@@ -1,0 +1,28 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's in-process mini-cluster fixture strategy (reference:
+test/core/TestUtils.h:68,154 — tiny memory options, forced spills) using the
+JAX host-platform device-count trick so multi-chip code paths execute in CI
+without TPUs (SURVEY.md §4).
+"""
+
+import os
+
+# must happen before jax import anywhere
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def ctx():
+    import tuplex_tpu
+
+    return tuplex_tpu.Context(
+        {"tuplex.partitionSize": "256KB", "tuplex.sample.maxDetectionRows": "64"}
+    )

@@ -1,0 +1,71 @@
+"""Type lattice semantics (reference parity: utils/test TypeSystemTest.cc)."""
+
+from tuplex_tpu.core import typesys as T
+
+
+def test_primitives_interned():
+    assert T.infer_type(1) is T.I64
+    assert T.infer_type(True) is T.BOOL
+    assert T.infer_type(1.5) is T.F64
+    assert T.infer_type("x") is T.STR
+    assert T.infer_type(None) is T.NULL
+    assert T.infer_type(()) is T.EMPTYTUPLE
+    assert T.infer_type(2**70) is T.PYOBJECT
+
+
+def test_tuple_inference_interned():
+    t1 = T.infer_type((1, "a"))
+    t2 = T.infer_type((2, "b"))
+    assert t1 is t2
+    assert isinstance(t1, T.TupleType)
+    assert t1.elements == (T.I64, T.STR)
+
+
+def test_super_type_numeric_chain():
+    assert T.super_type(T.BOOL, T.I64) is T.I64
+    assert T.super_type(T.I64, T.F64) is T.F64
+    assert T.super_type(T.F64, T.BOOL) is T.F64
+
+
+def test_super_type_null_makes_option():
+    t = T.super_type(T.I64, T.NULL)
+    assert t.is_optional() and t.without_option() is T.I64
+    # Option is idempotent
+    assert T.option(t) is t
+    assert T.super_type(t, T.NULL) is t
+    assert T.super_type(t, T.I64) is t
+
+
+def test_super_type_mismatch_is_pyobject():
+    assert T.super_type(T.STR, T.I64) is T.PYOBJECT
+    assert T.super_type(T.infer_type((1,)), T.infer_type((1, 2))) is T.PYOBJECT
+
+
+def test_normal_case_majority():
+    sample = [1, 2, 3, 4, 5, 6, 7, 8, 9, "x"]
+    nc, gc, frac = T.normal_case_type(sample, threshold=0.9)
+    assert nc is T.I64
+    assert gc is T.PYOBJECT
+    assert frac == 0.9
+
+
+def test_normal_case_with_nulls_promotes_option():
+    sample = [1, 2, None, 4]
+    nc, gc, frac = T.normal_case_type(sample, threshold=0.9)
+    assert nc.is_optional() and nc.without_option() is T.I64
+    assert frac == 1.0
+
+
+def test_normal_case_below_threshold_falls_to_general():
+    sample = [1, "a", 2, "b"]
+    nc, gc, frac = T.normal_case_type(sample, threshold=0.9)
+    assert nc is T.PYOBJECT and gc is T.PYOBJECT
+
+
+def test_conformance():
+    assert T.python_value_conforms(3, T.I64)
+    assert not T.python_value_conforms(3.0, T.I64)
+    assert not T.python_value_conforms(3, T.F64)  # no silent upcast
+    assert T.python_value_conforms(None, T.option(T.STR))
+    assert T.python_value_conforms("a", T.option(T.STR))
+    assert T.python_value_conforms((1, "a"), T.tuple_of(T.I64, T.STR))

@@ -1,0 +1,34 @@
+"""tuplex_tpu — a TPU-native data-processing framework.
+
+A from-scratch re-design of the Tuplex architecture (Spark-like Python UDF
+pipelines, data-driven compilation, dual-mode execution) where the compiled
+fast path is a jax.jit-traced columnar program running on TPU instead of
+LLVM-generated row loops, and distribution uses jax.sharding meshes + XLA
+collectives instead of thread pools / AWS Lambda.
+
+Public API mirrors the reference (reference: tuplex/python/tuplex/__init__.py:22-27):
+
+    import tuplex_tpu as tuplex
+    c = tuplex.Context()
+    c.parallelize([1, 2, None, 4]).map(lambda x: (x, x * x)).collect()
+"""
+
+from .core.errors import TuplexException
+
+__version__ = "0.1.0"
+
+__all__ = ["Context", "DataSet", "Metrics", "TuplexException", "__version__"]
+
+
+def __getattr__(name):
+    # lazy: importing the package must not drag in jax (slow, device init)
+    if name == "Context":
+        from .api.context import Context
+        return Context
+    if name == "DataSet":
+        from .api.dataset import DataSet
+        return DataSet
+    if name == "Metrics":
+        from .api.metrics import Metrics
+        return Metrics
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

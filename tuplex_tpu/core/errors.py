@@ -1,0 +1,87 @@
+"""Exception-code lattice for dual-mode execution.
+
+On device, every fused pipeline computes a per-row int32 error code alongside
+its outputs; code 0 means the row took the normal path. Non-zero rows are
+masked out of device outputs and shipped to the interpreter resolve path.
+
+Re-designs the reference's exception-code enum + exception partitions
+(reference: tuplex/utils/include/ExceptionCodes.h:24-118, compiled branch to
+exception_handler_f at core/include/physical/CodeDefs.h:43) as a vectorized
+code lattice: composed ops propagate the FIRST error per row (lower op index
+wins), matching sequential Python semantics.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class ExceptionCode(enum.IntEnum):
+    OK = 0
+    # Python exception classes reproducible by compiled paths
+    ZERODIVISIONERROR = 1
+    VALUEERROR = 2
+    TYPEERROR = 3
+    INDEXERROR = 4
+    KEYERROR = 5
+    ATTRIBUTEERROR = 6
+    OVERFLOWERROR = 7
+    STOPITERATION = 8
+    ASSERTIONERROR = 9
+    # internal codes (reference: ExceptionCodes.h NORMALCASEVIOLATION etc.)
+    NORMALCASEVIOLATION = 100
+    BADPARSE_STRING_INPUT = 101
+    NULLERROR = 102            # unexpected None on a non-Option path
+    GENERALCASEVIOLATION = 103
+    PYTHON_FALLBACK = 110      # UDF not compilable: row routed to interpreter
+    UNKNOWN = 120
+
+
+_PY_TO_CODE = {
+    ZeroDivisionError: ExceptionCode.ZERODIVISIONERROR,
+    ValueError: ExceptionCode.VALUEERROR,
+    TypeError: ExceptionCode.TYPEERROR,
+    IndexError: ExceptionCode.INDEXERROR,
+    KeyError: ExceptionCode.KEYERROR,
+    AttributeError: ExceptionCode.ATTRIBUTEERROR,
+    OverflowError: ExceptionCode.OVERFLOWERROR,
+    StopIteration: ExceptionCode.STOPITERATION,
+    AssertionError: ExceptionCode.ASSERTIONERROR,
+}
+
+_CODE_TO_PY = {v: k for k, v in _PY_TO_CODE.items()}
+
+
+def code_for_exception(exc: BaseException) -> ExceptionCode:
+    for cls in type(exc).__mro__:
+        if cls in _PY_TO_CODE:
+            return _PY_TO_CODE[cls]
+    return ExceptionCode.UNKNOWN
+
+
+def exception_class_for_code(code: int):
+    """Python exception class for a code (None for internal codes)."""
+    try:
+        return _CODE_TO_PY.get(ExceptionCode(code))
+    except ValueError:
+        return None
+
+
+def exception_name(code: int) -> str:
+    cls = exception_class_for_code(code)
+    if cls is not None:
+        return cls.__name__
+    try:
+        return ExceptionCode(code).name
+    except ValueError:
+        return f"code{code}"
+
+
+class TuplexException(Exception):
+    """Driver-side framework error (not a per-row exception)."""
+
+
+class NotCompilable(TuplexException):
+    """Raised by the emitter when a UDF uses constructs outside the compiled
+    subset; the operator then runs rows on the interpreter path (reference:
+    fallback mode, python/tests/test_fallback.py semantics)."""

@@ -1,0 +1,72 @@
+"""Boxed row values for driver-side sampling, transfer and the interpreter path.
+
+Reference semantics: tuplex/utils/src/Row.cc / Field.cc — a Row is an ordered
+tuple of fields, optionally with column names; single-element rows unwrap on
+collect. Here rows are lightweight wrappers over plain Python values; the
+columnar layout lives in `tuplex_tpu/runtime/columns.py`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional, Sequence
+
+from .typesys import Type, infer_type, tuple_of
+
+
+class Row:
+    __slots__ = ("values", "columns")
+
+    def __init__(self, values: Sequence[Any], columns: Optional[Sequence[str]] = None):
+        self.values: tuple = tuple(values)
+        self.columns: Optional[tuple] = tuple(columns) if columns else None
+
+    @classmethod
+    def from_value(cls, value: Any, columns: Optional[Sequence[str]] = None) -> "Row":
+        """Wrap a user value as a row: tuples spread into fields, everything
+        else is a single-field row (reference: Context.h parallelize)."""
+        if isinstance(value, tuple):
+            return cls(value, columns)
+        return cls((value,), columns)
+
+    def unwrap(self) -> Any:
+        """Single-field rows collect as the bare value (reference: Row semantics
+        in PythonDataSet.cc fast decoders)."""
+        if len(self.values) == 1:
+            return self.values[0]
+        return tuple(self.values)
+
+    def as_dict(self) -> dict:
+        if self.columns is None:
+            raise ValueError("row has no column names")
+        return dict(zip(self.columns, self.values))
+
+    def row_type(self) -> Type:
+        return tuple_of(*(infer_type(v) for v in self.values))
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def __iter__(self) -> Iterator[Any]:
+        return iter(self.values)
+
+    def __getitem__(self, key):
+        if isinstance(key, str):
+            if self.columns is None:
+                raise KeyError(key)
+            return self.values[self.columns.index(key)]
+        return self.values[key]
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, Row):
+            return self.values == other.values
+        return self.unwrap() == other
+
+    def __hash__(self) -> int:
+        return hash(self.values)
+
+    def __repr__(self) -> str:
+        if self.columns:
+            inner = ", ".join(f"{c}={v!r}" for c, v in zip(self.columns, self.values))
+        else:
+            inner = ", ".join(repr(v) for v in self.values)
+        return f"Row({inner})"

@@ -1,0 +1,418 @@
+"""Columnar host memory layout + host<->device staging.
+
+This replaces the reference's row-format Partition blocks
+(reference: core/include/Partition.h:38-85, utils/include/Serializer.h:104-138)
+with a TPU-first columnar layout:
+
+  * every logical column is decomposed into fixed-shape leaf arrays
+    (FlattenedTuple analog — reference: codegen/include/FlattenedTuple.h:49-57):
+      - numeric leaves: one array [N]
+      - str leaves:     uint8 bytes [N, W] zero-padded + int32 lengths [N]
+      - Option adds a validity bool [N]
+      - nested tuples flatten to dotted paths ("col.0.1")
+  * a partition covers a contiguous range of original row positions; rows that
+    do NOT conform to the normal-case schema keep their slot (placeholder
+    zeros) and live boxed in `fallback` — this preserves order for the
+    dual-mode merge (reference: ResolveTask.cc merge-in-order) with no index
+    bookkeeping.
+  * device staging pads N up to a bucket (and W per str col) so the jit cache
+    stays small (reference analog: one LLVM module per stage; here one XLA
+    executable per (stage, schema, bucket)).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Optional, Sequence
+
+import numpy as np
+
+from ..core import typesys as T
+from ..core.row import Row
+
+
+# ---------------------------------------------------------------------------
+# schema flattening
+# ---------------------------------------------------------------------------
+
+LEAF_NUMERIC = {T.BOOL: np.bool_, T.I64: np.int64, T.F64: np.float64}
+
+
+def flatten_type(t: T.Type, path: str = "") -> list[tuple[str, T.Type]]:
+    """Leaf (path, type) pairs for a column type. Option wraps leaves.
+
+    Leaf paths are INDEX-based ("2", "2.0", ...) — column names are metadata
+    only, so duplicate or hostile names can't collide storage keys.
+
+    An Option[Tuple[...]] column gets an extra "<path>#opt" BOOL leaf holding
+    whole-tuple validity (None vs a tuple of values), in addition to its
+    element leaves which become Option-wrapped.
+
+    Types without a fixed columnar layout (List/Dict/PYOBJECT) return a single
+    pyobject leaf — columns of that type are host-boxed and force rows through
+    the interpreter path when touched on device.
+    """
+    base = t.without_option() if t.is_optional() else t
+    opt = t.is_optional()
+
+    if isinstance(base, T.TupleType):
+        out: list[tuple[str, T.Type]] = []
+        if opt:
+            out.append((f"{path}#opt", T.BOOL))
+        for i, e in enumerate(base.elements):
+            sub = f"{path}.{i}" if path else str(i)
+            out.extend(flatten_type(T.option(e) if opt else e, sub))
+        return out
+    if base in (T.BOOL, T.I64, T.F64, T.STR, T.NULL, T.EMPTYTUPLE):
+        return [(path, t)]
+    return [(path, T.PYOBJECT)]
+
+
+def columnar_supported(t: T.Type) -> bool:
+    return all(lt is not T.PYOBJECT for _, lt in flatten_type(t))
+
+
+# ---------------------------------------------------------------------------
+# leaf column containers (host, numpy)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class NumericLeaf:
+    data: np.ndarray                      # [N] bool_/int64/float64
+    valid: Optional[np.ndarray] = None    # [N] bool_ when Option
+
+    def __len__(self):
+        return len(self.data)
+
+
+@dataclass
+class StrLeaf:
+    bytes: np.ndarray                     # [N, W] uint8, zero padded
+    lengths: np.ndarray                   # [N] int32
+    valid: Optional[np.ndarray] = None
+
+    def __len__(self):
+        return len(self.lengths)
+
+    @property
+    def width(self) -> int:
+        return self.bytes.shape[1] if self.bytes.ndim == 2 else 0
+
+
+@dataclass
+class NullLeaf:
+    """All-None column: carries only the row count."""
+    n: int
+
+    def __len__(self):
+        return self.n
+
+
+@dataclass
+class ObjectLeaf:
+    """Host-boxed python objects (List/Dict/PYOBJECT leaves)."""
+    values: list
+
+    def __len__(self):
+        return len(self.values)
+
+
+Leaf = NumericLeaf | StrLeaf | NullLeaf | ObjectLeaf
+
+
+def encode_str_leaf(values: Sequence[Optional[str]], optional: bool) -> StrLeaf:
+    n = len(values)
+    encoded = [v.encode("utf-8") if v is not None else b"" for v in values]
+    w = max((len(b) for b in encoded), default=0)
+    w = max(w, 1)
+    mat = np.zeros((n, w), dtype=np.uint8)
+    lens = np.zeros(n, dtype=np.int32)
+    for i, b in enumerate(encoded):
+        if b:
+            mat[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+        lens[i] = len(b)
+    valid = None
+    if optional:
+        valid = np.array([v is not None for v in values], dtype=np.bool_)
+    return StrLeaf(mat, lens, valid)
+
+
+def decode_str(leaf: StrLeaf, i: int) -> Optional[str]:
+    if leaf.valid is not None and not bool(leaf.valid[i]):
+        return None
+    ln = int(leaf.lengths[i])
+    return bytes(leaf.bytes[i, :ln]).decode("utf-8", errors="replace")
+
+
+def encode_leaf(values: Sequence[Any], t: T.Type) -> Leaf:
+    base = t.without_option() if t.is_optional() else t
+    opt = t.is_optional()
+    n = len(values)
+    if base is T.EMPTYTUPLE and opt:
+        # unit value with validity: only the valid bitmap carries information
+        valid = np.array([v is not None for v in values], dtype=np.bool_)
+        return NumericLeaf(np.zeros(n, dtype=np.bool_), valid)
+    if base is T.NULL or base is T.EMPTYTUPLE:
+        return NullLeaf(n)
+    if base is T.STR:
+        return encode_str_leaf(values, opt)
+    if base in LEAF_NUMERIC:
+        dtype = LEAF_NUMERIC[base]
+        if opt:
+            data = np.zeros(n, dtype=dtype)
+            valid = np.zeros(n, dtype=np.bool_)
+            for i, v in enumerate(values):
+                if v is not None:
+                    data[i] = v
+                    valid[i] = True
+            return NumericLeaf(data, valid)
+        return NumericLeaf(np.asarray(values, dtype=dtype))
+    return ObjectLeaf(list(values))
+
+
+def decode_leaf(leaf: Leaf, i: int) -> Any:
+    if isinstance(leaf, NullLeaf):
+        return None
+    if isinstance(leaf, ObjectLeaf):
+        return leaf.values[i]
+    if isinstance(leaf, StrLeaf):
+        return decode_str(leaf, i)
+    if leaf.valid is not None and not bool(leaf.valid[i]):
+        return None
+    v = leaf.data[i]
+    if leaf.data.dtype == np.bool_:
+        return bool(v)
+    if np.issubdtype(leaf.data.dtype, np.integer):
+        return int(v)
+    return float(v)
+
+
+# ---------------------------------------------------------------------------
+# partition
+# ---------------------------------------------------------------------------
+
+def _leaf_paths_for_value(path: str, t: T.Type, v: Any) -> Iterable[tuple[str, Any]]:
+    base = t.without_option() if t.is_optional() else t
+    opt = t.is_optional()
+    if isinstance(base, T.TupleType):
+        if opt:
+            yield (f"{path}#opt", v is not None)
+        for i, e in enumerate(base.elements):
+            sub = f"{path}.{i}" if path else str(i)
+            et = T.option(e) if opt else e
+            yield from _leaf_paths_for_value(sub, et, None if v is None else v[i])
+    else:
+        yield (path, v)
+
+
+@dataclass
+class Partition:
+    """A horizontal slice of a dataset in normal-case columnar layout.
+
+    `schema` is the normal-case RowType. `leaves` maps "<col>" or
+    "<col>.<i>..." paths to leaf arrays of length == num_rows. Non-conforming
+    row positions are False in `normal_mask` and boxed in `fallback`
+    (original python value, pre-conversion).
+    """
+
+    schema: T.RowType
+    num_rows: int
+    leaves: dict[str, Leaf] = field(default_factory=dict)
+    normal_mask: Optional[np.ndarray] = None      # [N] bool; None => all normal
+    fallback: dict[int, Any] = field(default_factory=dict)
+    start_index: int = 0                          # global row offset of row 0
+
+    @property
+    def columns(self) -> tuple[str, ...]:
+        return self.schema.columns
+
+    def n_normal(self) -> int:
+        if self.normal_mask is None:
+            return self.num_rows
+        return int(self.normal_mask.sum())
+
+    # -- row access (host) --------------------------------------------------
+    def decode_row(self, i: int) -> Row:
+        """Reconstruct the boxed row at local position i (interpreter path
+        input). Fallback rows return their original boxed value."""
+        if i in self.fallback:
+            return Row.from_value(self.fallback[i], self.columns if self.schema.columns else None)
+        vals = []
+        for ci, ct in enumerate(self.schema.types):
+            vals.append(self._decode_col(str(ci), ct, i))
+        return Row(vals, self.columns if self.columns else None)
+
+    def _decode_col(self, path: str, t: T.Type, i: int) -> Any:
+        base = t.without_option() if t.is_optional() else t
+        opt = t.is_optional()
+        if isinstance(base, T.TupleType):
+            if opt:
+                ol = self.leaves[f"{path}#opt"]
+                assert isinstance(ol, NumericLeaf)  # BOOL leaf: validity in data
+                if not bool(ol.data[i]):
+                    return None
+            return tuple(
+                self._decode_col(f"{path}.{j}", T.option(e) if opt else e, i)
+                for j, e in enumerate(base.elements)
+            )
+        if base is T.EMPTYTUPLE:
+            if opt:
+                leaf = self.leaves[path]
+                assert isinstance(leaf, NumericLeaf) and leaf.valid is not None
+                return () if bool(leaf.valid[i]) else None
+            return ()
+        return decode_leaf(self.leaves[path], i)
+
+    def iter_rows(self) -> Iterable[Row]:
+        for i in range(self.num_rows):
+            yield self.decode_row(i)
+
+    def nbytes(self) -> int:
+        total = 0
+        for leaf in self.leaves.values():
+            if isinstance(leaf, NumericLeaf):
+                total += leaf.data.nbytes + (leaf.valid.nbytes if leaf.valid is not None else 0)
+            elif isinstance(leaf, StrLeaf):
+                total += leaf.bytes.nbytes + leaf.lengths.nbytes
+        return total
+
+
+def build_partition(
+    values: Sequence[Any],
+    schema: T.RowType,
+    start_index: int = 0,
+) -> Partition:
+    """Encode boxed python row values into a Partition against `schema`.
+
+    Rows that don't conform to the normal-case schema keep their position as
+    placeholder slots and are boxed into `fallback` (reference: fallback
+    partitions of pickled objects, PythonContext.cc:617 parallelizeAnyType).
+    """
+    n = len(values)
+    # row value shape: single column -> bare value; multi -> tuple
+    multi = len(schema.columns) > 1
+
+    normal_mask = np.ones(n, dtype=np.bool_)
+    fallback: dict[int, Any] = {}
+    # per-leaf collected python values (placeholder None/0 for bad rows);
+    # leaf paths are column-index based so duplicate names can't collide
+    leaf_types: list[tuple[str, T.Type]] = []
+    for ci, ct in enumerate(schema.types):
+        leaf_types.extend(flatten_type(ct, str(ci)))
+    leaf_values: dict[str, list] = {p: [] for p, _ in leaf_types}
+    leaf_type_map = dict(leaf_types)
+
+    placeholders = {p: _placeholder(lt) for p, lt in leaf_types}
+
+    for i, v in enumerate(values):
+        row_tuple = v if multi else (v,)
+        ok = isinstance(row_tuple, tuple) and len(row_tuple) == len(schema.columns)
+        if ok:
+            for rv, ct in zip(row_tuple, schema.types):
+                if not T.python_value_conforms(rv, ct):
+                    ok = False
+                    break
+        if not ok:
+            normal_mask[i] = False
+            fallback[i] = v
+            for p in leaf_values:
+                leaf_values[p].append(placeholders[p])
+            continue
+        for ci, (ct, rv) in enumerate(zip(schema.types, row_tuple)):
+            for p, lv in _leaf_paths_for_value(str(ci), ct, rv):
+                leaf_values[p].append(lv)
+
+    leaves = {p: encode_leaf(vals, leaf_type_map[p]) for p, vals in leaf_values.items()}
+    mask = None if len(fallback) == 0 else normal_mask
+    return Partition(schema=schema, num_rows=n, leaves=leaves,
+                     normal_mask=mask, fallback=fallback, start_index=start_index)
+
+
+def _placeholder(t: T.Type) -> Any:
+    base = t.without_option() if t.is_optional() else t
+    if t.is_optional() or base is T.NULL or base is T.EMPTYTUPLE:
+        return None
+    if base is T.STR:
+        return ""
+    if base is T.BOOL:
+        return False
+    if base is T.I64:
+        return 0
+    if base is T.F64:
+        return 0.0
+    return None
+
+
+# ---------------------------------------------------------------------------
+# device staging
+# ---------------------------------------------------------------------------
+
+def bucket_size(n: int, mode: str = "pow2", minimum: int = 8) -> int:
+    if mode == "exact" or n <= 0:
+        return max(n, 1)
+    b = max(minimum, 1 << int(math.ceil(math.log2(max(n, 1)))))
+    return b
+
+
+def pad_to(arr: np.ndarray, n: int, axis: int = 0) -> np.ndarray:
+    cur = arr.shape[axis]
+    if cur >= n:
+        return arr
+    pad_width = [(0, 0)] * arr.ndim
+    pad_width[axis] = (0, n - cur)
+    return np.pad(arr, pad_width)
+
+
+@dataclass
+class DeviceBatch:
+    """The jit-facing view of a partition: dict of padded numpy/jnp arrays.
+
+    arrays keys: for each leaf path P:
+        P            -> numeric data     [B]
+        P#bytes      -> str bytes        [B, Wb]
+        P#len        -> str lengths      [B]
+        P#valid      -> validity         [B]      (Option leaves only)
+    plus:
+        "#rowvalid"  -> [B] bool — True for real, normal-case rows
+    `n` is the real row count, `b` the padded bucket size.
+    """
+
+    arrays: dict[str, np.ndarray]
+    n: int
+    b: int
+    schema: T.RowType
+
+    def spec(self) -> tuple:
+        """Hashable shape/dtype signature — the jit cache key component."""
+        return tuple(sorted(
+            (k, v.shape, str(v.dtype)) for k, v in self.arrays.items()
+        ))
+
+
+def stage_partition(part: Partition, bucket_mode: str = "pow2") -> DeviceBatch:
+    n = part.num_rows
+    b = bucket_size(n, bucket_mode)
+    arrays: dict[str, np.ndarray] = {}
+    for path, leaf in part.leaves.items():
+        if isinstance(leaf, NullLeaf):
+            continue
+        if isinstance(leaf, ObjectLeaf):
+            continue  # host-only column: device code must not touch it
+        if isinstance(leaf, NumericLeaf):
+            arrays[path] = pad_to(leaf.data, b)
+            if leaf.valid is not None:
+                arrays[path + "#valid"] = pad_to(leaf.valid, b)
+        elif isinstance(leaf, StrLeaf):
+            wb = bucket_size(max(leaf.width, 1), bucket_mode, minimum=8)
+            arrays[path + "#bytes"] = pad_to(pad_to(leaf.bytes, b, 0), wb, 1)
+            arrays[path + "#len"] = pad_to(leaf.lengths, b)
+            if leaf.valid is not None:
+                arrays[path + "#valid"] = pad_to(leaf.valid, b)
+    rowvalid = np.zeros(b, dtype=np.bool_)
+    if part.normal_mask is None:
+        rowvalid[:n] = True
+    else:
+        rowvalid[:n] = part.normal_mask
+    arrays["#rowvalid"] = rowvalid
+    return DeviceBatch(arrays=arrays, n=n, b=b, schema=part.schema)

@@ -1,0 +1,179 @@
+"""UDF source extraction + closure capture.
+
+Re-designs the reference's reflection/source-vault machinery
+(reference: python/tuplex/utils/reflection.py:156 get_function_code,
+source_vault.py:129, globs.py) without the vault indirection: we parse the
+defining source with `inspect` + `ast`, slice out the exact lambda when several
+share a line, and capture referenced globals/closure cells.
+
+The compiled path only needs the AST + captured constants; the interpreter
+fallback calls the live function object directly, so (unlike the reference) we
+never need to re-materialize code from source.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+import types
+from typing import Any, Callable
+
+
+class UDFSource:
+    __slots__ = ("func", "source", "tree", "globals", "name")
+
+    def __init__(self, func: Callable, source: str, tree: ast.AST,
+                 globs: dict[str, Any], name: str):
+        self.func = func
+        self.source = source          # normalized source ("lambda x: ..." / "def f...")
+        self.tree = tree              # ast.Lambda or ast.FunctionDef
+        self.globals = globs          # captured globals + closure cells (by name)
+        self.name = name
+
+    @property
+    def params(self) -> list[str]:
+        args = self.tree.args  # type: ignore[attr-defined]
+        return [a.arg for a in args.args]
+
+
+def _code_fingerprint(code: types.CodeType) -> tuple:
+    """Location-independent fingerprint of a code object (bytecode + const
+    structure), so identical-looking lambdas at different columns differ only
+    if their bodies differ."""
+    consts = tuple(
+        _code_fingerprint(c) if isinstance(c, types.CodeType) else c
+        for c in code.co_consts
+    )
+    return (code.co_code, consts, code.co_names, code.co_varnames[: code.co_argcount])
+
+
+def _find_lambda_node(tree: ast.AST, func: types.FunctionType) -> ast.Lambda | None:
+    """Pick the lambda node matching `func` when a line holds several, by
+    compiling each candidate and comparing bytecode fingerprints (reference:
+    source_vault disambiguates via code-object comparison,
+    python/tuplex/utils/source_vault.py:129)."""
+    lambdas = [n for n in ast.walk(tree) if isinstance(n, ast.Lambda)]
+    if not lambdas:
+        return None
+    if len(lambdas) == 1:
+        return lambdas[0]
+    want = _code_fingerprint(func.__code__)
+    matched: list[ast.Lambda] = []
+    for n in lambdas:
+        try:
+            expr = ast.Expression(body=n)
+            ast.fix_missing_locations(expr)
+            compiled = compile(expr, "<udf>", "eval")
+            lam_code = next(
+                c for c in compiled.co_consts if isinstance(c, types.CodeType)
+            )
+            if _code_fingerprint(lam_code) == want:
+                matched.append(n)
+        except (SyntaxError, ValueError, StopIteration):
+            continue
+    if matched:
+        return matched[0]  # identical fingerprints => identical behavior
+    # last resort: argument-name match, then position order
+    want_args = func.__code__.co_varnames[: func.__code__.co_argcount]
+    pool = [
+        n for n in lambdas if tuple(a.arg for a in n.args.args) == tuple(want_args)
+    ] or lambdas
+    pool.sort(key=lambda n: (n.lineno, n.col_offset))
+    return pool[0]
+
+
+def get_udf_source(func: Callable) -> UDFSource:
+    """Extract normalized source + AST + captured globals for a UDF."""
+    if not callable(func):
+        raise TypeError(f"UDF must be callable, got {type(func)}")
+    if not isinstance(func, types.FunctionType):
+        # builtins / callables: no source — interpreter-only UDF
+        return UDFSource(func, "", ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=ast.Constant(value=None)), {}, getattr(func, "__name__", "<callable>"))
+
+    try:
+        raw = inspect.getsource(func)
+    except (OSError, TypeError):
+        raw = ""
+
+    tree_node: ast.AST | None = None
+    source = raw
+    if raw:
+        dedented = textwrap.dedent(raw)
+        try:
+            mod = ast.parse(dedented)
+        except SyntaxError:
+            # e.g. source slice starts mid-expression: `.map(lambda x: x)` —
+            # retry after trimming to the first `lambda`/`def`
+            for kw in ("lambda", "def "):
+                idx = dedented.find(kw)
+                if idx >= 0:
+                    frag = dedented[idx:].rstrip()
+                    while frag:
+                        try:
+                            mod = ast.parse(frag)
+                            break
+                        except SyntaxError:
+                            frag = frag[:-1]
+                    else:
+                        mod = None
+                    if mod is not None:
+                        break
+            else:
+                mod = None
+        if mod is not None:
+            if func.__name__ == "<lambda>":
+                tree_node = _find_lambda_node(mod, func)
+                if tree_node is not None:
+                    source = ast.unparse(tree_node)
+            else:
+                for n in ast.walk(mod):
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                            n.name == func.__name__:
+                        tree_node = n
+                        source = ast.unparse(n)
+                        break
+
+    globs = capture_globals(func)
+    if tree_node is None:
+        # no retrievable source (stdin/REPL without history): interpreter-only
+        # UDF, but keep real param names so schema hinting still works
+        source = ""
+        tree_node = _dummy(func.__code__.co_varnames[: func.__code__.co_argcount])
+    return UDFSource(func, source, tree_node, globs, func.__name__)
+
+
+def _dummy(params: tuple[str, ...] = ()) -> ast.Lambda:
+    return ast.Lambda(
+        args=ast.arguments(posonlyargs=[],
+                           args=[ast.arg(arg=p) for p in params],
+                           kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=ast.Constant(value=None))
+
+
+def capture_globals(func: types.FunctionType) -> dict[str, Any]:
+    """Names the function references resolved from its globals and closure
+    (reference: python/tuplex/utils/globs.py)."""
+    out: dict[str, Any] = {}
+
+    def walk_names(code: types.CodeType) -> set[str]:
+        names = set(code.co_names)
+        for c in code.co_consts:
+            if isinstance(c, types.CodeType):  # nested lambdas/comprehensions
+                names |= walk_names(c)
+        return names
+
+    g = func.__globals__
+    for name in walk_names(func.__code__):
+        if name in g:
+            out[name] = g[name]
+    if func.__closure__:
+        for name, cell in zip(func.__code__.co_freevars, func.__closure__):
+            try:
+                out[name] = cell.cell_contents
+            except ValueError:
+                pass
+    return out
