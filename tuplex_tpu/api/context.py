@@ -1,0 +1,150 @@
+"""Public Context — the engine entry point.
+
+API parity with the reference's Python Context (reference:
+python/tuplex/context.py:50 — options merge, parallelize/csv/text entry
+points; core/include/Context.h:43). There is no binding layer: planning and
+execution are Python-driven, the hot path is XLA-compiled.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional, Sequence
+
+from ..core import typesys as T
+from ..core.errors import TuplexException
+from ..core.options import ContextOptions
+from ..exec.local import LocalBackend
+from ..plan import logical as L
+from ..runtime import columns as C
+from .metrics import Metrics
+
+
+class Context:
+    def __init__(self, conf: Mapping[str, Any] | str | None = None, **kwargs):
+        self.options_store = ContextOptions(conf if not isinstance(conf, str)
+                                            else None, **kwargs)
+        if isinstance(conf, str):
+            self.options_store.update(conf)
+        self.backend = self._make_backend()
+        self.metrics = Metrics()
+
+    def _make_backend(self):
+        name = self.options_store.get_str("tuplex.backend", "local")
+        if name in ("local", "tpu"):
+            return LocalBackend(self.options_store)
+        if name == "multihost":
+            from ..exec.multihost import MultiHostBackend
+
+            return MultiHostBackend(self.options_store)
+        raise TuplexException(f"unknown backend {name!r}")
+
+    # ------------------------------------------------------------------
+    def parallelize(self, data: Sequence[Any],
+                    columns: Optional[Sequence[str]] = None,
+                    schema: Optional[T.RowType] = None) -> "DataSet":
+        """Create a DataSet from python values (reference: context.py
+        parallelize → PythonContext.cc:823-919 fast transfer + fallback
+        partitions for non-conforming rows)."""
+        from .dataset import DataSet
+
+        data = list(data)
+        if not data:
+            raise TuplexException("parallelize: empty input")
+        max_rows = self.options_store.get_int(
+            "tuplex.sample.maxDetectionRows", 1000)
+        threshold = self.options_store.get_float(
+            "tuplex.normalcaseThreshold", 0.9)
+        if schema is None:
+            schema = _infer_row_schema(data[:max_rows], columns, threshold)
+        elif columns:
+            schema = T.row_of(columns, schema.types)
+
+        if C.user_columns(schema) and any(isinstance(v, dict) for v in data[:8]):
+            # dict rows were auto-unpacked into named columns: convert values
+            # (rows missing keys stay boxed and go to the fallback path)
+            keys = list(schema.columns)
+            data = [
+                tuple(d[k] for k in keys)
+                if isinstance(d, dict) and set(d.keys()) == set(keys) else d
+                for d in data
+            ]
+
+        op = L.ParallelizeOperator(data, schema, sample_size=max_rows)
+        return DataSet(self, op)
+
+    def csv(self, pattern: str, columns=None, header=None, delimiter=None,
+            type_hints=None, null_values=None) -> "DataSet":
+        from ..io.csvsource import make_csv_operator
+        from .dataset import DataSet
+
+        op = make_csv_operator(self.options_store, pattern, columns=columns,
+                               header=header, delimiter=delimiter,
+                               type_hints=type_hints, null_values=null_values)
+        return DataSet(self, op)
+
+    def text(self, pattern: str) -> "DataSet":
+        from ..io.csvsource import make_text_operator
+        from .dataset import DataSet
+
+        return DataSet(self, make_text_operator(self.options_store, pattern))
+
+    def options(self) -> dict:
+        return self.options_store.as_dict()
+
+    def optionsToYAML(self, path: str) -> None:
+        with open(path, "w") as fp:
+            for k, v in sorted(self.options_store.as_dict().items()):
+                fp.write(f"{k}: {v}\n")
+
+    # filesystem helpers (reference: context.py ls/cp/rm via VFS)
+    def ls(self, pattern: str) -> list[str]:
+        from ..io.vfs import VirtualFileSystem
+
+        return VirtualFileSystem.ls(pattern)
+
+    def cp(self, src: str, dst: str) -> None:
+        from ..io.vfs import VirtualFileSystem
+
+        VirtualFileSystem.cp(src, dst)
+
+    def rm(self, pattern: str) -> None:
+        from ..io.vfs import VirtualFileSystem
+
+        VirtualFileSystem.rm(pattern)
+
+    def uiWebURL(self) -> str:
+        host = self.options_store.get_str("tuplex.webui.url", "localhost")
+        port = self.options_store.get_str("tuplex.webui.port", "5000")
+        return f"http://{host}:{port}"
+
+
+def _infer_row_schema(sample: list, columns, threshold: float) -> T.RowType:
+    """Column-wise normal-case speculation (reference:
+    PythonContext.cc:1023 inferType — majority type over the sample)."""
+    dicts = all(isinstance(v, dict) for v in sample)
+    if dicts and sample:
+        # auto-unpack string-keyed dicts into named columns (reference:
+        # strDictParallelize, PythonContext.cc:617)
+        keys = list(sample[0].keys())
+        if all(list(d.keys()) == keys for d in sample) and \
+                all(isinstance(k, str) for k in keys):
+            types = [T.normal_case_type([d[k] for d in sample], threshold)[0]
+                     for k in keys]
+            return T.row_of(keys, types)
+    tuples = [v for v in sample if isinstance(v, tuple)]
+    if tuples and len(tuples) >= threshold * len(sample):
+        k = len(tuples[0])
+        if all(len(t) == k for t in tuples):
+            types = []
+            for ci in range(k):
+                vals = [t[ci] for t in tuples]
+                nc, _, _ = T.normal_case_type(vals, threshold)
+                types.append(nc)
+            names = list(columns) if columns else [f"_{i}" for i in range(k)]
+            if len(names) != k:
+                raise TuplexException(
+                    f"{k} columns in data but {len(names)} names given")
+            return T.row_of(names, types)
+    nc, _, _ = T.normal_case_type(sample, threshold)
+    names = list(columns) if columns else ["_0"]
+    return T.row_of(names[:1], [nc])

@@ -1,0 +1,202 @@
+"""Public DataSet — lazy operator-graph builder.
+
+Method-for-method parity with the reference's DataSet (reference:
+python/tuplex/dataset.py — map:49, filter:83, collect:113, take:125, show:144,
+resolve:162, withColumn:201, mapColumn:231, selectColumns:262,
+renameColumn:293, ignore:319, cache:346, columns:365, types:375, join:384,
+leftJoin:442, tocsv:500, aggregate:593, aggregateByKey:644, unique:36,
+exception_counts:707). Every method returns a NEW DataSet over a new logical
+operator; nothing executes until an action (collect/take/show/tocsv).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+from ..core import typesys as T
+from ..core.errors import TuplexException
+from ..plan import logical as L
+from ..plan.physical import plan_stages
+
+
+class DataSet:
+    def __init__(self, context, op: L.LogicalOperator):
+        self._context = context
+        self._op = op
+        self._last_exceptions: list = []
+
+    def _derive(self, op: L.LogicalOperator) -> "DataSet":
+        return DataSet(self._context, op)
+
+    # -- transformations ----------------------------------------------------
+    def map(self, udf: Callable) -> "DataSet":
+        return self._derive(L.MapOperator(self._op, udf))
+
+    def filter(self, udf: Callable) -> "DataSet":
+        return self._derive(L.FilterOperator(self._op, udf))
+
+    def withColumn(self, column: str, udf: Callable) -> "DataSet":
+        return self._derive(L.WithColumnOperator(self._op, column, udf))
+
+    def mapColumn(self, column: str, udf: Callable) -> "DataSet":
+        return self._derive(L.MapColumnOperator(self._op, column, udf))
+
+    def selectColumns(self, columns: Sequence) -> "DataSet":
+        if not isinstance(columns, (list, tuple)):
+            columns = [columns]
+        return self._derive(L.SelectColumnsOperator(self._op, columns))
+
+    def renameColumn(self, old, new: str) -> "DataSet":
+        return self._derive(L.RenameColumnOperator(self._op, old, new))
+
+    def resolve(self, exc_class: type, udf: Callable) -> "DataSet":
+        return self._derive(L.ResolveOperator(self._op, exc_class, udf))
+
+    def ignore(self, exc_class: type) -> "DataSet":
+        return self._derive(L.IgnoreOperator(self._op, exc_class))
+
+    def unique(self) -> "DataSet":
+        from ..plan.aggregates import UniqueOperator
+
+        return self._derive(UniqueOperator(self._op))
+
+    def aggregate(self, combine: Callable, aggregate: Callable,
+                  initial: Any) -> "DataSet":
+        from ..plan.aggregates import AggregateOperator
+
+        return self._derive(
+            AggregateOperator(self._op, combine, aggregate, initial))
+
+    def aggregateByKey(self, combine: Callable, aggregate: Callable,
+                       initial: Any, key_columns: Sequence[str]) -> "DataSet":
+        from ..plan.aggregates import AggregateByKeyOperator
+
+        return self._derive(AggregateByKeyOperator(
+            self._op, combine, aggregate, initial, key_columns))
+
+    def join(self, other: "DataSet", left_column: str, right_column: str,
+             prefixes=None, suffixes=None) -> "DataSet":
+        from ..plan.joins import JoinOperator
+
+        return self._derive(JoinOperator(
+            self._op, other._op, left_column, right_column, "inner",
+            prefixes, suffixes))
+
+    def leftJoin(self, other: "DataSet", left_column: str, right_column: str,
+                 prefixes=None, suffixes=None) -> "DataSet":
+        from ..plan.joins import JoinOperator
+
+        return self._derive(JoinOperator(
+            self._op, other._op, left_column, right_column, "left",
+            prefixes, suffixes))
+
+    def cache(self, store_specialized: bool = True) -> "DataSet":
+        from ..plan.cacheop import CacheOperator
+
+        op = CacheOperator(self._op, store_specialized)
+        op.materialize(self._context)
+        return self._derive(op)
+
+    # -- metadata -----------------------------------------------------------
+    @property
+    def columns(self) -> Optional[list[str]]:
+        cols = self._op.columns()
+        return list(cols) if cols else None
+
+    @property
+    def types(self) -> list:
+        return list(self._op.schema().types)
+
+    @property
+    def schema(self) -> T.RowType:
+        return self._op.schema()
+
+    # -- actions ------------------------------------------------------------
+    def collect(self):
+        return self._execute(limit=-1)
+
+    def take(self, nrows: int = 5):
+        return self._execute(limit=nrows)
+
+    def show(self, nrows: int = -1) -> None:
+        rows = self._execute(limit=nrows) if nrows >= 0 else self.collect()
+        cols = self.columns
+        if cols:
+            print(" | ".join(cols))
+            print("-" * (3 * len(cols) + sum(len(c) for c in cols)))
+        for r in rows:
+            if isinstance(r, tuple):
+                print(" | ".join(repr(v) for v in r))
+            else:
+                print(repr(r))
+
+    def tocsv(self, path: str, **kwargs) -> None:
+        from ..io.csvsink import write_csv
+
+        rows = self.collect()
+        write_csv(path, rows, self.columns)
+
+    def exception_counts(self) -> dict[str, int]:
+        """Counts of unresolved exceptions from the LAST action on this
+        dataset chain (reference: dataset.py:707)."""
+        counts: dict[str, int] = {}
+        for rec in self._last_exceptions:
+            counts[rec.exc_name] = counts.get(rec.exc_name, 0) + 1
+        return counts
+
+    # ------------------------------------------------------------------
+    def _execute(self, limit: int):
+        sink = L.TakeOperator(self._op, limit) if limit >= 0 else self._op
+        stages = plan_stages(sink)
+        backend = self._context.backend
+        partitions = None
+        all_exceptions = []
+        for stage in stages:
+            partitions = stage.input_partitions(self._context) \
+                if hasattr(stage, "input_partitions") else partitions
+            if partitions is None:
+                partitions = _source_partitions(self._context, stage)
+            result = backend.execute(stage, partitions)
+            partitions = result.partitions
+            all_exceptions.extend(result.exceptions)
+            self._context.metrics.record_stage(result.metrics)
+        self._last_exceptions = all_exceptions
+        out = []
+        for p in partitions or []:
+            for r in p.iter_rows():
+                out.append(r.unwrap())
+        if limit >= 0:
+            out = out[:limit]
+        return out
+
+
+def _source_partitions(context, stage):
+    """Materialize the stage source into columnar partitions."""
+    src = stage.source
+    if isinstance(src, L.ParallelizeOperator):
+        from ..runtime import columns as C
+
+        schema = src.schema()
+        part_rows = _rows_per_partition(context, schema, len(src.data))
+        parts = []
+        for off in range(0, len(src.data), part_rows):
+            chunk = src.data[off: off + part_rows]
+            parts.append(C.build_partition(chunk, schema, start_index=off))
+        return parts
+    if hasattr(src, "load_partitions"):
+        return src.load_partitions(context)
+    raise TuplexException(f"unknown source {src!r}")
+
+
+def _rows_per_partition(context, schema, total_rows: int) -> int:
+    psize = context.options_store.get_size("tuplex.partitionSize", 32 << 20)
+    # rough per-row cost: 8B per numeric leaf + 64B per str leaf
+    from ..runtime import columns as C
+
+    per_row = 0
+    for ci, ct in enumerate(schema.types):
+        for _, lt in C.flatten_type(ct, str(ci)):
+            base = lt.without_option() if lt.is_optional() else lt
+            per_row += 64 if base is T.STR else 8
+    per_row = max(per_row, 8)
+    return max(64, min(total_rows, psize // per_row))

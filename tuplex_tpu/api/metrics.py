@@ -1,0 +1,38 @@
+"""Job metrics (reference: core/include/JobMetrics.h:23-70 — compile/sample
+times, fast/slow path wall time; exposed via python/tuplex/metrics.py)."""
+
+from __future__ import annotations
+
+
+class Metrics:
+    def __init__(self):
+        self.stages: list[dict] = []
+
+    def record_stage(self, m: dict) -> None:
+        self.stages.append(dict(m))
+
+    @property
+    def totalExceptionCount(self) -> int:
+        return sum(int(m.get("exception_rows", 0)) for m in self.stages)
+
+    def fastPathWallTime(self) -> float:
+        return sum(float(m.get("fast_path_s", 0.0)) for m in self.stages)
+
+    def slowPathWallTime(self) -> float:
+        return sum(float(m.get("slow_path_s", 0.0)) for m in self.stages)
+
+    def totalWallTime(self) -> float:
+        return sum(float(m.get("wall_s", 0.0)) for m in self.stages)
+
+    def as_dict(self) -> dict:
+        return {
+            "stages": list(self.stages),
+            "fast_path_s": self.fastPathWallTime(),
+            "slow_path_s": self.slowPathWallTime(),
+            "wall_s": self.totalWallTime(),
+        }
+
+    def as_json(self) -> str:
+        import json
+
+        return json.dumps(self.as_dict())

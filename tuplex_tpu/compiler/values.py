@@ -1,0 +1,103 @@
+"""Columnar symbolic values (CV) — what the emitter's abstract interpreter
+pushes around while tracing a UDF over column batches.
+
+A CV is one of:
+  * const    — a compile-time Python scalar (specialized into the trace, the
+               way the reference bakes constants into LLVM IR)
+  * numeric  — data [B] (+ valid [B] when Option)
+  * str      — sbytes [B, W] + slen [B] (+ valid)
+  * null     — the None value for every row
+  * tuple    — tuple of CVs (+ valid for Option[Tuple]); may carry field names
+               (row values: dict-style access x['col'] resolves here, the
+               reference's dict-access rewrite UDF.h:183)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..core import typesys as T
+from ..core.errors import NotCompilable
+from ..runtime.jaxcfg import jnp
+
+_MISSING = object()
+
+
+@dataclass
+class CV:
+    t: T.Type
+    data: Any = None            # numeric payload [B]
+    valid: Any = None           # Option validity [B] (None => always valid)
+    sbytes: Any = None          # str payload [B, W]
+    slen: Any = None            # str lengths [B]
+    elts: Optional[tuple] = None          # tuple elements (CVs)
+    names: Optional[tuple] = None         # field names for row-tuples
+    const: Any = _MISSING       # compile-time constant
+
+    # -- predicates ----------------------------------------------------------
+    @property
+    def is_const(self) -> bool:
+        return self.const is not _MISSING
+
+    @property
+    def base(self) -> T.Type:
+        return self.t.without_option() if self.t.is_optional() else self.t
+
+    def __repr__(self):
+        if self.is_const:
+            return f"CV(const={self.const!r})"
+        return f"CV({self.t})"
+
+
+def const_cv(value: Any) -> CV:
+    return CV(t=T.infer_type(value), const=value)
+
+
+def null_cv() -> CV:
+    return CV(t=T.NULL, const=None)
+
+
+def tuple_cv(elts: Sequence[CV], names: Optional[Sequence[str]] = None,
+             valid: Any = None) -> CV:
+    ts = tuple(e.t for e in elts)
+    t = T.tuple_of(*ts)
+    if valid is not None:
+        t = T.option(t)
+    return CV(t=t, elts=tuple(elts), names=tuple(names) if names else None,
+              valid=valid)
+
+
+def materialize(cv: CV, b: int) -> CV:
+    """Broadcast a const CV to batch arrays of length b."""
+    if not cv.is_const:
+        return cv
+    v = cv.const
+    if v is None:
+        return CV(t=T.NULL, const=None)  # null stays symbolic
+    if isinstance(v, bool):
+        return CV(t=T.BOOL, data=jnp.full(b, v, dtype=bool))
+    if isinstance(v, int):
+        return CV(t=T.I64, data=jnp.full(b, v, dtype=jnp.int64))
+    if isinstance(v, float):
+        return CV(t=T.F64, data=jnp.full(b, v, dtype=jnp.float64))
+    if isinstance(v, str):
+        from ..ops import strings as S
+
+        sb, sl = S.broadcast_const(v, b)
+        return CV(t=T.STR, sbytes=sb, slen=sl)
+    if isinstance(v, tuple):
+        return tuple_cv([materialize(const_cv(x), b) for x in v])
+    raise NotCompilable(f"cannot materialize constant {type(v).__name__}")
+
+
+def dtype_for(t: T.Type):
+    if t is T.BOOL:
+        return np.bool_
+    if t is T.I64:
+        return np.int64
+    if t is T.F64:
+        return np.float64
+    raise NotCompilable(f"no dtype for {t}")

@@ -387,6 +387,8 @@ def normal_case_type(
 
 def python_value_conforms(value: Any, t: Type) -> bool:
     """Does `value` fit in the columnar layout of type `t` exactly?"""
+    if t is PYOBJECT:
+        return True  # boxed object columns accept anything
     vt = infer_type(value)
     if vt is t:
         return True

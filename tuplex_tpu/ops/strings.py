@@ -1,0 +1,620 @@
+"""Vectorized string kernels over fixed-width byte matrices.
+
+The TPU-native replacement for the reference's compiled string runtime
+(reference: tuplex/runtime/src/StringFunctions.cc:76-439 — SIMD strLower etc.,
+and codegen'd str methods in codegen/include/FunctionRegistry.h:71-205).
+
+Representation: a batch of N strings is (bytes: uint8 [N, W] zero-padded,
+lens: int32 [N]). All kernels are shape-static jnp programs — constant
+needles/widths are baked into the trace (they come from UDF constants, which
+the data-driven compiler specializes on, exactly like the reference bakes
+constants into LLVM IR).
+
+Conventions:
+  * kernels never raise — they return (result..., err) or sentinel values;
+    the emitter turns sentinels into error-code lattice updates
+  * positions use int32; -1 means "not found" (Python find semantics)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..runtime.jaxcfg import jnp
+
+
+def const_bytes(s: str) -> np.ndarray:
+    return np.frombuffer(s.encode("utf-8"), dtype=np.uint8)
+
+
+def broadcast_const(s: str, n: int, width: int | None = None):
+    """Materialize a python str constant as an [n, W] batch."""
+    b = const_bytes(s)
+    w = max(len(b), 1) if width is None else width
+    mat = np.zeros((1, w), dtype=np.uint8)
+    mat[0, : len(b)] = b
+    return (
+        jnp.broadcast_to(jnp.asarray(mat), (n, w)),
+        jnp.full((n,), len(b), dtype=jnp.int32),
+    )
+
+
+def _pos_mask(width: int, lens):
+    """[N, width] bool — True where position < len."""
+    return jnp.arange(width, dtype=jnp.int32)[None, :] < lens[:, None]
+
+
+# ---------------------------------------------------------------------------
+# search
+# ---------------------------------------------------------------------------
+
+def find_const(bytes_, lens, needle: str, start=None, reverse: bool = False):
+    """str.find / str.rfind with a constant needle. Returns int32 [N], -1 if
+    absent. Empty needle matches at `start` (Python semantics: ''.find -> 0)."""
+    n, w = bytes_.shape
+    nb = const_bytes(needle)
+    m = len(nb)
+    if m == 0:
+        if reverse:
+            return lens.astype(jnp.int32)  # s.rfind('') == len(s)
+        base = jnp.zeros(n, dtype=jnp.int32) if start is None else start
+        return jnp.where(base > lens, -1, base).astype(jnp.int32)
+    if m > w:
+        return jnp.full(n, -1, dtype=jnp.int32)
+    # match[i, p] = bytes[i, p:p+m] == needle, for p in [0, w-m]
+    npos = w - m + 1
+    match = jnp.ones((n, npos), dtype=bool)
+    for j in range(m):  # m is a compile-time constant: unrolled, XLA fuses
+        match = match & (bytes_[:, j : j + npos] == nb[j])
+    pos = jnp.arange(npos, dtype=jnp.int32)[None, :]
+    inside = pos + m <= lens[:, None]
+    match = match & inside
+    if start is not None:
+        # Python semantics: negative start counts from the end
+        nstart = jnp.where(start < 0, jnp.maximum(start + lens, 0), start)
+        match = match & (pos >= nstart[:, None])
+    if reverse:
+        found = jnp.max(jnp.where(match, pos, -1), axis=1)
+    else:
+        big = npos + 1
+        first = jnp.min(jnp.where(match, pos, big), axis=1)
+        found = jnp.where(first >= big, -1, first)
+    return found.astype(jnp.int32)
+
+
+def contains_const(bytes_, lens, needle: str):
+    return find_const(bytes_, lens, needle) >= 0
+
+
+def startswith_const(bytes_, lens, prefix: str):
+    nb = const_bytes(prefix)
+    m = len(nb)
+    n, w = bytes_.shape
+    if m == 0:
+        return jnp.ones(n, dtype=bool)
+    if m > w:
+        return jnp.zeros(n, dtype=bool)
+    ok = lens >= m
+    for j in range(m):
+        ok = ok & (bytes_[:, j] == nb[j])
+    return ok
+
+
+def endswith_const(bytes_, lens, suffix: str):
+    nb = const_bytes(suffix)
+    m = len(nb)
+    n, w = bytes_.shape
+    if m == 0:
+        return jnp.ones(n, dtype=bool)
+    if m > w:
+        return jnp.zeros(n, dtype=bool)
+    ok = lens >= m
+    start = lens - m
+    idx = start[:, None] + jnp.arange(m, dtype=jnp.int32)[None, :]
+    idx = jnp.clip(idx, 0, w - 1)
+    got = jnp.take_along_axis(bytes_, idx, axis=1)
+    ok = ok & jnp.all(got == jnp.asarray(nb)[None, :], axis=1)
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# slicing / substring
+# ---------------------------------------------------------------------------
+
+def normalize_index(idx, lens):
+    """Python index semantics: negatives count from the end."""
+    return jnp.where(idx < 0, idx + lens, idx)
+
+
+def slice_(bytes_, lens, start, stop, out_width: int | None = None):
+    """s[start:stop] with per-row dynamic bounds (already normalized, may be
+    None for defaults). Returns (bytes [N, Wout], lens [N])."""
+    n, w = bytes_.shape
+    zeros = jnp.zeros(n, dtype=jnp.int32)
+    if start is None:
+        start = zeros
+    if stop is None:
+        stop = lens
+    start = jnp.clip(jnp.where(start < 0, start + lens, start), 0, lens)
+    stop = jnp.clip(jnp.where(stop < 0, stop + lens, stop), 0, lens)
+    out_len = jnp.maximum(stop - start, 0)
+    wout = w if out_width is None else out_width
+    idx = start[:, None] + jnp.arange(wout, dtype=jnp.int32)[None, :]
+    idx_c = jnp.clip(idx, 0, w - 1)
+    out = jnp.take_along_axis(bytes_, idx_c, axis=1)
+    keep = jnp.arange(wout, dtype=jnp.int32)[None, :] < out_len[:, None]
+    return jnp.where(keep, out, 0).astype(jnp.uint8), out_len.astype(jnp.int32)
+
+
+def char_at(bytes_, lens, idx):
+    """s[i] -> (bytes [N,1], len [N]=1, err_oob [N] bool)."""
+    n, w = bytes_.shape
+    nidx = normalize_index(idx, lens)
+    oob = (nidx < 0) | (nidx >= lens)
+    safe = jnp.clip(nidx, 0, w - 1)
+    ch = jnp.take_along_axis(bytes_, safe[:, None], axis=1)
+    return ch.astype(jnp.uint8), jnp.ones(n, dtype=jnp.int32), oob
+
+
+# ---------------------------------------------------------------------------
+# case / strip / replace / concat
+# ---------------------------------------------------------------------------
+
+def lower(bytes_, lens):
+    is_up = (bytes_ >= 65) & (bytes_ <= 90)
+    return jnp.where(is_up, bytes_ + 32, bytes_).astype(jnp.uint8), lens
+
+
+def upper(bytes_, lens):
+    is_lo = (bytes_ >= 97) & (bytes_ <= 122)
+    return jnp.where(is_lo, bytes_ - 32, bytes_).astype(jnp.uint8), lens
+
+
+def swapcase(bytes_, lens):
+    is_up = (bytes_ >= 65) & (bytes_ <= 90)
+    is_lo = (bytes_ >= 97) & (bytes_ <= 122)
+    out = jnp.where(is_up, bytes_ + 32, jnp.where(is_lo, bytes_ - 32, bytes_))
+    return out.astype(jnp.uint8), lens
+
+
+_WHITESPACE = np.array([9, 10, 11, 12, 13, 32], dtype=np.uint8)
+
+
+def _is_space(bytes_):
+    acc = jnp.zeros(bytes_.shape, dtype=bool)
+    for c in _WHITESPACE:
+        acc = acc | (bytes_ == c)
+    return acc
+
+
+def _is_in_charset(bytes_, chars: str):
+    cs = const_bytes(chars)
+    acc = jnp.zeros(bytes_.shape, dtype=bool)
+    for c in cs:
+        acc = acc | (bytes_ == c)
+    return acc
+
+
+def strip(bytes_, lens, chars: str | None = None, left=True, right=True):
+    n, w = bytes_.shape
+    strippable = _is_space(bytes_) if chars is None else _is_in_charset(bytes_, chars)
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    inside = pos < lens[:, None]
+    keepable = ~strippable & inside
+    if left:
+        big = w + 1
+        first_keep = jnp.min(jnp.where(keepable, pos, big), axis=1)
+        start = jnp.where(first_keep >= big, lens, first_keep)
+    else:
+        start = jnp.zeros(n, dtype=jnp.int32)
+    if right:
+        last_keep = jnp.max(jnp.where(keepable, pos, -1), axis=1)
+        stop = jnp.where(last_keep < 0, start, last_keep + 1)
+    else:
+        stop = lens
+    return slice_(bytes_, lens, start, jnp.maximum(stop, start))
+
+
+def replace_const(bytes_, lens, old: str, new: str):
+    """str.replace with constant old/new.
+
+    Fast paths: len(old)==len(new) (in-place mask) and new=='' (compaction).
+    General case grows the width by the worst-case expansion factor.
+    """
+    ob, nb = const_bytes(old), const_bytes(new)
+    m, k = len(ob), len(nb)
+    n, w = bytes_.shape
+    if m == 0:
+        raise NotImplementedError("replace with empty pattern")
+    # match starts
+    npos = w - m + 1
+    if npos <= 0:
+        return bytes_, lens
+    match = jnp.ones((n, npos), dtype=bool)
+    for j in range(m):
+        match = match & (bytes_[:, j : j + npos] == ob[j])
+    pos = jnp.arange(npos, dtype=jnp.int32)[None, :]
+    match = match & (pos + m <= lens[:, None])
+    # resolve overlaps with Python's greedy left-to-right scan: a match is
+    # real iff no real match starts in the previous m-1 positions. Greedy
+    # selection is sequential — scan over columns with vectorized row state.
+    if m > 1:
+        from ..runtime.jaxcfg import lax
+
+        def step(next_ok, col_match):
+            real_col = col_match & (next_ok <= 0)
+            next_ok = jnp.where(real_col, m - 1, next_ok - 1)
+            return next_ok, real_col
+
+        init = jnp.zeros(n, dtype=jnp.int32)
+        _, real_t = lax.scan(step, init, jnp.transpose(match))
+        match = jnp.transpose(real_t)
+    # output positions: each input byte either copied or consumed; matched
+    # start produces k bytes instead of m.
+    is_start = jnp.pad(match, ((0, 0), (0, w - npos)))  # [n, w]
+    consumed = jnp.zeros((n, w), dtype=bool)
+    for j in range(m):
+        consumed = consumed | jnp.pad(is_start[:, : w - j], ((0, 0), (j, 0)))
+    inside = _pos_mask(w, lens)
+    copied = inside & ~consumed
+    # contribution of each input position to output length
+    contrib = jnp.where(is_start & inside, k, jnp.where(copied, 1, 0))
+    out_start = jnp.cumsum(contrib, axis=1) - contrib  # exclusive prefix
+    out_len = jnp.sum(contrib, axis=1).astype(jnp.int32)
+    grow = max(1, -(-k // m))  # ceil(k/m) worst-case expansion
+    wout = w * grow if k > m else w
+    out = jnp.zeros((n, wout), dtype=jnp.uint8)
+    # scatter copied bytes
+    rows = jnp.arange(n)[:, None]
+    tgt = jnp.where(copied, out_start, wout)  # park non-copied at off-end
+    out = _scatter_cols(out, rows, tgt, bytes_, wout)
+    # scatter replacement bytes
+    for j in range(k):
+        tgt_j = jnp.where(is_start & inside, out_start + j, wout)
+        src = jnp.full((n, w), nb[j], dtype=jnp.uint8)
+        out = _scatter_cols(out, rows, tgt_j, src, wout)
+    return out, out_len
+
+
+def _scatter_cols(out, rows, tgt, src, wout):
+    """out[rows, tgt] = src where tgt < wout (off-end writes dropped)."""
+    pad_out = jnp.zeros((out.shape[0], wout + 1), dtype=out.dtype)
+    pad_out = pad_out.at[:, :wout].set(out)
+    tgt_c = jnp.clip(tgt, 0, wout)
+    pad_out = pad_out.at[rows, tgt_c].set(src.astype(out.dtype), mode="drop")
+    return pad_out[:, :wout]
+
+
+def concat(a_bytes, a_lens, b_bytes, b_lens):
+    n, wa = a_bytes.shape
+    _, wb = b_bytes.shape
+    wout = wa + wb
+    out = jnp.zeros((n, wout), dtype=jnp.uint8)
+    out = out.at[:, :wa].set(a_bytes)
+    # place b at offset a_lens via gather from b with shifted index
+    pos = jnp.arange(wout, dtype=jnp.int32)[None, :]
+    b_idx = pos - a_lens[:, None]
+    valid_b = (b_idx >= 0) & (b_idx < b_lens[:, None])
+    b_gathered = jnp.take_along_axis(
+        b_bytes, jnp.clip(b_idx, 0, wb - 1), axis=1
+    )
+    out = jnp.where(valid_b, b_gathered, out)
+    # zero anything past a_lens that isn't b payload (stale a padding)
+    inside = (pos < a_lens[:, None]) | valid_b
+    out = jnp.where(inside, out, 0)
+    return out.astype(jnp.uint8), (a_lens + b_lens).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# comparisons
+# ---------------------------------------------------------------------------
+
+def _pad_common(a_bytes, b_bytes):
+    wa, wb = a_bytes.shape[1], b_bytes.shape[1]
+    w = max(wa, wb)
+    if wa < w:
+        a_bytes = jnp.pad(a_bytes, ((0, 0), (0, w - wa)))
+    if wb < w:
+        b_bytes = jnp.pad(b_bytes, ((0, 0), (0, w - wb)))
+    return a_bytes, b_bytes
+
+
+def equals(a_bytes, a_lens, b_bytes, b_lens):
+    a, b = _pad_common(a_bytes, b_bytes)
+    same = jnp.all(a == b, axis=1)  # zero padding ⇒ tails equal iff lens equal
+    return same & (a_lens == b_lens)
+
+
+def compare_lt(a_bytes, a_lens, b_bytes, b_lens, or_equal: bool = False):
+    """Lexicographic a < b (byte-wise, matching Python for ASCII)."""
+    a, b = _pad_common(a_bytes, b_bytes)
+    w = a.shape[1]
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    va = pos < a_lens[:, None]
+    vb = pos < b_lens[:, None]
+    ab = jnp.where(va, a, 0).astype(jnp.int32)
+    bb = jnp.where(vb, b, 0).astype(jnp.int32)
+    diff = ab != bb
+    big = w + 1
+    first = jnp.min(jnp.where(diff, pos, big), axis=1)
+    no_diff = first >= big
+    fa = jnp.take_along_axis(ab, jnp.clip(first, 0, w - 1)[:, None], axis=1)[:, 0]
+    fb = jnp.take_along_axis(bb, jnp.clip(first, 0, w - 1)[:, None], axis=1)[:, 0]
+    lt = jnp.where(no_diff, a_lens < b_lens, fa < fb)
+    if or_equal:
+        return lt | (no_diff & (a_lens == b_lens))
+    return lt
+
+
+# ---------------------------------------------------------------------------
+# parse / format
+# ---------------------------------------------------------------------------
+
+def parse_i64(bytes_, lens):
+    """int(s) semantics: optional surrounding spaces, optional sign, digits.
+    Returns (val int64 [N], err bool [N])."""
+    sb, sl = strip(bytes_, lens)
+    n, w = sb.shape
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    inside = pos < sl[:, None]
+    first = sb[:, 0] if w > 0 else jnp.zeros(n, dtype=jnp.uint8)
+    has_sign = (first == 43) | (first == 45)  # + -
+    neg = first == 45
+    digit_start = jnp.where(has_sign, 1, 0)
+    is_digit = (sb >= 48) & (sb <= 57)
+    digit_zone = inside & (pos >= digit_start[:, None])
+    # invalid if: any non-digit inside the digit zone, or no digits at all
+    bad = jnp.any(digit_zone & ~is_digit, axis=1)
+    ndigits = sl - digit_start
+    bad = bad | (ndigits <= 0)
+    d = jnp.where(digit_zone & is_digit, (sb - 48).astype(jnp.int64), 0)
+    # Horner over static width; positions past len contribute *1 each (skip)
+    val = jnp.zeros(n, dtype=jnp.int64)
+    for j in range(w):
+        in_zone = digit_zone[:, j]
+        val = jnp.where(in_zone, val * 10 + d[:, j], val)
+    val = jnp.where(neg, -val, val)
+    return val, bad
+
+
+def parse_f64(bytes_, lens):
+    """float(s): [sign] digits [.digits] [e[sign]digits]. No inf/nan literals
+    yet. Returns (val f64, err bool)."""
+    sb, sl = strip(bytes_, lens)
+    n, w = sb.shape
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    inside = pos < sl[:, None]
+    is_digit = (sb >= 48) & (sb <= 57)
+    dot = sb == 46
+    e_chr = (sb == 101) | (sb == 69)
+    sign = (sb == 43) | (sb == 45)
+    big = w + 1
+    # landmark positions
+    dot_pos = jnp.min(jnp.where(dot & inside, pos, big), axis=1)
+    e_pos = jnp.min(jnp.where(e_chr & inside, pos, big), axis=1)
+    has_dot = dot_pos < big
+    has_e = e_pos < big
+    mant_end = jnp.where(has_e, e_pos, sl)
+    first = sb[:, 0] if w > 0 else jnp.zeros(n, dtype=jnp.uint8)
+    lead_sign = (first == 43) | (first == 45)
+    neg = first == 45
+    int_start = jnp.where(lead_sign, 1, 0)
+    int_end = jnp.where(has_dot & (dot_pos < mant_end), dot_pos, mant_end)
+    frac_start = jnp.where(has_dot, dot_pos + 1, mant_end)
+    # validity: every char inside must be digit / single dot / single e / sign
+    # in legal spot
+    ok_char = is_digit | (dot & (pos == dot_pos[:, None])) | \
+        (e_chr & (pos == e_pos[:, None])) | \
+        (sign & ((pos == 0) | (pos == (e_pos + 1)[:, None])))
+    bad = jnp.any(inside & ~ok_char, axis=1)
+    n_int = int_end - int_start
+    n_frac = jnp.where(has_dot, mant_end - frac_start, 0)
+    bad = bad | ((n_int <= 0) & (n_frac <= 0)) | (sl <= 0)
+    bad = bad | (has_e & (has_dot & (dot_pos > e_pos)))
+    d = jnp.where(is_digit, (sb - 48).astype(jnp.float64), 0.0)
+    # mantissa value via Horner across [int_start, mant_end), tracking scale
+    # for frac digits
+    mant = jnp.zeros(n, dtype=jnp.float64)
+    for j in range(w):
+        in_mant = (pos[0, j] >= int_start) & (pos[0, j] < mant_end) & \
+            inside[:, j] & is_digit[:, j]
+        mant = jnp.where(in_mant, mant * 10.0 + d[:, j], mant)
+    scale = jnp.where(has_dot, (mant_end - frac_start).astype(jnp.float64), 0.0)
+    # exponent digits
+    exp_val = jnp.zeros(n, dtype=jnp.float64)
+    exp_sign_pos = e_pos + 1
+    exp_first = jnp.take_along_axis(
+        sb, jnp.clip(exp_sign_pos, 0, w - 1)[:, None], axis=1)[:, 0]
+    exp_has_sign = has_e & ((exp_first == 43) | (exp_first == 45))
+    exp_neg = has_e & (exp_first == 45)
+    exp_start = jnp.where(exp_has_sign, e_pos + 2, e_pos + 1)
+    for j in range(w):
+        in_exp = has_e & (pos[0, j] >= exp_start) & inside[:, j] & is_digit[:, j]
+        exp_val = jnp.where(in_exp, exp_val * 10.0 + d[:, j], exp_val)
+    n_exp_digits = jnp.where(has_e, sl - exp_start, 1)
+    bad = bad | (has_e & (n_exp_digits <= 0))
+    exp_val = jnp.where(exp_neg, -exp_val, exp_val)
+    val = mant * jnp.power(10.0, exp_val - scale)
+    val = jnp.where(neg, -val, val)
+    return val, bad
+
+
+_I64_MAX_DIGITS = 20  # sign + 19 digits
+
+
+def format_i64(vals, width: int = 0, pad_zero: bool = False):
+    """str(i) / '%0Nd' % i -> (bytes [N, W], lens [N])."""
+    n = vals.shape[0]
+    w = max(_I64_MAX_DIGITS, width)
+    neg = vals < 0
+    # careful: abs(i64 min) overflows; data pipelines don't hit it — clamp
+    mag = jnp.where(neg, -vals, vals).astype(jnp.uint64)
+    digits = jnp.zeros((n, w), dtype=jnp.uint8)
+    rem = mag
+    # emit digits right-aligned into scratch, then shift left
+    for j in range(w - 1, -1, -1):
+        digits = digits.at[:, j].set((rem % 10).astype(jnp.uint8) + 48)
+        rem = rem // 10
+    ndig = jnp.maximum(
+        w - jnp.sum(jnp.cumsum(digits != 48, axis=1) == 0, axis=1), 1
+    ).astype(jnp.int32)
+    if pad_zero and width > 0:
+        ndig = jnp.maximum(ndig, width - jnp.where(neg, 1, 0))
+    out_len = ndig + jnp.where(neg, 1, 0)
+    # build output: optional '-', then the last `ndig` digits
+    pos = jnp.arange(w + 1, dtype=jnp.int32)[None, :]
+    digit_idx = pos - jnp.where(neg, 1, 0)[:, None] + (w - ndig)[:, None]
+    gathered = jnp.take_along_axis(
+        jnp.pad(digits, ((0, 0), (0, 1))), jnp.clip(digit_idx, 0, w), axis=1
+    )
+    out = jnp.where(
+        (pos == 0) & neg[:, None], 45, gathered
+    )
+    inside = pos < out_len[:, None]
+    out = jnp.where(inside, out, 0)
+    return out.astype(jnp.uint8), out_len.astype(jnp.int32)
+
+
+def from_numpy_strings(values: list[str | None]):
+    """Host helper for tests."""
+    enc = [(v.encode() if v is not None else b"") for v in values]
+    w = max((len(b) for b in enc), default=1) or 1
+    mat = np.zeros((len(enc), w), dtype=np.uint8)
+    lens = np.zeros(len(enc), dtype=np.int32)
+    for i, b in enumerate(enc):
+        mat[i, : len(b)] = np.frombuffer(b, np.uint8)
+        lens[i] = len(b)
+    return jnp.asarray(mat), jnp.asarray(lens)
+
+
+def to_python_strings(bytes_, lens) -> list[str]:
+    b = np.asarray(bytes_)
+    l = np.asarray(lens)
+    return [bytes(b[i, : l[i]]).decode("utf-8", errors="replace")
+            for i in range(b.shape[0])]
+
+
+# ---------------------------------------------------------------------------
+# counting / char classes / casing extras
+# ---------------------------------------------------------------------------
+
+def count_const(bytes_, lens, needle: str):
+    """str.count with constant needle (non-overlapping, Python semantics)."""
+    n, w = bytes_.shape
+    nb = const_bytes(needle)
+    m = len(nb)
+    if m == 0:
+        return (lens + 1).astype(jnp.int64)
+    if m > w:
+        return jnp.zeros(n, dtype=jnp.int64)
+    npos = w - m + 1
+    match = jnp.ones((n, npos), dtype=bool)
+    for j in range(m):
+        match = match & (bytes_[:, j : j + npos] == nb[j])
+    pos = jnp.arange(npos, dtype=jnp.int32)[None, :]
+    match = match & (pos + m <= lens[:, None])
+    if m > 1:
+        from ..runtime.jaxcfg import lax
+
+        def step(next_ok, col_match):
+            real_col = col_match & (next_ok <= 0)
+            next_ok = jnp.where(real_col, m - 1, next_ok - 1)
+            return next_ok, real_col
+
+        _, real_t = lax.scan(step, jnp.zeros(n, dtype=jnp.int32),
+                             jnp.transpose(match))
+        match = jnp.transpose(real_t)
+    return jnp.sum(match, axis=1).astype(jnp.int64)
+
+
+def char_class_all(bytes_, lens, kind: str):
+    """isdigit/isdecimal/isalpha/isalnum/isspace — ASCII semantics, all chars
+    in class AND non-empty."""
+    is_digit = (bytes_ >= 48) & (bytes_ <= 57)
+    is_alpha = ((bytes_ >= 65) & (bytes_ <= 90)) | \
+        ((bytes_ >= 97) & (bytes_ <= 122))
+    if kind in ("isdigit", "isdecimal"):
+        cls = is_digit
+    elif kind == "isalpha":
+        cls = is_alpha
+    elif kind == "isalnum":
+        cls = is_digit | is_alpha
+    elif kind == "isspace":
+        cls = _is_space(bytes_)
+    else:
+        raise ValueError(kind)
+    inside = _pos_mask(bytes_.shape[1], lens)
+    return jnp.all(cls | ~inside, axis=1) & (lens > 0)
+
+
+def capitalize(bytes_, lens):
+    """First char upper, rest lower."""
+    lb, ll = lower(bytes_, lens)
+    first = lb[:, 0:1]
+    is_lo = (first >= 97) & (first <= 122)
+    ub = jnp.where(is_lo, first - 32, first)
+    out = jnp.concatenate([ub, lb[:, 1:]], axis=1)
+    return out.astype(jnp.uint8), ll
+
+
+def title(bytes_, lens):
+    """str.title: uppercase letters starting a word (after non-alpha)."""
+    n, w = bytes_.shape
+    is_alpha = ((bytes_ >= 65) & (bytes_ <= 90)) | \
+        ((bytes_ >= 97) & (bytes_ <= 122))
+    prev_alpha = jnp.pad(is_alpha[:, :-1], ((0, 0), (1, 0)))
+    starts = is_alpha & ~prev_alpha
+    lb, _ = lower(bytes_, lens)
+    ub, _ = upper(bytes_, lens)
+    return jnp.where(starts, ub, lb).astype(jnp.uint8), lens
+
+
+def zfill(bytes_, lens, width: int):
+    """str.zfill(width): left-pad digits with '0' after any sign."""
+    n, w = bytes_.shape
+    wout = max(w, width)
+    first = bytes_[:, 0] if w else jnp.zeros(n, jnp.uint8)
+    has_sign = ((first == 43) | (first == 45)) & (lens > 0)
+    out_len = jnp.maximum(lens, width)
+    nzeros = out_len - lens
+    pos = jnp.arange(wout, dtype=jnp.int32)[None, :]
+    sign_col = (pos == 0) & has_sign[:, None]
+    # source index into original string for each output position
+    body_start = jnp.where(has_sign, 1, 0)
+    src_idx = pos - nzeros[:, None]
+    src_idx = jnp.where(sign_col, 0, jnp.where(
+        pos < (body_start + nzeros)[:, None], -1, src_idx))
+    is_zero = (src_idx < 0) & ~sign_col & (pos < out_len[:, None])
+    gathered = jnp.take_along_axis(
+        jnp.pad(bytes_, ((0, 0), (0, max(0, wout - w + 1)))),
+        jnp.clip(src_idx, 0, w), axis=1)[:, :wout]
+    out = jnp.where(sign_col, first[:, None], jnp.where(is_zero, 48, gathered))
+    inside = pos < out_len[:, None]
+    out = jnp.where(inside, out, 0)
+    return out.astype(jnp.uint8), out_len.astype(jnp.int32)
+
+
+def pad_left(bytes_, lens, width: int, fillchar: str = " "):
+    """Right-align into a field of `width` (str.rjust / '%Nd' space pad)."""
+    n, w = bytes_.shape
+    wout = max(w, width)
+    fill = const_bytes(fillchar)[0]
+    out_len = jnp.maximum(lens, width)
+    shift = out_len - lens
+    pos = jnp.arange(wout, dtype=jnp.int32)[None, :]
+    src_idx = pos - shift[:, None]
+    in_pad = (src_idx < 0) & (pos < out_len[:, None])
+    padded_src = jnp.pad(bytes_, ((0, 0), (0, max(0, wout - w + 1))))
+    gathered = jnp.take_along_axis(padded_src, jnp.clip(src_idx, 0, w), axis=1)[:, :wout]
+    out = jnp.where(in_pad, fill, gathered)
+    inside = pos < out_len[:, None]
+    return jnp.where(inside, out, 0).astype(jnp.uint8), out_len.astype(jnp.int32)
+
+
+def non_ascii_rows(bytes_, lens):
+    """[N] bool — rows containing any non-ASCII byte inside their length.
+    Index-space string ops (len, find, slicing) operate on UTF-8 BYTES; for
+    multibyte rows that diverges from Python's codepoint semantics, so those
+    rows must take the interpreter path (normal-case violation)."""
+    inside = _pos_mask(bytes_.shape[1], lens)
+    return jnp.any(inside & (bytes_ >= 128), axis=1)

@@ -1,0 +1,344 @@
+"""Logical operator DAG.
+
+Re-designs the reference's logical layer (reference: core/src/logical/ — one
+class per operator with output-schema inference and sampling,
+LogicalOperator.cc:39-50 compute()). Schema inference here IS the sample
+tracer: operators run their UDF on the parent's sample rows via CPython
+(reference: TraceVisitor semantics — execute on sample to annotate types,
+core/include/TraceVisitor.h:25-80) and speculate the normal-case output type.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Optional, Sequence
+
+from ..core import typesys as T
+from ..core.errors import TuplexException
+from ..core.row import Row
+from ..utils.reflection import UDFSource, get_udf_source
+
+_op_ids = itertools.count(1)
+
+
+def apply_udf_python(udf: UDFSource, row: Row) -> Any:
+    """Interpreter-path calling convention shared by sampling and the
+    fallback pipeline (reference: PythonPipelineBuilder's generated Row class,
+    core/src/physical/PythonPipelineBuilder.cc:1-60)."""
+    f = udf.func
+    nparams = len(udf.params) if udf.params else 1
+    if nparams > 1 and len(row.values) == nparams:
+        return f(*row.values)
+    if row.columns is not None:
+        return f(row)
+    if len(row.values) == 1:
+        return f(row.values[0])
+    return f(tuple(row.values))
+
+
+class LogicalOperator:
+    """Base: parent links + output schema + sample rows."""
+
+    def __init__(self, parents: Sequence["LogicalOperator"]):
+        self.id = next(_op_ids)
+        self.parents = list(parents)
+        self.name = type(self).__name__.replace("Operator", "").lower()
+
+    @property
+    def parent(self) -> "LogicalOperator":
+        return self.parents[0]
+
+    # -- overridables --------------------------------------------------------
+    def schema(self) -> T.RowType:
+        raise NotImplementedError
+
+    def columns(self) -> Optional[tuple[str, ...]]:
+        from ..runtime.columns import user_columns
+
+        return user_columns(self.schema())
+
+    def sample(self) -> list[Row]:
+        raise NotImplementedError
+
+    def is_breaker(self) -> bool:
+        """Pipeline breaker => stage boundary (reference:
+        PhysicalPlan.cc:60-238 — joins/aggregates end stages)."""
+        return False
+
+    def __repr__(self):
+        return f"{type(self).__name__}(#{self.id})"
+
+
+class ParallelizeOperator(LogicalOperator):
+    """In-memory input (reference: core/src/logical/ParallelizeOperator.cc)."""
+
+    def __init__(self, data: list, schema: T.RowType, sample_size: int = 256):
+        super().__init__([])
+        self.data = data
+        self._schema = schema
+        self._sample_size = sample_size
+
+    def schema(self) -> T.RowType:
+        return self._schema
+
+    def sample(self) -> list[Row]:
+        from ..runtime.columns import user_columns
+
+        cols = user_columns(self._schema)
+        return [Row.from_value(v, cols) for v in self.data[: self._sample_size]]
+
+
+class UDFOperator(LogicalOperator):
+    """Base for operators carrying a UDF (reference: logical/UDFOperator.cc)."""
+
+    def __init__(self, parent: LogicalOperator, func: Callable):
+        super().__init__([parent])
+        self.udf = get_udf_source(func)
+        self._schema_cache: Optional[T.RowType] = None
+
+    def schema(self) -> T.RowType:
+        if self._schema_cache is None:
+            self._schema_cache = self._infer_schema()
+        return self._schema_cache
+
+    def _infer_schema(self) -> T.RowType:
+        raise NotImplementedError
+
+
+class MapOperator(UDFOperator):
+    def _infer_schema(self) -> T.RowType:
+        outs = []
+        for r in self.parent.sample():
+            try:
+                outs.append(apply_udf_python(self.udf, r))
+            except Exception:
+                pass
+        if not outs:
+            # UDF failed on EVERY sample row: job still runs, all rows become
+            # exception rows (schema degrades to pyobject)
+            return T.row_of(["_0"], [T.PYOBJECT])
+        if all(isinstance(o, tuple) for o in outs) and outs and \
+                len({len(o) for o in outs}) == 1:
+            k = len(outs[0])
+            types = [T.normal_case_type([o[i] for o in outs])[0]
+                     for i in range(k)]
+            return T.row_of([f"_{i}" for i in range(k)], types)
+        # dict results keep column names (reference: map with dict output)
+        if all(isinstance(o, dict) for o in outs) and outs:
+            keys = list(outs[0].keys())
+            if all(list(o.keys()) == keys for o in outs):
+                types = [T.normal_case_type([o[k] for o in outs])[0]
+                         for k in keys]
+                return T.row_of(keys, types)
+        nc, _, _ = T.normal_case_type(outs)
+        return T.row_of(["_0"], [nc])
+
+    def sample(self) -> list[Row]:
+        out = []
+        cols = self.columns()
+        for r in self.parent.sample():
+            try:
+                v = apply_udf_python(self.udf, r)
+            except Exception:
+                continue
+            if isinstance(v, dict):
+                out.append(Row(list(v.values()), list(v.keys())))
+            else:
+                out.append(Row.from_value(v, cols))
+        return out
+
+
+class FilterOperator(UDFOperator):
+    def _infer_schema(self) -> T.RowType:
+        return self.parent.schema()
+
+    def columns(self):
+        return self.parent.columns()
+
+    def sample(self) -> list[Row]:
+        out = []
+        for r in self.parent.sample():
+            try:
+                if apply_udf_python(self.udf, r):
+                    out.append(r)
+            except Exception:
+                pass
+        return out
+
+
+class WithColumnOperator(UDFOperator):
+    """Adds or replaces a named column (reference: logical/WithColumnOperator.cc)."""
+
+    def __init__(self, parent: LogicalOperator, column: str, func: Callable):
+        self.column = column
+        super().__init__(parent, func)
+
+    def _infer_schema(self) -> T.RowType:
+        from ..runtime.columns import user_columns
+
+        ps = self.parent.schema()
+        if user_columns(ps) is None:
+            raise TuplexException("withColumn requires named columns")
+        outs = []
+        for r in self.parent.sample():
+            try:
+                outs.append(apply_udf_python(self.udf, r))
+            except Exception:
+                pass
+        nc = T.PYOBJECT if not outs else T.normal_case_type(outs)[0]
+        cols = list(ps.columns)
+        types = list(ps.types)
+        if self.column in cols:
+            types[cols.index(self.column)] = nc
+        else:
+            cols.append(self.column)
+            types.append(nc)
+        return T.row_of(cols, types)
+
+    def sample(self) -> list[Row]:
+        schema = self.schema()
+        out = []
+        for r in self.parent.sample():
+            try:
+                v = apply_udf_python(self.udf, r)
+            except Exception:
+                continue
+            d = dict(zip(r.columns, r.values))
+            d[self.column] = v
+            out.append(Row([d[c] for c in schema.columns], schema.columns))
+        return out
+
+
+class MapColumnOperator(UDFOperator):
+    """UDF over ONE column's value (reference: logical/MapColumnOperator.cc)."""
+
+    def __init__(self, parent: LogicalOperator, column: str, func: Callable):
+        self.column = column
+        super().__init__(parent, func)
+
+    def _infer_schema(self) -> T.RowType:
+        ps = self.parent.schema()
+        if self.column not in (ps.columns or ()):
+            raise TuplexException(f"unknown column {self.column!r}")
+        ci = ps.columns.index(self.column)
+        outs = []
+        for r in self.parent.sample():
+            try:
+                outs.append(self.udf.func(r.values[ci]))
+            except Exception:
+                pass
+        nc = T.PYOBJECT if not outs else T.normal_case_type(outs)[0]
+        types = list(ps.types)
+        types[ci] = nc
+        return T.row_of(ps.columns, types)
+
+    def sample(self) -> list[Row]:
+        ps = self.parent.schema()
+        ci = ps.columns.index(self.column)
+        out = []
+        for r in self.parent.sample():
+            try:
+                v = self.udf.func(r.values[ci])
+            except Exception:
+                continue
+            vals = list(r.values)
+            vals[ci] = v
+            out.append(Row(vals, r.columns))
+        return out
+
+
+class SelectColumnsOperator(LogicalOperator):
+    def __init__(self, parent: LogicalOperator, columns: Sequence):
+        super().__init__([parent])
+        self.selected = list(columns)
+
+    def _resolve_indices(self) -> list[int]:
+        ps = self.parent.schema()
+        idx = []
+        for c in self.selected:
+            if isinstance(c, int):
+                idx.append(c if c >= 0 else len(ps.types) + c)
+            else:
+                if c not in ps.columns:
+                    raise TuplexException(f"unknown column {c!r}")
+                idx.append(ps.columns.index(c))
+        return idx
+
+    def schema(self) -> T.RowType:
+        ps = self.parent.schema()
+        idx = self._resolve_indices()
+        return T.row_of([ps.columns[i] for i in idx],
+                        [ps.types[i] for i in idx])
+
+    def sample(self) -> list[Row]:
+        idx = self._resolve_indices()
+        s = self.schema()
+        return [Row([r.values[i] for i in idx], s.columns)
+                for r in self.parent.sample()]
+
+
+class RenameColumnOperator(LogicalOperator):
+    def __init__(self, parent: LogicalOperator, old, new: str):
+        super().__init__([parent])
+        self.old = old
+        self.new = new
+
+    def schema(self) -> T.RowType:
+        ps = self.parent.schema()
+        if isinstance(self.old, int):
+            i = self.old
+        else:
+            if self.old not in (ps.columns or ()):
+                raise TuplexException(f"unknown column {self.old!r}")
+            i = ps.columns.index(self.old)
+        cols = list(ps.columns)
+        cols[i] = self.new
+        return T.row_of(cols, ps.types)
+
+    def sample(self) -> list[Row]:
+        s = self.schema()
+        return [Row(r.values, s.columns) for r in self.parent.sample()]
+
+
+class ResolveOperator(LogicalOperator):
+    """Attaches an exception resolver to the previous operator (reference:
+    logical/ResolveOperator.cc; dataset.py:162)."""
+
+    def __init__(self, parent: LogicalOperator, exc_class: type, func: Callable):
+        super().__init__([parent])
+        self.exc_class = exc_class
+        self.udf = get_udf_source(func)
+
+    def schema(self) -> T.RowType:
+        return self.parent.schema()
+
+    def sample(self) -> list[Row]:
+        return self.parent.sample()
+
+
+class IgnoreOperator(LogicalOperator):
+    """Silently drops rows raising exc_class at the previous operator
+    (reference: logical/IgnoreOperator.cc; dataset.py:319)."""
+
+    def __init__(self, parent: LogicalOperator, exc_class: type):
+        super().__init__([parent])
+        self.exc_class = exc_class
+
+    def schema(self) -> T.RowType:
+        return self.parent.schema()
+
+    def sample(self) -> list[Row]:
+        return self.parent.sample()
+
+
+class TakeOperator(LogicalOperator):
+    def __init__(self, parent: LogicalOperator, limit: int):
+        super().__init__([parent])
+        self.limit = limit
+
+    def schema(self) -> T.RowType:
+        return self.parent.schema()
+
+    def sample(self) -> list[Row]:
+        s = self.parent.sample()
+        return s if self.limit < 0 else s[: self.limit]

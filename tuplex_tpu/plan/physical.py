@@ -1,0 +1,157 @@
+"""Physical planning: stage splitting + fused stage functions.
+
+Re-designs the reference's physical layer (reference:
+core/src/physical/PhysicalPlan.cc:60-238 — split DAG into stages at pipeline
+breakers; StageBuilder.cc — fuse the stage's operators into one compiled
+function). Here a TransformStage compiles to ONE jax function over a staged
+column batch: every fused operator contributes ops to the same trace, so XLA
+sees the whole pipeline and fuses it into a handful of kernels (the TPU analog
+of the reference's single LLVM row-loop).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Callable, Optional
+
+from ..compiler.emitter import EmitCtx, Emitter, Frame
+from ..compiler.stagefn import input_row_cv, result_arrays
+from ..compiler.values import CV, tuple_cv
+from ..core import typesys as T
+from ..core.errors import NotCompilable
+from ..runtime.jaxcfg import jnp
+from . import logical as L
+
+
+class TransformStage:
+    """A fused chain of row operators over one input source.
+
+    `ops` excludes the source; Resolve/Ignore operators ride along for the
+    host resolve path but emit nothing on device (reference: slow-path-only
+    resolvers, StageBuilder.cc generateResolveCodePath).
+    """
+
+    def __init__(self, source: L.LogicalOperator, ops: list[L.LogicalOperator],
+                 limit: int = -1):
+        self.source = source
+        self.ops = ops
+        self.limit = limit
+        self.input_schema = source.schema()
+        self.output_schema = ops[-1].schema() if ops else source.schema()
+        out_cols = (ops[-1] if ops else source).columns()
+        self.output_columns = out_cols
+
+    def key(self) -> str:
+        """Cache key for the jit'd executable: operator chain + UDF sources +
+        captured globals + input schema (specialization contract of the
+        emitter)."""
+        h = hashlib.sha256()
+        h.update(self.input_schema.name.encode())
+        for op in self.ops:
+            h.update(type(op).__name__.encode())
+            udf = getattr(op, "udf", None)
+            if udf is not None:
+                h.update(udf.source.encode())
+                for k in sorted(udf.globals):
+                    h.update(f"{k}={udf.globals[k]!r}".encode())
+            for attr in ("column", "selected", "old", "new"):
+                if hasattr(op, attr):
+                    h.update(repr(getattr(op, attr)).encode())
+        return h.hexdigest()[:16]
+
+    # ------------------------------------------------------------------
+    def build_device_fn(self) -> Callable:
+        """The fused fast-path function: staged arrays -> output arrays +
+        '#err' + '#keep'. Raises NotCompilable if any fused UDF can't compile
+        (the backend then interprets every row)."""
+        schema = self.input_schema
+        ops = [op for op in self.ops
+               if not isinstance(op, (L.ResolveOperator, L.IgnoreOperator,
+                                      L.TakeOperator))]
+        out_schema = self.output_schema
+
+        def fn(arrays: dict):
+            b = arrays["#rowvalid"].shape[0]
+            ctx = EmitCtx(b, arrays["#rowvalid"])
+            keep = arrays["#rowvalid"]
+            row = input_row_cv(arrays, schema)
+            from ..runtime.columns import user_columns
+
+            names = user_columns(schema)
+            for op in ops:
+                row, keep, names = _emit_op(ctx, op, row, keep, names)
+            outs, out_t = result_arrays(row, b)
+            outs = dict(outs)
+            outs["#err"] = ctx.err
+            outs["#keep"] = keep & (ctx.err == 0)
+            return outs
+
+        return fn
+
+
+def _emit_op(ctx: EmitCtx, op: L.LogicalOperator, row: CV, keep,
+             names: Optional[tuple]):
+    em = Emitter(ctx, getattr(op, "udf", None).globals
+                 if getattr(op, "udf", None) else {})
+    frame = Frame(em, {})
+    if isinstance(op, L.MapOperator):
+        res = em.eval_udf(op.udf, [row])
+        out_cols = op.columns()
+        if res.elts is not None and out_cols and len(out_cols) == len(res.elts):
+            res = tuple_cv(res.elts, names=out_cols, valid=res.valid)
+            return res, keep, out_cols
+        return res, keep, None
+    if isinstance(op, L.FilterOperator):
+        pred = em.eval_udf(op.udf, [row])
+        tr = frame.truthy(pred)
+        keep = keep & tr
+        ctx.active = ctx.active & tr   # errors past a filter never fire
+        return row, keep, names
+    if isinstance(op, L.WithColumnOperator):
+        if row.elts is None or names is None:
+            raise NotCompilable("withColumn on unnamed row")
+        val = em.eval_udf(op.udf, [row])
+        elts = list(row.elts)
+        nm = list(names)
+        if op.column in nm:
+            elts[nm.index(op.column)] = val
+        else:
+            elts.append(val)
+            nm.append(op.column)
+        return tuple_cv(elts, names=nm), keep, tuple(nm)
+    if isinstance(op, L.MapColumnOperator):
+        if row.elts is None or names is None:
+            raise NotCompilable("mapColumn on unnamed row")
+        ci = list(names).index(op.column)
+        val = em.eval_udf(op.udf, [row.elts[ci]])
+        elts = list(row.elts)
+        elts[ci] = val
+        return tuple_cv(elts, names=names), keep, names
+    if isinstance(op, L.SelectColumnsOperator):
+        if row.elts is None:
+            raise NotCompilable("selectColumns on unnamed row")
+        idx = op._resolve_indices()
+        nm = tuple(op.schema().columns)
+        return tuple_cv([row.elts[i] for i in idx], names=nm), keep, nm
+    if isinstance(op, L.RenameColumnOperator):
+        nm = tuple(op.schema().columns)
+        if row.elts is not None:
+            return tuple_cv(row.elts, names=nm, valid=row.valid), keep, nm
+        return row, keep, nm
+    raise NotCompilable(f"operator {type(op).__name__} not fusable")
+
+
+def plan_stages(sink: L.LogicalOperator) -> list[TransformStage]:
+    """Walk the DAG sink→source splitting at breakers (single linear chain
+    until joins/aggregates land)."""
+    chain: list[L.LogicalOperator] = []
+    limit = -1
+    node = sink
+    while node.parents:
+        if isinstance(node, L.TakeOperator):
+            limit = node.limit
+        else:
+            chain.append(node)
+        node = node.parent
+    chain.reverse()
+    return [TransformStage(node, chain, limit)]

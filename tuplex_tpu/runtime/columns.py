@@ -73,6 +73,15 @@ def columnar_supported(t: T.Type) -> bool:
     return all(lt is not T.PYOBJECT for _, lt in flatten_type(t))
 
 
+def user_columns(schema: T.RowType):
+    """Auto-generated names are '_0', '_1', ... — a schema made only of them
+    is an UNNAMED row (no dict access, UDFs get bare values/tuples)."""
+    cols = schema.columns
+    if cols and all(c == f"_{i}" for i, c in enumerate(cols)):
+        return None
+    return cols if cols else None
+
+
 # ---------------------------------------------------------------------------
 # leaf column containers (host, numpy)
 # ---------------------------------------------------------------------------
@@ -227,6 +236,11 @@ class Partition:
     def columns(self) -> tuple[str, ...]:
         return self.schema.columns
 
+    @property
+    def user_columns(self):
+        """Column names as the user sees them: None when auto-generated."""
+        return user_columns(self.schema)
+
     def n_normal(self) -> int:
         if self.normal_mask is None:
             return self.num_rows
@@ -236,12 +250,13 @@ class Partition:
     def decode_row(self, i: int) -> Row:
         """Reconstruct the boxed row at local position i (interpreter path
         input). Fallback rows return their original boxed value."""
+        cols = self.user_columns
         if i in self.fallback:
-            return Row.from_value(self.fallback[i], self.columns if self.schema.columns else None)
+            return Row.from_value(self.fallback[i], cols)
         vals = []
         for ci, ct in enumerate(self.schema.types):
             vals.append(self._decode_col(str(ci), ct, i))
-        return Row(vals, self.columns if self.columns else None)
+        return Row(vals, cols)
 
     def _decode_col(self, path: str, t: T.Type, i: int) -> Any:
         base = t.without_option() if t.is_optional() else t
@@ -305,14 +320,20 @@ def build_partition(
 
     placeholders = {p: _placeholder(lt) for p, lt in leaf_types}
 
+    def conforms(row_tuple) -> bool:
+        if not (isinstance(row_tuple, tuple) and
+                len(row_tuple) == len(schema.columns)):
+            return False
+        return all(T.python_value_conforms(rv, ct)
+                   for rv, ct in zip(row_tuple, schema.types))
+
     for i, v in enumerate(values):
         row_tuple = v if multi else (v,)
-        ok = isinstance(row_tuple, tuple) and len(row_tuple) == len(schema.columns)
-        if ok:
-            for rv, ct in zip(row_tuple, schema.types):
-                if not T.python_value_conforms(rv, ct):
-                    ok = False
-                    break
+        ok = conforms(row_tuple)
+        if not ok and not multi and isinstance(v, tuple) and len(v) == 1:
+            # single-column rows may arrive as 1-tuples (Row semantics)
+            row_tuple = v
+            ok = conforms(row_tuple)
         if not ok:
             normal_mask[i] = False
             fallback[i] = v
@@ -416,3 +437,198 @@ def stage_partition(part: Partition, bucket_mode: str = "pow2") -> DeviceBatch:
         rowvalid[:n] = part.normal_mask
     arrays["#rowvalid"] = rowvalid
     return DeviceBatch(arrays=arrays, n=n, b=b, schema=part.schema)
+
+
+# ---------------------------------------------------------------------------
+# rebuild partitions from device outputs
+# ---------------------------------------------------------------------------
+
+def schema_for_result_type(t: "T.Type", columns: Optional[Sequence[str]] = None) -> T.RowType:
+    """Row schema for a UDF/stage result type: a plain tuple spreads into
+    columns, everything else is a single column. Auto column names start with
+    '_' (the unnamed-row convention)."""
+    if isinstance(t, T.TupleType) and not t.is_optional():
+        names = tuple(columns) if columns and len(columns) == len(t.elements) \
+            else tuple(f"_{i}" for i in range(len(t.elements)))
+        return T.row_of(names, t.elements)
+    name = tuple(columns) if columns and len(columns) == 1 else ("_0",)
+    return T.row_of(name, (t,))
+
+
+def partition_from_arrays(
+    arrays: dict[str, np.ndarray],
+    schema: T.RowType,
+    n: int,
+    normal_mask: Optional[np.ndarray] = None,
+    fallback: Optional[dict[int, Any]] = None,
+    start_index: int = 0,
+) -> Partition:
+    """Inverse of stage_partition: trim padded output arrays to n rows and
+    wrap them as a Partition (leaf-path convention of flatten_type)."""
+    leaves: dict[str, Leaf] = {}
+    for ci, ct in enumerate(schema.types):
+        for path, lt in flatten_type(ct, str(ci)):
+            base = lt.without_option() if lt.is_optional() else lt
+            opt = lt.is_optional()
+            valid = arrays.get(path + "#valid")
+            valid = None if valid is None else np.asarray(valid[:n], dtype=np.bool_)
+            if path.endswith("#opt"):
+                leaves[path] = NumericLeaf(np.asarray(arrays[path][:n], dtype=np.bool_))
+                continue
+            if base is T.STR:
+                leaves[path] = StrLeaf(
+                    np.asarray(arrays[path + "#bytes"][:n], dtype=np.uint8),
+                    np.asarray(arrays[path + "#len"][:n], dtype=np.int32),
+                    valid,
+                )
+            elif base is T.NULL:
+                leaves[path] = NullLeaf(n)
+            elif base is T.EMPTYTUPLE:
+                if opt:
+                    leaves[path] = NumericLeaf(np.zeros(n, dtype=np.bool_), valid)
+                else:
+                    leaves[path] = NullLeaf(n)
+            elif base in LEAF_NUMERIC:
+                leaves[path] = NumericLeaf(
+                    np.asarray(arrays[path][:n], dtype=LEAF_NUMERIC[base]), valid)
+            else:
+                raise ValueError(f"cannot rebuild leaf {path}: {lt}")
+    return Partition(schema=schema, num_rows=n, leaves=leaves,
+                     normal_mask=normal_mask, fallback=dict(fallback or {}),
+                     start_index=start_index)
+
+
+def type_from_result_arrays(arrays: dict, path: str) -> Optional[T.Type]:
+    """Reconstruct a leaf/column type from device-output array keys: the key
+    suffix convention + dtypes fully determine the type, so the rebuilt
+    partition always matches what the trace ACTUALLY produced (never the
+    sample-speculated schema)."""
+    # fast existence probe: nothing under this path => no such column
+    if not any(k == path or k.startswith(path + "#") or
+               k.startswith(path + ".") for k in arrays):
+        return None
+    opt = (path + "#valid") in arrays or (path + "#opt") in arrays
+    if (path + "#bytes") in arrays:
+        return T.option(T.STR) if opt else T.STR
+    if (path + "#null") in arrays:
+        return T.NULL
+    if (path + "#unit") in arrays:
+        return T.option(T.EMPTYTUPLE) if opt else T.EMPTYTUPLE
+    if path in arrays:
+        dt = np.asarray(arrays[path]).dtype
+        if dt == np.bool_:
+            base = T.BOOL
+        elif np.issubdtype(dt, np.integer):
+            base = T.I64
+        else:
+            base = T.F64
+        return T.option(base) if opt else base
+    # tuple: children at path.0, path.1, ...
+    elts = []
+    i = 0
+    while True:
+        sub = f"{path}.{i}" if path else str(i)
+        et = type_from_result_arrays(arrays, sub)
+        if et is None:
+            break
+        elts.append(et)
+        i += 1
+    if not elts:
+        return None
+    tt = T.tuple_of(*[e.without_option() if opt and e.is_optional() else e
+                      for e in elts]) if opt else T.tuple_of(*elts)
+    return T.option(tt) if opt else tt
+
+
+def partition_from_result_arrays(
+    arrays: dict[str, np.ndarray],
+    n: int,
+    columns: Optional[Sequence[str]] = None,
+    start_index: int = 0,
+) -> Partition:
+    """Build a Partition directly from stage-output arrays (cv_output_arrays
+    key convention), deriving the schema from the arrays themselves."""
+    col_types = []
+    ci = 0
+    while True:
+        t = type_from_result_arrays(arrays, str(ci))
+        if t is None:
+            break
+        col_types.append(t)
+        ci += 1
+    if not col_types:
+        raise ValueError("no columns found in result arrays")
+    names = tuple(columns) if columns and len(columns) == len(col_types) \
+        else tuple(f"_{i}" for i in range(len(col_types)))
+    schema = T.row_of(names, col_types)
+
+    leaves: dict[str, Leaf] = {}
+    for ci, ct in enumerate(col_types):
+        for path, lt in flatten_type(ct, str(ci)):
+            base = lt.without_option() if lt.is_optional() else lt
+            opt = lt.is_optional()
+            if path.endswith("#opt"):
+                leaves[path] = NumericLeaf(
+                    np.asarray(arrays[path][:n], dtype=np.bool_))
+                continue
+            valid = arrays.get(path + "#valid")
+            if valid is None and opt and (path + "#opt") in arrays:
+                valid = arrays[path + "#opt"]
+            valid = None if valid is None else \
+                np.asarray(valid[:n], dtype=np.bool_)
+            if base is T.STR:
+                leaves[path] = StrLeaf(
+                    np.asarray(arrays[path + "#bytes"][:n], dtype=np.uint8),
+                    np.asarray(arrays[path + "#len"][:n], dtype=np.int32),
+                    valid)
+            elif base is T.NULL:
+                leaves[path] = NullLeaf(n)
+            elif base is T.EMPTYTUPLE:
+                if opt:
+                    leaves[path] = NumericLeaf(
+                        np.zeros(n, dtype=np.bool_),
+                        valid if valid is not None
+                        else np.ones(n, dtype=np.bool_))
+                else:
+                    leaves[path] = NullLeaf(n)
+            else:
+                leaves[path] = NumericLeaf(
+                    np.asarray(arrays[path][:n], dtype=LEAF_NUMERIC[base]),
+                    valid)
+    return Partition(schema=schema, num_rows=n, leaves=leaves,
+                     start_index=start_index)
+
+
+def gather_partition(part: Partition, out_positions: np.ndarray,
+                     src_indices: np.ndarray, m: int) -> Partition:
+    """New m-row partition with rows src_indices placed at out_positions
+    (other slots zero placeholders, to be filled by resolved rows)."""
+    leaves: dict[str, Leaf] = {}
+    for path, leaf in part.leaves.items():
+        if isinstance(leaf, NumericLeaf):
+            data = np.zeros(m, dtype=leaf.data.dtype)
+            valid = None if leaf.valid is None else np.zeros(m, np.bool_)
+            if len(src_indices):
+                data[out_positions] = leaf.data[src_indices]
+                if valid is not None:
+                    valid[out_positions] = leaf.valid[src_indices]
+            leaves[path] = NumericLeaf(data, valid)
+        elif isinstance(leaf, StrLeaf):
+            b = np.zeros((m, max(leaf.width, 1)), dtype=np.uint8)
+            ln = np.zeros(m, dtype=np.int32)
+            valid = None if leaf.valid is None else np.zeros(m, np.bool_)
+            if len(src_indices):
+                b[out_positions] = leaf.bytes[src_indices]
+                ln[out_positions] = leaf.lengths[src_indices]
+                if valid is not None:
+                    valid[out_positions] = leaf.valid[src_indices]
+            leaves[path] = StrLeaf(b, ln, valid)
+        elif isinstance(leaf, NullLeaf):
+            leaves[path] = NullLeaf(m)
+        else:
+            vals: list = [None] * m
+            for o, s in zip(out_positions.tolist(), src_indices.tolist()):
+                vals[o] = leaf.values[s]
+            leaves[path] = ObjectLeaf(vals)
+    return Partition(schema=part.schema, num_rows=m, leaves=leaves,
+                     start_index=part.start_index)

@@ -1,0 +1,16 @@
+"""Central jax import + config. Import jax ONLY through here inside the
+framework so x64 is enabled before any trace happens.
+
+Python ints are i64 in the reference's type system (TypeSystem.h); on TPU
+i64 is emulated but the hot arithmetic is mostly i32-safe — the emitter
+narrows where value ranges allow (future work, tuplex.tpu.* options).
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+__all__ = ["jax", "jnp", "lax"]
