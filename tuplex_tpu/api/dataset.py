@@ -152,11 +152,9 @@ class DataSet:
         partitions = None
         all_exceptions = []
         for stage in stages:
-            partitions = stage.input_partitions(self._context) \
-                if hasattr(stage, "input_partitions") else partitions
-            if partitions is None:
+            if getattr(stage, "source", None) is not None:
                 partitions = _source_partitions(self._context, stage)
-            result = backend.execute(stage, partitions)
+            result = backend.execute_any(stage, partitions, self._context)
             partitions = result.partitions
             all_exceptions.extend(result.exceptions)
             self._context.metrics.record_stage(result.metrics)
@@ -172,19 +170,19 @@ class DataSet:
 
 def _source_partitions(context, stage):
     """Materialize the stage source into columnar partitions."""
+    from ..runtime import columns as C
+
     src = stage.source
     if isinstance(src, L.ParallelizeOperator):
-        from ..runtime import columns as C
-
         schema = src.schema()
         part_rows = _rows_per_partition(context, schema, len(src.data))
         parts = []
         for off in range(0, len(src.data), part_rows):
             chunk = src.data[off: off + part_rows]
             parts.append(C.build_partition(chunk, schema, start_index=off))
-        return parts
+        return C.harmonize_partitions(parts)
     if hasattr(src, "load_partitions"):
-        return src.load_partitions(context)
+        return C.harmonize_partitions(src.load_partitions(context))
     raise TuplexException(f"unknown source {src!r}")
 
 

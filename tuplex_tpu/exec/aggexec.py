@@ -1,0 +1,355 @@
+"""Aggregate stage execution: vectorized folds + segment reductions.
+
+The device path exploits the associative-combine contract the reference
+imposes on user aggregates (reference: AggregateFunctions.cc agg_combine_f
+is required to be associative for thread-parallel aggregation;
+LocalBackend.cc:2219 createFinalHashmap merges per-task tables). Here:
+
+  per-partition: recognized fold exprs evaluate as whole columns on device
+  (Emitter trace) and reduce via jnp.sum / segment_sum — per-device partials
+  then combine on host (tiny), or via psum over a mesh (parallel backend).
+
+Rows that error during expr evaluation (plus boxed fallback rows) fold on the
+interpreter exactly like other dual-mode work.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ..compiler.emitter import EmitCtx, Emitter, Frame
+from ..core import typesys as T
+from ..core.errors import NotCompilable
+from ..core.row import Row
+from ..plan import aggregates as A
+from ..plan import logical as L
+from ..runtime import columns as C
+from .local import ExceptionRecord
+
+
+_SUM_IDENT = 0
+_BIG = (1 << 62)
+
+
+def _identity(reducer: str, is_float: bool):
+    if reducer == "sum":
+        return 0.0 if is_float else 0
+    if reducer == "min":
+        return float("inf") if is_float else _BIG
+    return float("-inf") if is_float else -_BIG
+
+
+def _combine_scalar(reducer: str, a, b):
+    if reducer == "sum":
+        return a + b
+    if reducer == "min":
+        return min(a, b)
+    return max(a, b)
+
+
+class AggregateExecutor:
+    def __init__(self, backend):
+        self.backend = backend
+
+    # ==================================================================
+    def execute(self, stage, partitions: list[C.Partition]):
+        from .local import StageResult
+
+        op = stage.op
+        t0 = time.perf_counter()
+        if isinstance(op, A.UniqueOperator):
+            parts, excs = self._unique(op, partitions)
+        elif isinstance(op, A.AggregateByKeyOperator):
+            parts, excs = self._aggregate(op, partitions, by_key=True)
+        elif isinstance(op, A.AggregateOperator):
+            parts, excs = self._aggregate(op, partitions, by_key=False)
+        else:
+            raise NotCompilable(f"aggregate stage op {op!r}")
+        m = {"wall_s": time.perf_counter() - t0,
+             "rows_out": sum(p.num_rows for p in parts),
+             "exception_rows": len(excs)}
+        return StageResult(parts, excs, m)
+
+    # ==================================================================
+    def _unique(self, op, partitions):
+        """Distinct rows, first-occurrence order. Vectorized per partition
+        via structured-view np.unique; cross-partition merge via host set."""
+        seen: set = set()
+        out_rows: list = []
+        cols = None
+        for part in partitions:
+            cols = part.user_columns
+            sig = _row_signatures(part)
+            for i in range(part.num_rows):
+                key = sig[i] if sig is not None else None
+                if key is None or i in part.fallback:
+                    row = part.decode_row(i)
+                    try:
+                        key = tuple(row.values)
+                    except TypeError:
+                        out_rows.append(row)
+                        continue
+                if key in seen:
+                    continue
+                seen.add(key)
+                out_rows.append(part.decode_row(i))
+        schema = op.schema()
+        values = [r.unwrap() if len(schema.columns) == 1 else tuple(r.values)
+                  for r in out_rows]
+        if not values:
+            return [], []
+        return [C.build_partition(values, schema)], []
+
+    # ==================================================================
+    def _aggregate(self, op, partitions, by_key: bool):
+        spec = A.recognize_fold(op.aggregate_udf)
+        excs: list[ExceptionRecord] = []
+        ps = partitions[0].schema if partitions else None
+
+        if by_key:
+            kidx = [ps.columns.index(c) for c in op.key_columns] if ps else []
+            groups: dict = {}
+            for part in partitions:
+                device_ok = spec is not None and self._device_fold_bykey(
+                    op, spec, part, kidx, groups, excs)
+                if not device_ok:
+                    self._python_fold(op, part, range(part.num_rows),
+                                      groups, kidx, excs)
+            out_schema = op.schema()
+            values = []
+            for k, acc in groups.items():
+                accs = acc if isinstance(acc, tuple) else (acc,)
+                values.append(tuple(k) + tuple(accs))
+            if not values:
+                return [], excs
+            return [C.build_partition(values, out_schema)], excs
+
+        # whole-dataset aggregate
+        acc_holder = {"acc": op.initial, "started": False}
+
+        def merge_partial(partial):
+            # partial is a raw reduction (identity-seeded); merge via the
+            # recognized reducers
+            accs = list(acc_holder["acc"]) if isinstance(
+                acc_holder["acc"], tuple) else [acc_holder["acc"]]
+            parts_ = list(partial) if isinstance(partial, tuple) else [partial]
+            merged = [_combine_scalar(r, a, p)
+                      for r, a, p in zip(spec.reducers, accs, parts_)]
+            acc_holder["acc"] = tuple(merged) if isinstance(
+                acc_holder["acc"], tuple) else merged[0]
+
+        groups2: dict = {(): op.initial}
+        for part in partitions:
+            done = False
+            if spec is not None:
+                partial, bad_rows = self._device_fold(op, spec, part)
+                if partial is not None:
+                    merge_partial(partial)
+                    self._python_fold(op, part, bad_rows, groups2, [], excs,
+                                      into_key=())
+                    done = True
+            if not done:
+                self._python_fold(op, part, range(part.num_rows), groups2,
+                                  [], excs, into_key=())
+        # fold the python-side accumulator into the device-side one via the
+        # user combine (both are real agg values, reference: agg_combine_f)
+        py_acc = groups2[()]
+        if spec is not None:
+            if py_acc != op.initial:
+                acc_holder["acc"] = op.combine_udf.func(
+                    acc_holder["acc"], py_acc)
+            final = acc_holder["acc"]
+        else:
+            final = py_acc
+        schema = op.schema()
+        return [C.build_partition([final], schema)], excs
+
+    # ------------------------------------------------------------------
+    def _python_fold(self, op, part, indices, groups, kidx, excs,
+                     into_key: Optional[tuple] = None):
+        for i in indices:
+            row = part.decode_row(i)
+            k = into_key if into_key is not None else \
+                tuple(row.values[j] for j in kidx)
+            acc = groups.get(k, op.initial)
+            try:
+                groups[k] = A._apply_agg(op.aggregate_udf, acc, row)
+            except Exception as e:
+                excs.append(ExceptionRecord(op.id, type(e).__name__,
+                                            row.unwrap()))
+
+    # ------------------------------------------------------------------
+    def _device_fold(self, op, spec: A.FoldSpec, part: C.Partition):
+        """(partial_tuple|scalar, bad_row_indices) or (None, _) if the
+        partition can't run on device."""
+        try:
+            vals, ok_mask, err = self._eval_exprs(op, spec, part)
+        except NotCompilable:
+            return None, range(part.num_rows)
+        import jax.numpy as jnp
+
+        partials = []
+        for cv_data, reducer in zip(vals, spec.reducers):
+            is_float = cv_data.dtype.kind == "f"
+            ident = _identity(reducer, is_float)
+            masked = jnp.where(ok_mask, cv_data, ident)
+            if reducer == "sum":
+                r = masked.sum()
+            elif reducer == "min":
+                r = masked.min()
+            else:
+                r = masked.max()
+            partials.append(r.item())
+        bad = np.nonzero(~np.asarray(ok_mask)[: part.num_rows] &
+                         _real_mask(part))[0].tolist()
+        bad += [i for i in part.fallback if i not in bad]
+        out = tuple(partials) if not spec.scalar else partials[0]
+        return out, sorted(set(bad))
+
+    def _device_fold_bykey(self, op, spec, part, kidx, groups, excs) -> bool:
+        try:
+            vals, ok_mask, err = self._eval_exprs(op, spec, part)
+        except NotCompilable:
+            return False
+        import jax.numpy as jnp
+        import jax.ops
+
+        n = part.num_rows
+        ok_np = np.asarray(ok_mask)[:n] & _real_mask(part)
+        codes, uniq_rows = _factorize_keys(part, kidx, ok_np)
+        if codes is None:
+            return False
+        nseg = len(uniq_rows)
+        b = np.asarray(ok_mask).shape[0]
+        codes_b = np.full(b, nseg, dtype=np.int32)  # padding -> dropped seg
+        codes_b[:n][ok_np] = codes
+        seg_partials = []
+        for cv_data, reducer in zip(vals, spec.reducers):
+            is_float = cv_data.dtype.kind == "f"
+            ident = _identity(reducer, is_float)
+            masked = jnp.where(ok_mask, cv_data, ident)
+            if reducer == "sum":
+                r = jax.ops.segment_sum(masked, codes_b,
+                                        num_segments=nseg + 1)
+            elif reducer == "min":
+                r = jax.ops.segment_min(masked, codes_b,
+                                        num_segments=nseg + 1)
+            else:
+                r = jax.ops.segment_max(masked, codes_b,
+                                        num_segments=nseg + 1)
+            seg_partials.append(np.asarray(r)[:nseg])
+        # merge per-key partials into the global dict
+        for si, row_i in enumerate(uniq_rows):
+            row = part.decode_row(int(row_i))
+            k = tuple(row.values[j] for j in kidx)
+            acc = groups.get(k, op.initial)
+            accs = list(acc) if isinstance(acc, tuple) else [acc]
+            merged = []
+            for j, reducer in enumerate(spec.reducers):
+                v = seg_partials[j][si].item()
+                merged.append(_combine_scalar(reducer, accs[j], v)
+                              if reducer != "sum" else accs[j] + v)
+            groups[k] = tuple(merged) if isinstance(acc, tuple) else merged[0]
+        # bad rows -> interpreter
+        bad = np.nonzero(~ok_np & _real_mask(part))[0].tolist()
+        bad += [i for i in part.fallback if i not in bad]
+        self._python_fold(op, part, sorted(set(bad)), groups, kidx, excs)
+        return True
+
+    # ------------------------------------------------------------------
+    def _eval_exprs(self, op, spec: A.FoldSpec, part: C.Partition):
+        """Evaluate fold exprs over the staged partition; returns
+        (list of [B] arrays, ok_mask [B], err [B])."""
+        from ..compiler.stagefn import input_row_cv
+        import jax.numpy as jnp
+
+        if not part.leaves and part.fallback:
+            raise NotCompilable("all-fallback partition")
+        batch = C.stage_partition(part, self.backend.bucket_mode)
+        arrays = {k: jnp.asarray(v) for k, v in batch.arrays.items()}
+        ctx = EmitCtx(batch.b, arrays["#rowvalid"])
+        em = Emitter(ctx, spec.globals)
+        row = input_row_cv(arrays, part.schema)
+        frame = Frame(em, {spec.row_param: row})
+        datas = []
+        for expr in spec.exprs:
+            cv = frame.eval(expr)
+            cv = frame._require_numeric(cv, "aggregate expr")
+            datas.append(cv.data)
+        ok = arrays["#rowvalid"] & (ctx.err == 0)
+        return datas, ok, ctx.err
+
+
+def _real_mask(part: C.Partition) -> np.ndarray:
+    m = np.ones(part.num_rows, dtype=np.bool_)
+    if part.normal_mask is not None:
+        m &= part.normal_mask
+    return m
+
+
+def _row_signatures(part: C.Partition) -> Optional[np.ndarray]:
+    """[N] array of hashable per-row signatures (bytes), or None if the
+    partition has non-vectorizable leaves."""
+    pieces = []
+    for path in sorted(part.leaves):
+        leaf = part.leaves[path]
+        if isinstance(leaf, C.NumericLeaf):
+            pieces.append(np.ascontiguousarray(
+                leaf.data.reshape(part.num_rows, -1)).view(np.uint8).reshape(
+                    part.num_rows, -1))
+            if leaf.valid is not None:
+                pieces.append(leaf.valid.reshape(-1, 1).view(np.uint8))
+        elif isinstance(leaf, C.StrLeaf):
+            pieces.append(leaf.bytes)
+            pieces.append(leaf.lengths.astype("<i4").view(np.uint8).reshape(
+                part.num_rows, -1))
+            if leaf.valid is not None:
+                pieces.append(leaf.valid.reshape(-1, 1).view(np.uint8))
+        elif isinstance(leaf, C.NullLeaf):
+            continue
+        else:
+            return None
+    if not pieces:
+        return None
+    mat = np.ascontiguousarray(np.concatenate(pieces, axis=1))
+    return np.asarray([mat[i].tobytes() for i in range(part.num_rows)],
+                      dtype=object)
+
+
+def _factorize_keys(part: C.Partition, kidx: list[int], ok_mask: np.ndarray):
+    """(codes[n_ok], unique_first_row_indices) — vectorized key factorization
+    over the key columns' leaf bytes."""
+    pieces = []
+    for ci in kidx:
+        for path, lt in C.flatten_type(part.schema.types[ci], str(ci)):
+            leaf = part.leaves.get(path)
+            if isinstance(leaf, C.NumericLeaf):
+                pieces.append(np.ascontiguousarray(
+                    leaf.data.reshape(part.num_rows, -1)).view(
+                        np.uint8).reshape(part.num_rows, -1))
+                if leaf.valid is not None:
+                    pieces.append(leaf.valid.reshape(-1, 1).view(np.uint8))
+            elif isinstance(leaf, C.StrLeaf):
+                pieces.append(leaf.bytes)
+                pieces.append(leaf.lengths.astype("<i4").view(
+                    np.uint8).reshape(part.num_rows, -1))
+                if leaf.valid is not None:
+                    pieces.append(leaf.valid.reshape(-1, 1).view(np.uint8))
+            elif isinstance(leaf, C.NullLeaf):
+                continue
+            else:
+                return None, None
+    if not pieces:
+        return None, None
+    mat = np.ascontiguousarray(np.concatenate(pieces, axis=1))
+    sub = mat[ok_mask]
+    if len(sub) == 0:
+        return np.zeros(0, np.int32), np.zeros(0, np.int64)
+    view = sub.view([("v", np.void, sub.shape[1])]).ravel()
+    uniq, first_idx, inverse = np.unique(view, return_index=True,
+                                         return_inverse=True)
+    ok_rows = np.nonzero(ok_mask)[0]
+    return inverse.astype(np.int32), ok_rows[first_idx]

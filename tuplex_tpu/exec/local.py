@@ -79,6 +79,29 @@ class LocalBackend:
         self.bucket_mode = options.get_str("tuplex.tpu.padBucketing", "pow2")
         self._not_compilable: set[str] = set()
 
+    def _jit_stage_fn(self, raw_fn):
+        """Compile a stage fn for dispatch (overridden by MultiHostBackend
+        to row-shard over a mesh)."""
+        import jax
+
+        return jax.jit(raw_fn)
+
+    # ------------------------------------------------------------------
+    def execute_any(self, stage, partitions, context) -> StageResult:
+        """Dispatch by stage kind (reference: LocalBackend.cc:145-180)."""
+        from ..plan.physical import AggregateStage, JoinStage
+
+        if isinstance(stage, AggregateStage):
+            from .aggexec import AggregateExecutor
+
+            return AggregateExecutor(self).execute(stage, partitions or [])
+        if isinstance(stage, JoinStage):
+            from .joinexec import JoinExecutor
+
+            return JoinExecutor(self).execute(stage, partitions or [],
+                                              context)
+        return self.execute(stage, partitions or [])
+
     # ------------------------------------------------------------------
     def execute(self, stage: TransformStage,
                 partitions: list[C.Partition]) -> StageResult:
@@ -93,7 +116,7 @@ class LocalBackend:
             try:
                 raw_fn = stage.build_device_fn()
                 device_fn = self.jit_cache.get_or_build(
-                    ("stagefn", skey), lambda: jax.jit(raw_fn))
+                    ("stagefn", skey), lambda: self._jit_stage_fn(raw_fn))
             except NotCompilable:
                 self._not_compilable.add(skey)
                 device_fn = None
@@ -376,6 +399,12 @@ def _apply_op_python(op: L.LogicalOperator, row: Row) -> Optional[Row]:
         return Row([row.values[i] for i in idx], s.columns)
     if isinstance(op, L.RenameColumnOperator):
         return Row(row.values, op.schema().columns)
+    if isinstance(op, L.DecodeOperator):
+        vals = [L.decode_cell_python(v, t, op.null_values)
+                for v, t in zip(row.values, op.declared.types)]
+        from ..runtime.columns import user_columns
+
+        return Row(vals, user_columns(op.declared))
     raise TuplexException(f"interpreter: unsupported op {op!r}")
 
 

@@ -1,0 +1,25 @@
+"""CSV output (reference: FileOutputOperator + buildWithCSVRowWriter,
+core/include/physical/PipelineBuilder.h:238)."""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Optional, Sequence
+
+
+def write_csv(path: str, rows: list, columns: Optional[Sequence[str]] = None,
+              delimiter: str = ",") -> None:
+    if path.endswith("/") or os.path.isdir(path):
+        os.makedirs(path, exist_ok=True)
+        path = os.path.join(path, "part0.csv")
+    else:
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+    with open(path, "w", newline="") as fp:
+        w = csv.writer(fp, delimiter=delimiter)
+        if columns:
+            w.writerow(columns)
+        for r in rows:
+            w.writerow(list(r) if isinstance(r, tuple) else [r])

@@ -1,0 +1,96 @@
+"""Virtual filesystem with URI-scheme dispatch.
+
+Re-designs the reference's VirtualFileSystem (reference:
+io/include/VirtualFileSystem.h — posix + S3 impls selected by URI prefix).
+S3/GCS backends are gated on their SDKs being importable; local posix always
+works. Zero-egress environments simply never exercise the remote schemes.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+import shutil
+from typing import Optional
+
+
+class VirtualFileSystem:
+    @staticmethod
+    def _scheme(uri: str) -> str:
+        if "://" in uri:
+            return uri.split("://", 1)[0]
+        return "file"
+
+    @staticmethod
+    def _strip(uri: str) -> str:
+        return uri.split("://", 1)[1] if "://" in uri else uri
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def ls(cls, pattern: str) -> list[str]:
+        scheme = cls._scheme(pattern)
+        if scheme == "file":
+            p = cls._strip(pattern)
+            if os.path.isdir(p):
+                return sorted(os.path.join(p, f) for f in os.listdir(p))
+            return sorted(_glob.glob(p))
+        if scheme in ("s3", "gs"):
+            return cls._remote(scheme).ls(pattern)
+        raise ValueError(f"unsupported scheme {scheme!r}")
+
+    @classmethod
+    def glob_input(cls, pattern: str) -> list[str]:
+        """Comma-separated patterns / dirs / globs -> file list (reference:
+        FileInputOperator detectFiles)."""
+        out: list[str] = []
+        for pat in pattern.split(","):
+            pat = pat.strip()
+            if not pat:
+                continue
+            scheme = cls._scheme(pat)
+            if scheme == "file":
+                p = cls._strip(pat)
+                if os.path.isdir(p):
+                    out.extend(sorted(
+                        os.path.join(p, f) for f in os.listdir(p)
+                        if os.path.isfile(os.path.join(p, f))))
+                elif os.path.isfile(p):
+                    out.append(p)
+                else:
+                    out.extend(sorted(_glob.glob(p)))
+            else:
+                out.extend(cls._remote(scheme).ls(pat))
+        return out
+
+    @classmethod
+    def cp(cls, src: str, dst: str) -> None:
+        if cls._scheme(src) == "file" and cls._scheme(dst) == "file":
+            shutil.copy(cls._strip(src), cls._strip(dst))
+            return
+        raise ValueError("remote cp not available in this environment")
+
+    @classmethod
+    def rm(cls, pattern: str) -> None:
+        for p in cls.ls(pattern):
+            if os.path.isdir(p):
+                shutil.rmtree(p)
+            else:
+                os.remove(p)
+
+    @classmethod
+    def open_read(cls, uri: str, mode: str = "rb"):
+        if cls._scheme(uri) == "file":
+            return open(cls._strip(uri), mode)
+        raise ValueError(f"unsupported scheme for open: {uri}")
+
+    @classmethod
+    def file_size(cls, uri: str) -> int:
+        if cls._scheme(uri) == "file":
+            return os.path.getsize(cls._strip(uri))
+        raise ValueError(f"unsupported scheme: {uri}")
+
+    @staticmethod
+    def _remote(scheme: str):
+        raise ValueError(
+            f"{scheme}:// requires a cloud SDK not present in this "
+            f"environment (zero-egress); stage files locally instead")

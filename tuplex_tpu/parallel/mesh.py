@@ -1,0 +1,64 @@
+"""Device-mesh execution of stage functions.
+
+The TPU replacement for the reference's executor thread pool + (absent)
+shuffle layer (reference: core/include/Executor.h WorkQueue;
+SURVEY.md §2.10): partitions are row-sharded across a `jax.sharding.Mesh`
+and the SAME fused stage function runs under pjit — row-wise pipelines
+partition with zero collectives; aggregates/joins add psum/all_gather inside
+the traced function (see parallel/collectives.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..runtime.jaxcfg import jax, jnp
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = DATA_AXIS):
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def row_sharding(mesh, axis: str = DATA_AXIS):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_stage_fn(raw_fn, mesh, axis: str = DATA_AXIS):
+    """jit a stage function with every leading-dim array row-sharded over the
+    mesh. Row-wise stage bodies partition trivially (XLA inserts no
+    collectives); reduction stages contain their own psums."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shard = NamedSharding(mesh, P(axis))
+
+    def sharded(arrays):
+        placed = {k: jax.device_put(v, shard) for k, v in arrays.items()}
+        return raw_fn(placed)
+
+    return jax.jit(sharded)
+
+
+def pad_batch_for_mesh(arrays: dict, n_devices: int) -> dict:
+    """Pad the leading dim to a multiple of the mesh size (XLA requires
+    divisible sharding)."""
+    b = arrays["#rowvalid"].shape[0]
+    target = -(-b // n_devices) * n_devices
+    if target == b:
+        return arrays
+    out = {}
+    for k, v in arrays.items():
+        pad = [(0, target - b)] + [(0, 0)] * (v.ndim - 1)
+        out[k] = np.pad(np.asarray(v), pad)
+    return out

@@ -1,0 +1,85 @@
+"""Materialized dataset caching.
+
+Reference semantics (reference: core/include/logical/CacheOperator.h:73-83 +
+dataset.py:346): cache() EAGERLY executes the upstream plan and keeps the
+result partitions — normal-case columnar partitions and boxed
+fallback/general rows stay separate (store_specialized), so later plans
+reuse them without recompute (PhysicalPlan.cc:85-99).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core import typesys as T
+from ..core.row import Row
+from . import logical as L
+
+
+class CacheOperator(L.LogicalOperator):
+    acts_as_source = True  # plan walk stops here; partitions come from cache
+
+    def __init__(self, parent: L.LogicalOperator, store_specialized: bool = True):
+        super().__init__([parent])
+        self.store_specialized = store_specialized
+        self._partitions: Optional[list] = None
+        self._schema: Optional[T.RowType] = None
+        self._exceptions: list = []
+
+    # -- materialization (eager, like the reference) -----------------------
+    def materialize(self, context) -> None:
+        if self._partitions is not None:
+            return
+        from ..api.dataset import _source_partitions
+        from .physical import plan_stages
+
+        stages = plan_stages(self.parent)
+        partitions = None
+        for stage in stages:
+            if getattr(stage, "source", None) is not None:
+                partitions = _source_partitions(context, stage)
+            result = context.backend.execute_any(stage, partitions, context)
+            partitions = result.partitions
+            self._exceptions.extend(result.exceptions)
+            context.metrics.record_stage(result.metrics)
+        self._partitions = partitions or []
+        if self._partitions:
+            self._schema = self._partitions[0].schema
+        else:
+            self._schema = self.parent.schema()
+        if not self.store_specialized:
+            # un-specialize: box everything (general case only)
+            from ..runtime import columns as C
+
+            values = []
+            for p in self._partitions:
+                for r in p.iter_rows():
+                    values.append(r.unwrap() if len(r.values) == 1
+                                  else tuple(r.values))
+            schema = self._schema
+            self._partitions = [C.build_partition(values, schema)] \
+                if values else []
+
+    # -- source protocol ---------------------------------------------------
+    def schema(self) -> T.RowType:
+        if self._schema is not None:
+            return self._schema
+        return self.parent.schema()
+
+    def columns(self):
+        from ..runtime.columns import user_columns
+
+        return user_columns(self.schema())
+
+    def sample(self) -> list[Row]:
+        if self._partitions:
+            out = []
+            for p in self._partitions[:1]:
+                for i in range(min(p.num_rows, 256)):
+                    out.append(p.decode_row(i))
+            return out
+        return self.parent.sample()
+
+    def load_partitions(self, context) -> list:
+        self.materialize(context)
+        return list(self._partitions or [])

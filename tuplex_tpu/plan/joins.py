@@ -1,0 +1,105 @@
+"""Join operator (inner / left).
+
+Reference semantics (reference: core/src/logical/JoinOperator.cc:250,
+python/tuplex/dataset.py:384 join / :442 leftJoin): the key column appears
+once; output columns are the non-key left columns + key + non-key right
+columns, with optional prefixes/suffixes to disambiguate. The build side is
+fully materialized and broadcast (there is NO shuffle in the reference —
+PhysicalPlan.cc:145-178); we keep that model: build side partitions are
+merged, probe runs partition-parallel.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..core import typesys as T
+from ..core.errors import TuplexException
+from ..core.row import Row
+from . import logical as L
+
+
+class JoinOperator(L.LogicalOperator):
+    def __init__(self, left: L.LogicalOperator, right: L.LogicalOperator,
+                 left_column: str, right_column: str, how: str = "inner",
+                 prefixes: Optional[Sequence[str]] = None,
+                 suffixes: Optional[Sequence[str]] = None):
+        super().__init__([left, right])
+        self.left_column = left_column
+        self.right_column = right_column
+        self.how = how
+        self.prefixes = tuple(prefixes) if prefixes else ("", "")
+        self.suffixes = tuple(suffixes) if suffixes else ("", "")
+
+    @property
+    def left(self) -> L.LogicalOperator:
+        return self.parents[0]
+
+    @property
+    def right(self) -> L.LogicalOperator:
+        return self.parents[1]
+
+    def is_breaker(self) -> bool:
+        return True
+
+    # -- schema ---------------------------------------------------------
+    def _sides(self):
+        ls = self.left.schema()
+        rs = self.right.schema()
+        if self.left_column not in (ls.columns or ()):
+            raise TuplexException(f"unknown left key {self.left_column!r}")
+        if self.right_column not in (rs.columns or ()):
+            raise TuplexException(f"unknown right key {self.right_column!r}")
+        return ls, rs
+
+    def _decorate(self, name: str, side: int) -> str:
+        p = self.prefixes[side] or ""
+        s = self.suffixes[side] or ""
+        return f"{p}{name}{s}"
+
+    def schema(self) -> T.RowType:
+        ls, rs = self._sides()
+        lk = ls.columns.index(self.left_column)
+        rk = rs.columns.index(self.right_column)
+        key_t = T.super_type(ls.types[lk], rs.types[rk])
+        cols: list[str] = []
+        types: list[T.Type] = []
+        for i, (c, t) in enumerate(zip(ls.columns, ls.types)):
+            if i == lk:
+                continue
+            cols.append(self._decorate(c, 0))
+            types.append(t)
+        cols.append(self.left_column)
+        types.append(key_t)
+        for i, (c, t) in enumerate(zip(rs.columns, rs.types)):
+            if i == rk:
+                continue
+            cols.append(self._decorate(c, 1))
+            # left join: unmatched rows get None for right columns
+            types.append(T.option(t) if self.how == "left" else t)
+        return T.row_of(cols, types)
+
+    def columns(self):
+        return self.schema().columns
+
+    def sample(self) -> list[Row]:
+        ls, rs = self._sides()
+        lk = ls.columns.index(self.left_column)
+        rk = rs.columns.index(self.right_column)
+        build: dict = {}
+        for r in self.right.sample():
+            build.setdefault(r.values[rk], []).append(r)
+        out = []
+        cols = self.schema().columns
+        for r in self.left.sample():
+            key = r.values[lk]
+            matches = build.get(key, [])
+            lvals = [v for i, v in enumerate(r.values) if i != lk]
+            if matches:
+                for m in matches:
+                    rvals = [v for i, v in enumerate(m.values) if i != rk]
+                    out.append(Row(lvals + [key] + rvals, cols))
+            elif self.how == "left":
+                rvals = [None] * (len(rs.columns) - 1)
+                out.append(Row(lvals + [key] + rvals, cols))
+        return out

@@ -342,3 +342,56 @@ class TakeOperator(LogicalOperator):
     def sample(self) -> list[Row]:
         s = self.parent.sample()
         return s if self.limit < 0 else s[: self.limit]
+
+
+class DecodeOperator(LogicalOperator):
+    """Typed decode of raw string cells against the speculated normal-case
+    schema — fused into the stage so parsing runs on device (reference:
+    JITCSVSourceTaskBuilder / CSVParseRowGenerator fuse parse into the
+    pipeline). The interpreter path implements the GENERAL case: cells that
+    fail the normal-case parse stay raw strings, exactly like the reference's
+    general-case row type preserves un-specialized columns."""
+
+    def __init__(self, parent: LogicalOperator, declared: T.RowType,
+                 null_values: Sequence[str]):
+        super().__init__([parent])
+        self.declared = declared
+        self.null_values = tuple(null_values)
+
+    def schema(self) -> T.RowType:
+        return self.declared
+
+    def sample(self) -> list[Row]:
+        out = []
+        for r in self.parent.sample():
+            vals = [decode_cell_python(v, t, self.null_values)
+                    for v, t in zip(r.values, self.declared.types)]
+            out.append(Row(vals, self.declared.columns))
+        return out
+
+
+def decode_cell_python(cell, t: T.Type, null_values) -> Any:
+    """General-case decode: normal-case parse if possible, else the raw
+    string survives (so downstream interpreter UDFs can still handle it)."""
+    if cell is None:
+        return None
+    if not isinstance(cell, str):
+        return cell
+    if cell in null_values:
+        return None
+    base = t.without_option() if t.is_optional() else t
+    try:
+        if base is T.I64:
+            return int(cell)
+        if base is T.F64:
+            return float(cell)
+        if base is T.BOOL:
+            low = cell.strip().lower()
+            if low == "true":
+                return True
+            if low == "false":
+                return False
+            return cell
+    except ValueError:
+        return cell
+    return cell

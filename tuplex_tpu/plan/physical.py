@@ -31,15 +31,19 @@ class TransformStage:
     resolvers, StageBuilder.cc generateResolveCodePath).
     """
 
-    def __init__(self, source: L.LogicalOperator, ops: list[L.LogicalOperator],
-                 limit: int = -1):
-        self.source = source
+    def __init__(self, source: Optional[L.LogicalOperator],
+                 ops: list[L.LogicalOperator], limit: int = -1,
+                 input_schema: Optional[T.RowType] = None,
+                 input_op: Optional[L.LogicalOperator] = None):
+        self.source = source       # None => consumes previous stage's output
         self.ops = ops
         self.limit = limit
-        self.input_schema = source.schema()
-        self.output_schema = ops[-1].schema() if ops else source.schema()
-        out_cols = (ops[-1] if ops else source).columns()
-        self.output_columns = out_cols
+        src_like = source if source is not None else input_op
+        self.input_schema = input_schema if input_schema is not None \
+            else src_like.schema()
+        last = ops[-1] if ops else src_like
+        self.output_schema = last.schema()
+        self.output_columns = last.columns()
 
     def key(self) -> str:
         """Cache key for the jit'd executable: operator chain + UDF sources +
@@ -54,7 +58,8 @@ class TransformStage:
                 h.update(udf.source.encode())
                 for k in sorted(udf.globals):
                     h.update(f"{k}={udf.globals[k]!r}".encode())
-            for attr in ("column", "selected", "old", "new"):
+            for attr in ("column", "selected", "old", "new", "declared",
+                         "null_values"):
                 if hasattr(op, attr):
                     h.update(repr(getattr(op, attr)).encode())
         return h.hexdigest()[:16]
@@ -138,20 +143,141 @@ def _emit_op(ctx: EmitCtx, op: L.LogicalOperator, row: CV, keep,
         if row.elts is not None:
             return tuple_cv(row.elts, names=nm, valid=row.valid), keep, nm
         return row, keep, nm
+    if isinstance(op, L.DecodeOperator):
+        return _emit_decode(ctx, frame, op, row, keep)
     raise NotCompilable(f"operator {type(op).__name__} not fusable")
 
 
-def plan_stages(sink: L.LogicalOperator) -> list[TransformStage]:
-    """Walk the DAG sink→source splitting at breakers (single linear chain
-    until joins/aggregates land)."""
+def _emit_decode(ctx: EmitCtx, frame, op, row: CV, keep):
+    """Vectorized normal-case cell decode (reference:
+    CSVParseRowGenerator.cc codegen'd parse; here: parse kernels + err codes).
+    Parse failures raise BADPARSE_STRING_INPUT; unexpected nulls NULLERROR —
+    both re-run on the interpreter's general-case path."""
+    from ..core.errors import ExceptionCode
+    from ..ops import strings as S
+    from ..runtime.columns import user_columns
+
+    cells = row.elts if row.elts is not None else (row,)
+    decl = op.declared
+    elts = []
+    for cv, t in zip(cells, decl.types):
+        base = t.without_option() if t.is_optional() else t
+        opt = t.is_optional()
+        sb, sl = cv.sbytes, cv.slen
+        missing = ~cv.valid if cv.valid is not None else \
+            jnp.zeros(ctx.b, dtype=bool)
+        is_null = missing
+        for nv in op.null_values:
+            is_null = is_null | S.equals(
+                sb, sl, *S.broadcast_const(nv, ctx.b))
+        if base is T.STR:
+            if opt:
+                elts.append(CV(t=T.option(T.STR), sbytes=sb, slen=sl,
+                               valid=~is_null))
+            else:
+                frame.raise_where(is_null, ExceptionCode.NULLERROR)
+                elts.append(CV(t=T.STR, sbytes=sb, slen=sl))
+            continue
+        if base is T.NULL:
+            from ..compiler.values import null_cv
+
+            # a non-null cell in an all-null speculated column violates the
+            # normal case: send it to the interpreter's general-case path
+            frame.raise_where(~is_null, ExceptionCode.NORMALCASEVIOLATION)
+            elts.append(null_cv())
+            continue
+        if base is T.I64:
+            val, bad = S.parse_i64(sb, sl)
+            out = CV(t=T.I64, data=val)
+        elif base is T.F64:
+            val, bad = S.parse_f64(sb, sl)
+            out = CV(t=T.F64, data=val)
+        elif base is T.BOOL:
+            low_b, low_l = S.lower(*S.strip(sb, sl))
+            is_true = S.equals(low_b, low_l, *S.broadcast_const("true", ctx.b))
+            is_false = S.equals(low_b, low_l,
+                                *S.broadcast_const("false", ctx.b))
+            bad = ~(is_true | is_false)
+            out = CV(t=T.BOOL, data=is_true)
+        else:
+            raise NotCompilable(f"decode to {t}")
+        if opt:
+            frame.raise_where(bad & ~is_null,
+                              ExceptionCode.BADPARSE_STRING_INPUT)
+            out = CV(t=T.option(base), data=out.data, valid=~is_null)
+        else:
+            frame.raise_where(is_null, ExceptionCode.NULLERROR)
+            frame.raise_where(bad & ~is_null,
+                              ExceptionCode.BADPARSE_STRING_INPUT)
+        elts.append(out)
+    nm = user_columns(decl)
+    if len(elts) == 1 and nm is None:
+        return elts[0], keep, None
+    return tuple_cv(elts, names=nm), keep, nm
+
+
+class AggregateStage:
+    """Pipeline-breaker stage wrapping one aggregation operator (reference:
+    physical/AggregateStage.cc + LocalBackend executeAggregateStage)."""
+
+    def __init__(self, op: L.LogicalOperator):
+        self.op = op
+        self.limit = -1
+        self.output_schema = op.schema()
+        self.output_columns = op.columns()
+
+
+class JoinStage:
+    """Pipeline-breaker stage wrapping a join: the build side is planned as
+    its own sub-plan (reference: PhysicalPlan.cc:145-178 — build side becomes
+    stage N-1 with HASHTABLE output; probe fuses into the next stage)."""
+
+    def __init__(self, op):
+        self.op = op
+        self.limit = -1
+        self.output_schema = op.schema()
+        self.output_columns = op.columns()
+
+
+def plan_stages(sink: L.LogicalOperator):
+    """Walk the DAG sink→source splitting at pipeline breakers (reference:
+    PhysicalPlan.cc:60-238 splitIntoAndPlanStages)."""
     chain: list[L.LogicalOperator] = []
     limit = -1
     node = sink
-    while node.parents:
+    # operators that materialize (cache) act as sources: stop the walk there
+    while node.parents and not getattr(node, "acts_as_source", False):
         if isinstance(node, L.TakeOperator):
             limit = node.limit
         else:
             chain.append(node)
         node = node.parent
+    source = node
     chain.reverse()
-    return [TransformStage(node, chain, limit)]
+
+    stages: list = []
+    cur: list[L.LogicalOperator] = []
+    cur_source: Optional[L.LogicalOperator] = source
+    cur_input_op: Optional[L.LogicalOperator] = source
+    for op in chain:
+        if op.is_breaker():
+            if cur or cur_source is not None:
+                stages.append(TransformStage(cur_source, cur,
+                                             input_op=cur_input_op))
+            from .joins import JoinOperator
+
+            if isinstance(op, JoinOperator):
+                stages.append(JoinStage(op))
+            else:
+                stages.append(AggregateStage(op))
+            cur = []
+            cur_source = None
+            cur_input_op = op
+        else:
+            cur.append(op)
+    if cur or cur_source is not None or not stages:
+        stages.append(TransformStage(cur_source, cur, limit,
+                                     input_op=cur_input_op))
+    elif stages:
+        stages[-1].limit = limit
+    return stages

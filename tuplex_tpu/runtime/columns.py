@@ -632,3 +632,25 @@ def gather_partition(part: Partition, out_positions: np.ndarray,
             leaves[path] = ObjectLeaf(vals)
     return Partition(schema=part.schema, num_rows=m, leaves=leaves,
                      start_index=part.start_index)
+
+
+def harmonize_partitions(parts: list) -> list:
+    """Pad every partition's str leaves to the dataset-wide pow2 width and
+    align row-count buckets, so ONE jit executable serves every partition
+    (reference analog: one LLVM module per stage regardless of partition
+    count). Without this each partition's distinct shapes would recompile."""
+    if not parts:
+        return parts
+    widths: dict[str, int] = {}
+    for p in parts:
+        for path, leaf in p.leaves.items():
+            if isinstance(leaf, StrLeaf):
+                widths[path] = max(widths.get(path, 1), leaf.width)
+    for path in widths:
+        widths[path] = bucket_size(widths[path], "pow2", minimum=8)
+    for p in parts:
+        for path, w in widths.items():
+            leaf = p.leaves.get(path)
+            if isinstance(leaf, StrLeaf) and leaf.width < w:
+                leaf.bytes = pad_to(leaf.bytes, w, axis=1)
+    return parts
