@@ -320,3 +320,37 @@ def test_non_ascii_routes_to_interpreter():
     # byte-equivalent ops stay on device and are exact
     check(lambda s: s + "!", vals)
     check(lambda s: s == "héllo", vals)
+
+
+def test_format_review_regressions():
+    check(lambda x: "a{{}}b{0}".format(x), [7])       # brace escapes
+    check(lambda x: "{}".format(x > 0), [1, -1])      # bool -> True/False
+    check(lambda s: "{:5}!".format(s), ["ab", "abcdefg"])   # str left-align
+    check(lambda s: "{:05}!".format(s), ["ab"])       # str zero fills right
+    check(lambda x: str(x > 1), [0, 5])
+    # unsupported spec must NOT silently emit literal text: NotCompilable ->
+    # interpreter (harness treats whole-op NotCompilable as error)
+    import pytest as _pytest
+
+    with _pytest.raises(NotCompilable):
+        run_compiled(lambda x: "{:.2f}".format(x), [1.5])
+    with _pytest.raises(NotCompilable):
+        run_compiled(lambda x: "{0} {}".format(x, x), [1])
+
+
+def test_ambiguous_closure_lambdas_fall_back():
+    y = 3
+    a = (lambda x: x - y)
+    b = (lambda x: y - x)
+    from tuplex_tpu.utils.reflection import get_udf_source
+
+    sa, sb = get_udf_source(a), get_udf_source(b)
+    # either faithfully extracted or safely source-less; NEVER swapped
+    for s, f in ((sa, a), (sb, b)):
+        if s.source:
+            import ast as _ast
+
+            lam = eval(compile(_ast.Expression(
+                body=_ast.parse(s.source, mode="eval").body),
+                "<t>", "eval"), {"y": y})
+            assert lam(10) == f(10)

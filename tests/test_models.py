@@ -1,0 +1,61 @@
+"""Benchmark model golden tests: framework vs pure-python references
+(reference methodology: benchmarks/*/validate.sh output diffs)."""
+
+import pytest
+
+from tuplex_tpu.models import tpch
+
+
+def test_tpch_q6(ctx, tmp_path):
+    path = str(tmp_path / "lineitem.csv")
+    tpch.generate_csv(path, 2000, seed=4)
+    rows = tpch.gen_lineitem_rows(2000, seed=4)
+    got = tpch.q6(ctx.csv(path)).collect()[0]
+    want = tpch.q6_python(rows)
+    assert abs(got - want) < 1e-6 * max(1.0, abs(want))
+
+
+def test_tpch_q1(ctx, tmp_path):
+    path = str(tmp_path / "lineitem.csv")
+    tpch.generate_csv(path, 2000, seed=8)
+    rows = tpch.gen_lineitem_rows(2000, seed=8)
+    out = tpch.q1(ctx.csv(path)).collect()
+    got = {(r[0], r[1]): r[2:] for r in out}
+    want = tpch.q1_python(rows)
+    assert set(got) == set(want)
+    for k, w in want.items():
+        g = got[k]
+        for gv, wv in zip(g, w):
+            assert abs(gv - wv) < 1e-6 * max(1.0, abs(wv)), (k, g, w)
+
+
+@pytest.mark.slow
+def test_flights_pipeline(ctx, tmp_path):
+    from tuplex_tpu.models import flights
+
+    perf = str(tmp_path / "flights.csv")
+    carrier = str(tmp_path / "carrier.csv")
+    airport = str(tmp_path / "airports.txt")
+    flights.generate_perf_csv(perf, 300, seed=2)
+    flights.generate_carrier_csv(carrier)
+    flights.generate_airport_db(airport)
+
+    ds = flights.build_pipeline(ctx, perf, carrier, airport)
+    got = ds.collect()
+    want = flights.run_reference_python(perf, carrier, airport)
+    assert len(got) == len(want), (len(got), len(want))
+
+    def key(r):
+        i = flights.OUTPUT_COLS.index
+        return (r[i("CarrierCode")], r[i("FlightNumber")], r[i("Year")],
+                r[i("Month")], r[i("Day")], r[i("CrsDepTime")])
+
+    for g, w in zip(sorted(got, key=key), sorted(want, key=key)):
+        for ci, (a, b) in enumerate(zip(g, w)):
+            if isinstance(a, float) and isinstance(b, float):
+                # XLA may strength-reduce /const to reciprocal-multiply:
+                # 1-ulp divergence allowed (reference validators do the same)
+                assert abs(a - b) <= 1e-12 * max(1.0, abs(b)), \
+                    (flights.OUTPUT_COLS[ci], a, b)
+            else:
+                assert a == b, (flights.OUTPUT_COLS[ci], a, b)

@@ -628,12 +628,86 @@ class Frame:
             out = part if out is None else self._str_concat(out, part)
         return out if out is not None else const_cv("")
 
+    def _format_method(self, spec: str, args: list[CV]) -> CV:
+        """'...{}...{:02}...'.format(a, b) with plain / zero-pad int specs
+        (reference: FunctionRegistry str.format subset). Anything outside the
+        supported subset raises NotCompilable so rows keep exact Python
+        semantics via the interpreter."""
+        import re as _re
+
+        pieces = _re.split(r"(\{\{|\}\}|\{[^{}]*\})", spec)
+        out: Optional[CV] = None
+        auto_i = 0
+        saw_auto = saw_manual = False
+        for piece in pieces:
+            if not piece:
+                continue
+            if piece == "{{":
+                part = const_cv("{")
+            elif piece == "}}":
+                part = const_cv("}")
+            elif piece.startswith("{"):
+                m = _re.fullmatch(r"\{(\d*)(?::(0?)(\d*)([ds]?))?\}", piece)
+                if not m:
+                    raise NotCompilable(f"format spec {piece!r}")
+                if m.group(1):
+                    saw_manual = True
+                    idx = int(m.group(1))
+                else:
+                    saw_auto = True
+                    idx = auto_i
+                    auto_i += 1
+                if saw_auto and saw_manual:
+                    # CPython raises ValueError on mixed numbering
+                    raise NotCompilable("mixed manual/auto format numbering")
+                if idx >= len(args):
+                    raise NotCompilable("format arity")
+                arg = args[idx]
+                zero = m.group(2) == "0"
+                width = int(m.group(3)) if m.group(3) else 0
+                kind = m.group(4) or ""
+                is_int = (kind == "d") or (
+                    kind == "" and ((arg.base is T.I64 and not arg.is_const)
+                                    or (arg.is_const and
+                                        isinstance(arg.const, int) and
+                                        not isinstance(arg.const, bool))))
+                if is_int:
+                    na = self._require_numeric(arg, "format int")
+                    fb, fl = S.format_i64(self._as_i64(na), width=width,
+                                          pad_zero=zero)
+                    if width > 0 and not zero:
+                        fb, fl = S.pad_left(fb, fl, width, " ")
+                    part = CV(t=T.STR, sbytes=fb, slen=fl)
+                elif kind == "d":
+                    raise NotCompilable("format d of non-int")
+                else:
+                    part = self._to_str(arg)
+                    if width > 0:
+                        # Python left-aligns strings; zero flag fills right
+                        pb, pl = self._to_strpair(part)
+                        fb, fl = S.pad_right(pb, pl, width,
+                                             "0" if zero else " ")
+                        part = CV(t=T.STR, sbytes=fb, slen=fl)
+            else:
+                part = const_cv(piece)
+            out = part if out is None else self._str_concat(out, part)
+        return out if out is not None else const_cv("")
+
     def _to_str(self, v: CV) -> CV:
         if v.is_const:
             return const_cv(str(v.const))
         if v.base is T.STR:
             return v
-        if v.base is T.I64 or v.base is T.BOOL:
+        if v.base is T.BOOL:
+            v2 = self._require_numeric(v, "str()")
+            tb, tl = S.broadcast_const("True", self.ctx.b)
+            fb2, fl2 = S.broadcast_const("False", self.ctx.b)
+            tb, fb2 = S._pad_common(tb, fb2)
+            sb = jnp.where(v2.data[:, None], tb, fb2)
+            sl = jnp.where(v2.data, tl, fl2)
+            return CV(t=T.STR, sbytes=sb.astype(jnp.uint8),
+                      slen=sl.astype(jnp.int32))
+        if v.base is T.I64:
             v = self._require_numeric(v, "str()")
             fb, fl = S.format_i64(self._as_i64(v))
             return CV(t=T.STR, sbytes=fb, slen=fl)
@@ -733,6 +807,10 @@ class Frame:
         if name == "title":
             fb, fl = S.title(rb, rl)
             return CV(t=T.STR, sbytes=fb, slen=fl)
+        if name == "format":
+            if not (recv.is_const and isinstance(recv.const, str)):
+                raise NotCompilable("format on dynamic string")
+            return self._format_method(recv.const, args)
         if name == "center":
             raise NotCompilable("str.center")
         if name == "zfill":
@@ -957,6 +1035,11 @@ class Frame:
             if out_t is T.BOOL:
                 return CV(t=T.BOOL, data=res)
             return CV(t=T.F64, data=res)
+        if mod == "string" and name == "capwords":
+            rb, rl = self._to_strpair(args[0])
+            self._ascii_guard(rb, rl)  # unicode whitespace divergence
+            fb, fl = S.capwords(rb, rl)
+            return CV(t=T.STR, sbytes=fb, slen=fl)
         if mod == "math" and name == "pow":
             a = self._require_numeric(args[0], "math.pow")
             b = self._require_numeric(args[1], "math.pow")

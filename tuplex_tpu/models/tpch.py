@@ -1,0 +1,97 @@
+"""TPC-H Q1 / Q6 over lineitem (reference: benchmarks/tpch/Q06, Q19 —
+filter + aggregate pipelines used to compare against Hyper/Weld).
+
+Includes a scale-factor data generator for the lineitem columns these
+queries touch, and pure-python reference implementations for golden checks.
+"""
+
+from __future__ import annotations
+
+import random
+
+LINEITEM_COLUMNS = ["l_quantity", "l_extendedprice", "l_discount", "l_tax",
+                    "l_returnflag", "l_linestatus", "l_shipdate"]
+
+
+def gen_lineitem_rows(n: int, seed: int = 7):
+    rng = random.Random(seed)
+    flags = ["A", "N", "R"]
+    stats = ["F", "O"]
+    rows = []
+    for _ in range(n):
+        rows.append((
+            float(rng.randint(1, 50)),
+            round(rng.uniform(900.0, 105000.0), 2),
+            round(rng.choice([0.0, 0.01, 0.02, 0.03, 0.04, 0.05, 0.06,
+                              0.07, 0.08, 0.09, 0.1]), 2),
+            round(rng.uniform(0.0, 0.08), 2),
+            rng.choice(flags),
+            rng.choice(stats),
+            f"199{rng.randint(2, 8)}-{rng.randint(1, 12):02d}-"
+            f"{rng.randint(1, 28):02d}",
+        ))
+    return rows
+
+
+def generate_csv(path: str, n: int, seed: int = 7) -> str:
+    import csv
+
+    with open(path, "w", newline="") as fp:
+        w = csv.writer(fp)
+        w.writerow(LINEITEM_COLUMNS)
+        for r in gen_lineitem_rows(n, seed):
+            w.writerow(r)
+    return path
+
+
+# --- Q6: revenue from discounted small-quantity shipments -------------------
+
+def q6(ds):
+    """SELECT sum(l_extendedprice * l_discount) WHERE l_shipdate in [1994,
+    1995) AND l_discount in [0.05, 0.07] AND l_quantity < 24."""
+    return (ds
+            .filter(lambda x: x["l_shipdate"] >= "1994-01-01")
+            .filter(lambda x: x["l_shipdate"] < "1995-01-01")
+            .filter(lambda x: 0.05 <= x["l_discount"] <= 0.07)
+            .filter(lambda x: x["l_quantity"] < 24)
+            .aggregate(lambda a, b: a + b,
+                       lambda a, x: a + x["l_extendedprice"] * x["l_discount"],
+                       0.0))
+
+
+def q6_python(rows) -> float:
+    total = 0.0
+    for (qty, price, disc, tax, rf, ls, ship) in rows:
+        if "1994-01-01" <= ship < "1995-01-01" and \
+                0.05 <= disc <= 0.07 and qty < 24:
+            total += price * disc
+    return total
+
+
+# --- Q1: pricing summary report ---------------------------------------------
+
+def q1(ds):
+    """Grouped sums by (returnflag, linestatus) for l_shipdate <= cutoff."""
+    return (ds
+            .filter(lambda x: x["l_shipdate"] <= "1998-09-02")
+            .aggregateByKey(
+                lambda a, b: (a[0] + b[0], a[1] + b[1], a[2] + b[2],
+                              a[3] + b[3]),
+                lambda a, x: (a[0] + x["l_quantity"],
+                              a[1] + x["l_extendedprice"],
+                              a[2] + x["l_extendedprice"] *
+                              (1 - x["l_discount"]),
+                              a[3] + 1),
+                (0.0, 0.0, 0.0, 0),
+                ["l_returnflag", "l_linestatus"]))
+
+
+def q1_python(rows) -> dict:
+    groups: dict = {}
+    for (qty, price, disc, tax, rf, ls, ship) in rows:
+        if ship <= "1998-09-02":
+            k = (rf, ls)
+            a = groups.get(k, (0.0, 0.0, 0.0, 0))
+            groups[k] = (a[0] + qty, a[1] + price,
+                         a[2] + price * (1 - disc), a[3] + 1)
+    return groups

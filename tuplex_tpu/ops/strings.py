@@ -434,7 +434,22 @@ def parse_f64(bytes_, lens):
     n_exp_digits = jnp.where(has_e, sl - exp_start, 1)
     bad = bad | (has_e & (n_exp_digits <= 0))
     exp_val = jnp.where(exp_neg, -exp_val, exp_val)
-    val = mant * jnp.power(10.0, exp_val - scale)
+    # correctly-rounded decimal->binary for the common case: the integer
+    # mantissa is exact (< 2^53) and 10^|e| is exact for |e| <= 22, so ONE
+    # f64 multiply or divide yields the same bits as CPython's strtod
+    # (the classic Gay fast path). |e| > 22 falls back to powers (rare in
+    # data files; tiny ulp error possible there).
+    e = exp_val - scale
+    small = jnp.abs(e) <= 22.0
+    # exact powers of ten via lookup (jnp.power lowers to exp*log and is NOT
+    # exact even for integer exponents)
+    p10 = jnp.asarray(np.array([10.0 ** k for k in range(23)],
+                               dtype=np.float64))
+    abs_e = jnp.clip(jnp.abs(e), 0.0, 22.0).astype(jnp.int32)
+    pow_abs = jnp.take(p10, abs_e)
+    val_small = jnp.where(e >= 0, mant * pow_abs, mant / pow_abs)
+    val_big = mant * jnp.power(10.0, e)
+    val = jnp.where(small, val_small, val_big)
     val = jnp.where(neg, -val, val)
     return val, bad
 
@@ -618,3 +633,48 @@ def non_ascii_rows(bytes_, lens):
     rows must take the interpreter path (normal-case violation)."""
     inside = _pos_mask(bytes_.shape[1], lens)
     return jnp.any(inside & (bytes_ >= 128), axis=1)
+
+
+def capwords(bytes_, lens):
+    """string.capwords(s): split on whitespace, capitalize each word, join
+    with single spaces (collapses runs + strips ends)."""
+    n, w = bytes_.shape
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    inside = pos < lens[:, None]
+    ws = _is_space(bytes_) & inside
+    nonws = ~ws & inside
+    # capitalize: lower everything, upper at word starts
+    prev_nonws = jnp.pad(nonws[:, :-1], ((0, 0), (1, 0)))
+    word_start = nonws & ~prev_nonws
+    lb, _ = lower(bytes_, lens)
+    is_lo = (lb >= 97) & (lb <= 122)
+    cased = jnp.where(word_start & is_lo, lb - 32, lb)
+    # keep: all non-ws bytes, plus ONE space between words (a ws byte whose
+    # previous kept char is non-ws and which has a non-ws later)
+    nonws_after = jnp.flip(jnp.cumsum(jnp.flip(nonws, 1), axis=1), 1) - nonws
+    sep = ws & prev_nonws & (nonws_after > 0)
+    keep = nonws | sep
+    out_char = jnp.where(sep, 32, cased)
+    contrib = keep.astype(jnp.int32)
+    out_start = jnp.cumsum(contrib, axis=1) - contrib
+    out_len = jnp.sum(contrib, axis=1).astype(jnp.int32)
+    out = jnp.zeros((n, w), dtype=jnp.uint8)
+    rows = jnp.arange(n)[:, None]
+    tgt = jnp.where(keep, out_start, w)
+    out = _scatter_cols(out, rows, tgt, out_char, w)
+    return out.astype(jnp.uint8), out_len
+
+
+def pad_right(bytes_, lens, width: int, fillchar: str = " "):
+    """Left-align into a field of `width` (str.ljust / '{:5}' on strings)."""
+    n, w = bytes_.shape
+    wout = max(w, width)
+    fill = const_bytes(fillchar)[0]
+    out_len = jnp.maximum(lens, width)
+    if wout > w:
+        bytes_ = jnp.pad(bytes_, ((0, 0), (0, wout - w)))
+    pos = jnp.arange(wout, dtype=jnp.int32)[None, :]
+    in_pad = (pos >= lens[:, None]) & (pos < out_len[:, None])
+    out = jnp.where(in_pad, fill, bytes_)
+    inside = pos < out_len[:, None]
+    return jnp.where(inside, out, 0).astype(jnp.uint8), out_len.astype(jnp.int32)

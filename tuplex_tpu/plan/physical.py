@@ -45,6 +45,8 @@ class TransformStage:
         self.output_schema = last.schema()
         self.output_columns = last.columns()
 
+    force_interpret = False   # set on segments around non-compilable ops
+
     def key(self) -> str:
         """Cache key for the jit'd executable: operator chain + UDF sources +
         captured globals + input schema (specialization contract of the
@@ -74,6 +76,9 @@ class TransformStage:
                if not isinstance(op, (L.ResolveOperator, L.IgnoreOperator,
                                       L.TakeOperator))]
         out_schema = self.output_schema
+
+        if self.force_interpret:
+            raise NotCompilable("stage segment forced to interpreter")
 
         def fn(arrays: dict):
             b = arrays["#rowvalid"].shape[0]
@@ -280,4 +285,110 @@ def plan_stages(sink: L.LogicalOperator):
                                      input_op=cur_input_op))
     elif stages:
         stages[-1].limit = limit
-    return stages
+    # segment each transform stage so one non-compilable UDF doesn't sink
+    # the whole fused pipeline to the interpreter
+    out: list = []
+    for st in stages:
+        if isinstance(st, TransformStage):
+            out.extend(segment_stage(st))
+        else:
+            out.append(st)
+    return out
+
+
+def op_compiles(op: L.LogicalOperator, input_schema: T.RowType) -> bool:
+    """Abstract-trace ONE operator against its input schema (tiny shapes,
+    jax.eval_shape: no device work) — False if the emitter rejects it."""
+    if isinstance(op, (L.ResolveOperator, L.IgnoreOperator, L.TakeOperator)):
+        return True
+    from ..runtime.columns import flatten_type
+    from ..runtime.jaxcfg import jax
+    import numpy as np
+
+    arrays: dict = {"#rowvalid": jax.ShapeDtypeStruct((8,), np.bool_)}
+    for ci, ct in enumerate(input_schema.types):
+        for path, lt in flatten_type(ct, str(ci)):
+            base = lt.without_option() if lt.is_optional() else lt
+            opt = lt.is_optional()
+            if path.endswith("#opt"):
+                arrays[path] = jax.ShapeDtypeStruct((8,), np.bool_)
+                continue
+            if base is T.STR:
+                arrays[path + "#bytes"] = jax.ShapeDtypeStruct((8, 8), np.uint8)
+                arrays[path + "#len"] = jax.ShapeDtypeStruct((8,), np.int32)
+            elif base in (T.BOOL,):
+                arrays[path] = jax.ShapeDtypeStruct((8,), np.bool_)
+            elif base is T.I64:
+                arrays[path] = jax.ShapeDtypeStruct((8,), np.int64)
+            elif base is T.F64:
+                arrays[path] = jax.ShapeDtypeStruct((8,), np.float64)
+            elif base in (T.NULL, T.EMPTYTUPLE):
+                pass
+            else:
+                return False
+            if opt and not path.endswith("#opt"):
+                arrays[path + "#valid"] = jax.ShapeDtypeStruct((8,), np.bool_)
+
+    probe = TransformStage(None, [op], input_schema=input_schema,
+                           input_op=op)
+    # input_op=op is wrong for schema purposes; build fn against the given
+    # input schema directly
+    probe.input_schema = input_schema
+    fn = probe.build_device_fn()
+    try:
+        jax.eval_shape(fn, arrays)
+        return True
+    except NotCompilable:
+        return False
+    except Exception:
+        # any other trace failure: treat as non-compilable (interpreter is
+        # always correct)
+        return False
+
+
+def segment_stage(stage: TransformStage) -> list:
+    """Split a fused stage at non-compilable operators: maximal compilable
+    runs stay fused on device; runs of bad operators become interpreter
+    segments. Resolvers/ignores ride with the run of the op they guard."""
+    if not stage.ops:
+        return [stage]
+    flags: list = []          # True=compilable, False=not, None=passthrough
+    schemas_before: list[T.RowType] = []
+    schema = stage.input_schema
+    for op in stage.ops:
+        schemas_before.append(schema)
+        if isinstance(op, (L.ResolveOperator, L.IgnoreOperator)):
+            flags.append(None)
+        else:
+            flags.append(op_compiles(op, schema))
+            schema = op.schema()
+    if all(f is not False for f in flags):
+        return [stage]
+
+    runs: list[list] = []     # [start_idx, [ops], bad]
+    for i, (op, ok) in enumerate(zip(stage.ops, flags)):
+        if ok is None and runs:
+            runs[-1][1].append(op)
+            continue
+        bad = ok is False
+        if runs and runs[-1][2] == bad:
+            runs[-1][1].append(op)
+        else:
+            runs.append([i, [op], bad])
+
+    segments: list[TransformStage] = []
+    for j, (start, ops_run, bad) in enumerate(runs):
+        if j == 0:
+            seg = TransformStage(
+                stage.source, ops_run,
+                input_schema=None if stage.source is not None
+                else stage.input_schema,
+                input_op=None if stage.source is not None else ops_run[0])
+        else:
+            seg = TransformStage(None, ops_run,
+                                 input_schema=schemas_before[start],
+                                 input_op=ops_run[0])
+        seg.force_interpret = bad
+        segments.append(seg)
+    segments[-1].limit = stage.limit
+    return segments

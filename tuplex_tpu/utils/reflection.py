@@ -48,39 +48,86 @@ def _code_fingerprint(code: types.CodeType) -> tuple:
     return (code.co_code, consts, code.co_names, code.co_varnames[: code.co_argcount])
 
 
-def _find_lambda_node(tree: ast.AST, func: types.FunctionType) -> ast.Lambda | None:
-    """Pick the lambda node matching `func` when a line holds several, by
-    compiling each candidate and comparing bytecode fingerprints (reference:
-    source_vault disambiguates via code-object comparison,
-    python/tuplex/utils/source_vault.py:129)."""
-    lambdas = [n for n in ast.walk(tree) if isinstance(n, ast.Lambda)]
-    if not lambdas:
+def _loose_fingerprint(code: types.CodeType) -> tuple:
+    """Location/closure-insensitive signature: referenced names + constant
+    pool. Detects truncated extraction without false-failing closure lambdas
+    (which compile with LOAD_GLOBAL instead of LOAD_DEREF in isolation)."""
+    names: set = set(code.co_names) | set(code.co_freevars)
+    consts: set = set()
+
+    def walk(c: types.CodeType):
+        for k in c.co_consts:
+            if isinstance(k, types.CodeType):
+                names.update(k.co_names)
+                names.update(k.co_freevars)
+                walk(k)
+            elif isinstance(k, (int, float, str, bytes, bool)) or k is None:
+                consts.add(k)
+
+    walk(code)
+    return (code.co_varnames[: code.co_argcount], frozenset(names),
+            frozenset(consts))
+
+
+def _extract_lambda(func: types.FunctionType) -> ast.Lambda | None:
+    """Locate the lambda's full source by scanning file lines from its first
+    line, extending until a parse yields a lambda whose fingerprint matches
+    the live code object. Returns None when no trustworthy source exists —
+    the UDF then runs interpreter-only, which is always correct."""
+    try:
+        lines, lnum = inspect.findsource(func)
+    except (OSError, TypeError):
         return None
-    if len(lambdas) == 1:
-        return lambdas[0]
-    want = _code_fingerprint(func.__code__)
-    matched: list[ast.Lambda] = []
-    for n in lambdas:
-        try:
-            expr = ast.Expression(body=n)
-            ast.fix_missing_locations(expr)
-            compiled = compile(expr, "<udf>", "eval")
-            lam_code = next(
-                c for c in compiled.co_consts if isinstance(c, types.CodeType)
-            )
-            if _code_fingerprint(lam_code) == want:
-                matched.append(n)
-        except (SyntaxError, ValueError, StopIteration):
+    want_exact = _code_fingerprint(func.__code__)
+    want_loose = _loose_fingerprint(func.__code__)
+    loose_hits: dict[str, ast.Lambda] = {}  # unparse -> node
+    max_end = min(lnum + 40, len(lines))
+    for end in range(lnum + 1, max_end + 1):
+        frag = textwrap.dedent("".join(lines[lnum:end])).strip()
+        if not frag:
             continue
-    if matched:
-        return matched[0]  # identical fingerprints => identical behavior
-    # last resort: argument-name match, then position order
-    want_args = func.__code__.co_varnames[: func.__code__.co_argcount]
-    pool = [
-        n for n in lambdas if tuple(a.arg for a in n.args.args) == tuple(want_args)
-    ] or lambdas
-    pool.sort(key=lambda n: (n.lineno, n.col_offset))
-    return pool[0]
+        candidates = [frag]
+        # inside a call the fragment may carry unbalanced trailing closers
+        t = frag
+        for _ in range(4):
+            t = t.rstrip().rstrip(",")
+            if t.endswith((")", "]", "}")):
+                t = t[:-1]
+            candidates.append("(" + t + ")")
+        candidates.append("(" + frag + ")")
+        for cand in candidates:
+            try:
+                mod = ast.parse(cand)
+            except SyntaxError:
+                continue
+            for n in ast.walk(mod):
+                if not isinstance(n, ast.Lambda):
+                    continue
+                fp = _node_fingerprint(n, _code_fingerprint)
+                if fp is None:
+                    continue
+                if fp == want_exact:
+                    return n
+                if _node_fingerprint(n, _loose_fingerprint) == want_loose:
+                    loose_hits.setdefault(ast.unparse(n), n)
+    if len(loose_hits) == 1:
+        return next(iter(loose_hits.values()))
+    # zero or AMBIGUOUS loose matches (e.g. two closure lambdas sharing a
+    # name/const set): no trustworthy source -> interpreter-only
+    return None
+
+
+def _node_fingerprint(node: ast.Lambda, fp_fn) -> tuple | None:
+    """Compile a candidate lambda node and fingerprint its code object."""
+    try:
+        expr = ast.Expression(body=node)
+        ast.fix_missing_locations(expr)
+        compiled = compile(expr, "<udf>", "eval")
+        lam = next(c for c in compiled.co_consts
+                   if isinstance(c, types.CodeType))
+        return fp_fn(lam)
+    except (SyntaxError, ValueError, StopIteration):
+        return None
 
 
 def get_udf_source(func: Callable) -> UDFSource:
@@ -94,45 +141,30 @@ def get_udf_source(func: Callable) -> UDFSource:
                                kw_defaults=[], defaults=[]),
             body=ast.Constant(value=None)), {}, getattr(func, "__name__", "<callable>"))
 
-    try:
-        raw = inspect.getsource(func)
-    except (OSError, TypeError):
-        raw = ""
-
     tree_node: ast.AST | None = None
-    source = raw
-    if raw:
-        dedented = textwrap.dedent(raw)
+    source = ""
+    if func.__name__ == "<lambda>":
+        # inspect.getsource truncates multi-line lambdas to their first line;
+        # read the file ourselves and extend until the bytecode fingerprint
+        # matches the live function (reference analog: source_vault's
+        # code-object comparison)
+        tree_node = _extract_lambda(func)
+        if tree_node is not None:
+            source = ast.unparse(tree_node)
+    else:
         try:
-            mod = ast.parse(dedented)
-        except SyntaxError:
-            # e.g. source slice starts mid-expression: `.map(lambda x: x)` —
-            # retry after trimming to the first `lambda`/`def`
-            for kw in ("lambda", "def "):
-                idx = dedented.find(kw)
-                if idx >= 0:
-                    frag = dedented[idx:].rstrip()
-                    while frag:
-                        try:
-                            mod = ast.parse(frag)
-                            break
-                        except SyntaxError:
-                            frag = frag[:-1]
-                    else:
-                        mod = None
-                    if mod is not None:
-                        break
-            else:
+            raw = inspect.getsource(func)
+        except (OSError, TypeError):
+            raw = ""
+        if raw:
+            try:
+                mod = ast.parse(textwrap.dedent(raw))
+            except SyntaxError:
                 mod = None
-        if mod is not None:
-            if func.__name__ == "<lambda>":
-                tree_node = _find_lambda_node(mod, func)
-                if tree_node is not None:
-                    source = ast.unparse(tree_node)
-            else:
+            if mod is not None:
                 for n in ast.walk(mod):
-                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
-                            n.name == func.__name__:
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                            and n.name == func.__name__:
                         tree_node = n
                         source = ast.unparse(n)
                         break
