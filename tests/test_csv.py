@@ -143,3 +143,29 @@ def test_type_hints(ctx, csvdir):
 
     ds = ctx.csv(path, type_hints={0: T.option(T.F64)})
     assert ds.collect() == [1.0, 2.0]
+
+
+def test_select_by_index_with_pushdown(ctx, csvdir):
+    # regression: int selections must survive projection pruning
+    path = write(csvdir / "pi.csv", "a,b,junk\n1,x,9\n2,y,8\n")
+    assert ctx.csv(path).selectColumns([0, -2]).collect() == [(1, "x"), (2, "y")]
+
+
+def test_pushdown_with_segmentation(ctx, csvdir):
+    # review regression: segmentation must inherit the pruned projection
+    import re as _re
+
+    path = write(csvdir / "seg.csv", "a,b,c\n1,100,7\n2,200,8\n3,300,9\n")
+    ds = (ctx.csv(path)
+          .withColumn("d", lambda x: x["a"] + x["c"])
+          .filter(lambda x: _re.match("x", "y") is None)   # not compilable
+          .selectColumns(["a", "c", "d"]))
+    assert ds.collect() == [(1, 7, 8), (2, 8, 10), (3, 9, 12)]
+
+
+def test_pushdown_keeps_map_resolver_columns(ctx, csvdir):
+    path = write(csvdir / "res.csv", "a,b\n1,10\n0,20\n3,30\n")
+    ds = (ctx.csv(path)
+          .map(lambda x: 100 // x["a"])
+          .resolve(ZeroDivisionError, lambda x: x["b"]))
+    assert ds.collect() == [100, 20, 33]

@@ -59,3 +59,33 @@ def test_flights_pipeline(ctx, tmp_path):
                     (flights.OUTPUT_COLS[ci], a, b)
             else:
                 assert a == b, (flights.OUTPUT_COLS[ci], a, b)
+
+
+def test_logs_strip_pipeline(ctx, tmp_path):
+    from tuplex_tpu.models import logs
+
+    path = str(tmp_path / "access.log")
+    logs.generate_log(path, 500, seed=6)
+    got = logs.build_pipeline(ctx.text(path), "strip").collect()
+    want = logs.run_reference_python(path, "strip")
+    assert got == want
+
+
+def test_logs_regex_pipeline_interpreted(ctx, tmp_path):
+    from tuplex_tpu.models import logs
+
+    path = str(tmp_path / "access2.log")
+    logs.generate_log(path, 120, seed=9)
+    got = logs.build_pipeline(ctx.text(path), "regex").collect()
+    want = logs.run_reference_python(path, "regex")
+    assert got == want
+
+
+def test_nyc311_pipeline(ctx, tmp_path):
+    from tuplex_tpu.models import nyc311
+
+    path = str(tmp_path / "sr.csv")
+    nyc311.generate_csv(path, 400, seed=3)
+    got = nyc311.build_pipeline(ctx, path).collect()
+    want = nyc311.run_reference_python(path)
+    assert got == want

@@ -159,10 +159,11 @@ class DataSet:
             all_exceptions.extend(result.exceptions)
             self._context.metrics.record_stage(result.metrics)
         self._last_exceptions = all_exceptions
+        from ..runtime.columns import partition_to_pylist
+
         out = []
         for p in partitions or []:
-            for r in p.iter_rows():
-                out.append(r.unwrap())
+            out.extend(partition_to_pylist(p))
         if limit >= 0:
             out = out[:limit]
         return out
@@ -182,7 +183,15 @@ def _source_partitions(context, stage):
             parts.append(C.build_partition(chunk, schema, start_index=off))
         return C.harmonize_partitions(parts)
     if hasattr(src, "load_partitions"):
-        return C.harmonize_partitions(src.load_partitions(context))
+        import inspect
+
+        proj = getattr(stage, "source_projection", None)
+        sig = inspect.signature(src.load_partitions)
+        if "projection" in sig.parameters:
+            parts = src.load_partitions(context, proj)
+        else:
+            parts = src.load_partitions(context)
+        return C.harmonize_partitions(parts)
     raise TuplexException(f"unknown source {src!r}")
 
 

@@ -239,6 +239,17 @@ class Frame:
     def eval_Tuple(self, node: ast.Tuple) -> CV:
         return tuple_cv([self.eval(e) for e in node.elts])
 
+    def eval_Dict(self, node: ast.Dict) -> CV:
+        # string-keyed dict literals become named rows (reference: map with
+        # dict output keeps column names, MapOperator.cc)
+        keys = []
+        for k in node.keys:
+            if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                raise NotCompilable("dict literal with non-str-const keys")
+            keys.append(k.value)
+        vals = [self.eval(v) for v in node.values]
+        return tuple_cv(vals, names=keys)
+
     def eval_BinOp(self, node: ast.BinOp) -> CV:
         left = self.eval(node.left)
         right = self.eval(node.right)
@@ -689,6 +700,9 @@ class Frame:
                                              "0" if zero else " ")
                         part = CV(t=T.STR, sbytes=fb, slen=fl)
             else:
+                if "{" in piece or "}" in piece:
+                    # CPython raises ValueError on single braces
+                    raise NotCompilable("single brace in format string")
                 part = const_cv(piece)
             out = part if out is None else self._str_concat(out, part)
         return out if out is not None else const_cv("")

@@ -77,25 +77,28 @@ class AggregateExecutor:
     def _unique(self, op, partitions):
         """Distinct rows, first-occurrence order. Vectorized per partition
         via structured-view np.unique; cross-partition merge via host set."""
-        seen: set = set()
+        seen_sig: set = set()
+        seen_val: set = set()
         out_rows: list = []
-        cols = None
         for part in partitions:
-            cols = part.user_columns
             sig = _row_signatures(part)
             for i in range(part.num_rows):
-                key = sig[i] if sig is not None else None
-                if key is None or i in part.fallback:
-                    row = part.decode_row(i)
-                    try:
-                        key = tuple(row.values)
-                    except TypeError:
-                        out_rows.append(row)
-                        continue
-                if key in seen:
+                s = sig[i] if sig is not None and i not in part.fallback \
+                    else None
+                if s is not None and s in seen_sig:
                     continue
-                seen.add(key)
-                out_rows.append(part.decode_row(i))
+                row = part.decode_row(i)
+                try:
+                    key = tuple(row.values)
+                except TypeError:
+                    out_rows.append(row)  # unhashable: keep (reference keeps
+                    continue              # such rows in the backup dict)
+                if s is not None:
+                    seen_sig.add(s)
+                if key in seen_val:
+                    continue
+                seen_val.add(key)
+                out_rows.append(row)
         schema = op.schema()
         values = [r.unwrap() if len(schema.columns) == 1 else tuple(r.values)
                   for r in out_rows]
@@ -292,20 +295,30 @@ def _real_mask(part: C.Partition) -> np.ndarray:
 
 def _row_signatures(part: C.Partition) -> Optional[np.ndarray]:
     """[N] array of hashable per-row signatures (bytes), or None if the
-    partition has non-vectorizable leaves."""
+    partition has non-vectorizable leaves. Invalid (None) slots are zeroed so
+    every None has ONE canonical signature regardless of placeholder bytes."""
     pieces = []
+    n = part.num_rows
     for path in sorted(part.leaves):
         leaf = part.leaves[path]
         if isinstance(leaf, C.NumericLeaf):
+            data = leaf.data
+            if leaf.valid is not None:
+                data = np.where(leaf.valid, data, 0)
             pieces.append(np.ascontiguousarray(
-                leaf.data.reshape(part.num_rows, -1)).view(np.uint8).reshape(
-                    part.num_rows, -1))
+                data.reshape(n, -1)).view(np.uint8).reshape(n, -1))
             if leaf.valid is not None:
                 pieces.append(leaf.valid.reshape(-1, 1).view(np.uint8))
         elif isinstance(leaf, C.StrLeaf):
-            pieces.append(leaf.bytes)
-            pieces.append(leaf.lengths.astype("<i4").view(np.uint8).reshape(
-                part.num_rows, -1))
+            b, ln = leaf.bytes, leaf.lengths
+            if leaf.valid is not None:
+                b = np.where(leaf.valid[:, None], b, 0)
+                ln = np.where(leaf.valid, ln, 0)
+            # zero padding past len (stage outputs may carry stale bytes)
+            w = b.shape[1]
+            b = np.where(np.arange(w)[None, :] < ln[:, None], b, 0)
+            pieces.append(b)
+            pieces.append(ln.astype("<i4").view(np.uint8).reshape(n, -1))
             if leaf.valid is not None:
                 pieces.append(leaf.valid.reshape(-1, 1).view(np.uint8))
         elif isinstance(leaf, C.NullLeaf):
@@ -315,8 +328,7 @@ def _row_signatures(part: C.Partition) -> Optional[np.ndarray]:
     if not pieces:
         return None
     mat = np.ascontiguousarray(np.concatenate(pieces, axis=1))
-    return np.asarray([mat[i].tobytes() for i in range(part.num_rows)],
-                      dtype=object)
+    return np.asarray([mat[i].tobytes() for i in range(n)], dtype=object)
 
 
 def _factorize_keys(part: C.Partition, kidx: list[int], ok_mask: np.ndarray):

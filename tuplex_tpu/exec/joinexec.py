@@ -94,11 +94,16 @@ class JoinExecutor:
         build: dict = {}
         for rp in rparts:
             rk = rp.schema.columns.index(op.right_column)
-            for r in rp.iter_rows():
+            single = len(rp.schema.columns) == 1
+            for vals in C.partition_to_pylist(rp):
+                row_vals = (vals,) if single else vals
                 try:
-                    build.setdefault(r.values[rk], []).append(r)
-                except (TypeError, IndexError):
-                    pass  # unhashable/short build row: unreachable by probe
+                    if not isinstance(row_vals, tuple) or \
+                            rk >= len(row_vals):
+                        continue
+                    build.setdefault(row_vals[rk], []).append(row_vals)
+                except TypeError:
+                    pass  # unhashable build key: unreachable by probe
         return build
 
     def _probe_partition(self, op, lpart: C.Partition,
@@ -116,22 +121,23 @@ class JoinExecutor:
         rkk = (rparts[0].schema.columns.index(op.right_column) if rparts
                else op.right.schema().columns.index(op.right_column))
         values = []
-        for r in lpart.iter_rows():
+        single = len(ls.columns) == 1
+        empty_right = (None,) * (rs_cols_n - 1)
+        for vals in C.partition_to_pylist(lpart):
+            row_vals = (vals,) if single else vals
             try:
-                key = r.values[lk]
-                lvals = [v for i, v in enumerate(r.values) if i != lk]
+                key = row_vals[lk]
+                lvals = [v for i, v in enumerate(row_vals) if i != lk]
                 matches = build.get(key, []) if _hashable(key) else []
             except Exception as e:
-                excs.append(ExceptionRecord(op.id, type(e).__name__,
-                                            r.unwrap()))
+                excs.append(ExceptionRecord(op.id, type(e).__name__, vals))
                 continue
             if matches:
                 for m in matches:
-                    rvals = [v for i, v in enumerate(m.values) if i != rkk]
+                    rvals = [v for i, v in enumerate(m) if i != rkk]
                     values.append(tuple(lvals + [key] + rvals))
             elif op.how == "left":
-                values.append(tuple(lvals + [key] +
-                                    [None] * (rs_cols_n - 1)))
+                values.append(tuple(lvals) + (key,) + empty_right)
         schema = op.schema()
         if not values:
             return C.Partition(schema=schema, num_rows=0, leaves={},

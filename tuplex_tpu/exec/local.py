@@ -111,10 +111,12 @@ class LocalBackend:
         metrics: dict[str, Any] = {"fast_path_s": 0.0, "slow_path_s": 0.0,
                                    "compile_s": 0.0}
         device_fn = None
-        skey = stage.key()
-        if not self.interpret_only and skey not in self._not_compilable:
+        in_schema = partitions[0].schema if partitions else None
+        skey = stage.key() + "/" + (in_schema.name if in_schema else "")
+        if not self.interpret_only and skey not in self._not_compilable \
+                and in_schema is not None:
             try:
-                raw_fn = stage.build_device_fn()
+                raw_fn = stage.build_device_fn(in_schema)
                 device_fn = self.jit_cache.get_or_build(
                     ("stagefn", skey), lambda: self._jit_stage_fn(raw_fn))
             except NotCompilable:
@@ -222,17 +224,25 @@ class LocalBackend:
         m = len(emit_rows)
 
         if not out_arrays:
-            # interpreter-only: build straight from python rows
+            # interpreter-only: build straight from python rows. Schema
+            # derives from the RUNTIME rows (their column names/types), not
+            # sample speculation — projection/segmentation may have changed
+            # the shape.
             values = [row.unwrap() if len(row.values) == 1
                       else tuple(row.values)
                       for (_, _, row) in emit_rows]
-            schema = _normalized_output_schema(stage)
+            rows_only = [row for (_, _, row) in emit_rows]
+            schema = _schema_from_rows(rows_only) or \
+                _normalized_output_schema(stage)
             outp = C.build_partition(values, schema,
                                      start_index=part.start_index)
             return outp
 
+        from ..plan.physical import runtime_output_columns
+
+        out_cols = runtime_output_columns(part.schema, stage.ops)
         full = C.partition_from_result_arrays(
-            out_arrays, n, columns=stage.output_columns,
+            out_arrays, n, columns=out_cols,
             start_index=part.start_index)
         comp_out = np.asarray([k for k, (_, src, _) in enumerate(emit_rows)
                                if src is not None], dtype=np.int64)
@@ -256,6 +266,26 @@ class LocalBackend:
             outp.normal_mask = normal_mask
             outp.fallback = fallback
         return outp
+
+
+def _schema_from_rows(rows: list[Row]) -> Optional[T.RowType]:
+    """Normal-case schema speculated from actual interpreter-produced rows."""
+    rows = [r for r in rows if r is not None]
+    if not rows:
+        return None
+    k = len(rows[0].values)
+    if any(len(r.values) != k for r in rows):
+        return None
+    cols = rows[0].columns
+    if cols is None or len(cols) != k:
+        cols = tuple(f"_{i}" for i in range(k))
+    types = []
+    for ci in range(k):
+        nc, _, _ = T.normal_case_type([r.values[ci] for r in rows])
+        if nc is T.UNKNOWN:
+            return None
+        types.append(nc)
+    return T.row_of(cols, types)
 
 
 def _normalized_output_schema(stage: TransformStage) -> T.RowType:
@@ -394,8 +424,13 @@ def _apply_op_python(op: L.LogicalOperator, row: Row) -> Optional[Row]:
         vals[ci] = op.udf.func(vals[ci])
         return Row(vals, row.columns)
     if isinstance(op, L.SelectColumnsOperator):
-        idx = op._resolve_indices()
         s = op.schema()
+        if row.columns is not None:
+            idx = [list(row.columns).index(c) if isinstance(c, str)
+                   else (c if c >= 0 else len(row.values) + c)
+                   for c in op.selected]
+        else:
+            idx = op._resolve_indices()
         return Row([row.values[i] for i in idx], s.columns)
     if isinstance(op, L.RenameColumnOperator):
         return Row(row.values, op.schema().columns)

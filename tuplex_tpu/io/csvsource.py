@@ -211,16 +211,17 @@ class CSVSourceOperator(L.LogicalOperator):
         return out
 
     # -- bulk read ----------------------------------------------------------
-    def load_partitions(self, context) -> list[C.Partition]:
+    def load_partitions(self, context, projection=None) -> list[C.Partition]:
         parts: list[C.Partition] = []
         offset = 0
         for path in self.files:
-            for p in self._read_file(context, path, offset):
+            for p in self._read_file(context, path, offset, projection):
                 parts.append(p)
                 offset += p.num_rows
         return parts
 
-    def _read_file(self, context, path: str, base_index: int):
+    def _read_file(self, context, path: str, base_index: int,
+                   projection=None):
         import pyarrow as pa
         import pyarrow.csv as pacsv
 
@@ -242,11 +243,16 @@ class CSVSourceOperator(L.LogicalOperator):
             invalid_row_handler=on_invalid)
         conv_opts = pacsv.ConvertOptions(
             column_types={c: pa.string() for c in stat.columns},
+            include_columns=list(projection) if projection else None,
             strings_can_be_null=False)
+        out_columns = list(projection) if projection else stat.columns
+        raw_schema = T.row_of(out_columns,
+                              [T.option(T.STR)] * len(out_columns))
         table = pacsv.read_csv(path, read_options=read_opts,
                                parse_options=parse_opts,
                                convert_options=conv_opts)
-        if stat.has_header and table.column_names != stat.columns:
+        if not projection and stat.has_header and \
+                table.column_names != stat.columns:
             table = table.rename_columns(stat.columns[: table.num_columns])
 
         max_w = context.options_store.get_int("tuplex.tpu.maxStrBytes", 4096)
@@ -256,11 +262,12 @@ class CSVSourceOperator(L.LogicalOperator):
         while start < n:
             m = min(rows_per_part, n - start)
             chunk = table.slice(start, m)
-            yield _table_to_partition(chunk, self._raw_schema, max_w,
+            yield _table_to_partition(chunk, raw_schema, max_w,
                                       base_index + start)
             start += m
         # structurally-invalid rows: re-parse leniently, box as fallback rows
         if bad_rows:
+            proj_idx = [stat.columns.index(c) for c in out_columns]
             vals = []
             for _, text in bad_rows:
                 try:
@@ -268,9 +275,10 @@ class CSVSourceOperator(L.LogicalOperator):
                                                delimiter=stat.delimiter))
                 except Exception:
                     cells = [text]
-                vals.append(tuple(cells))
+                vals.append(tuple(cells[i] if i < len(cells) else None
+                                  for i in proj_idx))
             yield C.build_partition(
-                vals, self._raw_schema, start_index=base_index + n)
+                vals, raw_schema, start_index=base_index + n)
 
 
 def _csv_rows_per_partition(context, table) -> int:
@@ -364,7 +372,7 @@ class TextSourceOperator(L.LogicalOperator):
             self._sample_lines = lines
         return [Row((ln,), None) for ln in self._sample_lines]
 
-    def load_partitions(self, context) -> list[C.Partition]:
+    def load_partitions(self, context, projection=None) -> list[C.Partition]:
         parts = []
         offset = 0
         for f in self.files:

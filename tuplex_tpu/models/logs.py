@@ -1,0 +1,151 @@
+"""Apache-log parsing pipeline (reference: benchmarks/logs/runtuplex.py —
+regex and string-strip parse variants over loglines, endpoint filter).
+
+The strip variant compiles fully to the device (find/slice chains + dict
+row); the regex variant exercises the interpreter path (re.search is outside
+the compiled subset, like the reference's slower generality modes).
+"""
+
+from __future__ import annotations
+
+import random
+import re
+
+COLUMNS = ["ip", "client_id", "user_id", "date", "method", "endpoint",
+           "protocol", "response_code", "content_size"]
+
+
+def ParseWithStrip(x):
+    y = x
+
+    i = y.find(" ")
+    ip = y[:i]
+    y = y[i + 1:]
+
+    i = y.find(" ")
+    client_id = y[:i]
+    y = y[i + 1:]
+
+    i = y.find(" ")
+    user_id = y[:i]
+    y = y[i + 1:]
+
+    i = y.find("]")
+    date = y[:i][1:]
+    y = y[i + 2:]
+
+    y = y[y.find('"') + 1:]
+
+    method = ""
+    endpoint = ""
+    protocol = ""
+    failed = False
+    if y.find(" ") < y.rfind('"'):
+        i = y.find(" ")
+        method = y[:i]
+        y = y[i + 1:]
+
+        i = y.find(" ")
+        endpoint = y[:i]
+        y = y[i + 1:]
+
+        i = y.rfind('"')
+        protocol = y[:i]
+        protocol = protocol[protocol.rfind(" ") + 1:]
+        y = y[i + 2:]
+    else:
+        failed = True
+        i = y.rfind('"')
+        y = y[i + 2:]
+
+    i = y.find(" ")
+    response_code = y[:i]
+    content_size = y[i + 1:]
+
+    if not failed:
+        return {"ip": ip,
+                "client_id": client_id,
+                "user_id": user_id,
+                "date": date,
+                "method": method,
+                "endpoint": endpoint,
+                "protocol": protocol,
+                "response_code": int(response_code),
+                "content_size": 0 if content_size == "-" else
+                int(content_size)}
+    else:
+        return {"ip": "",
+                "client_id": "",
+                "user_id": "",
+                "date": "",
+                "method": "",
+                "endpoint": "",
+                "protocol": "",
+                "response_code": -1,
+                "content_size": -1}
+
+
+def ParseWithRegex(logline):
+    match = re.search(
+        r'^(\S+) (\S+) (\S+) \[([\w:/]+\s[+\-]\d{4})\] "(\S+) (\S+)\s*(\S*)'
+        r'\s*" (\d{3}) (\S+)', logline)
+    if match is None:
+        return {"ip": "", "client_id": "", "user_id": "", "date": "",
+                "method": "", "endpoint": "", "protocol": "",
+                "response_code": -1, "content_size": -1}
+    size_field = match.group(9)
+    size = 0 if size_field == "-" else int(size_field)
+    return {"ip": match.group(1), "client_id": match.group(2),
+            "user_id": match.group(3), "date": match.group(4),
+            "method": match.group(5), "endpoint": match.group(6),
+            "protocol": match.group(7),
+            "response_code": int(match.group(8)), "content_size": size}
+
+
+def build_pipeline(ds, mode: str = "strip"):
+    """reference: runtuplex.py — map(parse).filter(len(endpoint) > 0)."""
+    fn = ParseWithStrip if mode == "strip" else ParseWithRegex
+    return ds.map(fn).filter(lambda x: len(x["endpoint"]) > 0)
+
+
+# ---------------------------------------------------------------------------
+
+_METHODS = ["GET", "POST", "HEAD"]
+_ENDPOINTS = ["/index.html", "/images/logo.gif", "/about", "/~user/page",
+              "/api/v1/items", "/search?q=x"]
+
+
+def gen_logline(rng: random.Random) -> str:
+    if rng.random() < 0.03:   # malformed request line
+        return (f"{rng.randint(1,255)}.{rng.randint(0,255)}.0.1 - - "
+                f"[01/Jul/1995:00:00:0{rng.randint(0,9)} -0400] "
+                f'"garbage" 400 -')
+    ip = f"{rng.randint(1,255)}.{rng.randint(0,255)}.{rng.randint(0,255)}.{rng.randint(1,254)}"
+    size = rng.choice(["-", str(rng.randint(100, 99999))])
+    return (f"{ip} - - [0{rng.randint(1,9)}/Jul/1995:12:{rng.randint(10,59)}:"
+            f"{rng.randint(10,59)} -0400] "
+            f'"{rng.choice(_METHODS)} {rng.choice(_ENDPOINTS)} HTTP/1.0" '
+            f"{rng.choice([200, 200, 200, 304, 404])} {size}")
+
+
+def generate_log(path: str, n: int, seed: int = 17) -> str:
+    rng = random.Random(seed)
+    with open(path, "w") as fp:
+        for _ in range(n):
+            fp.write(gen_logline(rng) + "\n")
+    return path
+
+
+def run_reference_python(path: str, mode: str = "strip") -> list:
+    fn = ParseWithStrip if mode == "strip" else ParseWithRegex
+    out = []
+    with open(path) as fp:
+        for line in fp:
+            line = line.rstrip("\n")
+            try:
+                d = fn(line)
+                if len(d["endpoint"]) > 0:
+                    out.append(tuple(d[c] for c in COLUMNS))
+            except Exception:
+                continue
+    return out

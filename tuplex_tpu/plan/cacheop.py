@@ -80,6 +80,6 @@ class CacheOperator(L.LogicalOperator):
             return out
         return self.parent.sample()
 
-    def load_partitions(self, context) -> list:
+    def load_partitions(self, context, projection=None) -> list:
         self.materialize(context)
         return list(self._partitions or [])

@@ -1,0 +1,149 @@
+"""Logical optimizations: projection pushdown into file sources.
+
+Re-designs the reference's logical optimizer (reference:
+core/src/logical/LogicalPlan.cc — optimizeFilters/projection pushdown via
+ColumnRewriteVisitor; csv.selectionPushdown option): we statically analyze
+which source columns each UDF actually reads (dict-style subscripts with
+constant keys) and prune everything else at the Arrow read — unread columns
+are never parsed, decoded, or shipped to the device.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..core import typesys as T
+from . import logical as L
+
+ALL = None  # sentinel: reads the whole row
+
+
+def udf_read_columns(udf) -> Optional[set[str]]:
+    """Column names a single-param UDF reads via x['col'] subscripts, or ALL
+    if the row escapes (used whole, iterated, multi-param...)."""
+    params = udf.params
+    if len(params) != 1:
+        return ALL
+    p = params[0]
+    if udf.source == "":
+        return ALL
+    reads: set[str] = set()
+    for node in ast.walk(udf.tree):
+        if isinstance(node, ast.Subscript) and \
+                isinstance(node.value, ast.Name) and node.value.id == p:
+            if isinstance(node.slice, ast.Constant) and \
+                    isinstance(node.slice.value, str):
+                reads.add(node.slice.value)
+            else:
+                return ALL
+    # any OTHER use of the param leaks the whole row
+    for node in ast.walk(udf.tree):
+        if isinstance(node, ast.Name) and node.id == p:
+            # find whether this Name is the value of a const-str Subscript
+            pass
+    leaks = _param_leaks(udf.tree, p)
+    return ALL if leaks else reads
+
+
+def _param_leaks(tree: ast.AST, p: str) -> bool:
+    """True if `p` is used anywhere except as `p['const']`."""
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.leak = False
+
+        def visit_Subscript(self, node: ast.Subscript):
+            if isinstance(node.value, ast.Name) and node.value.id == p and \
+                    isinstance(node.slice, ast.Constant) and \
+                    isinstance(node.slice.value, str):
+                self.visit(node.slice)
+                return  # safe use; don't descend into node.value
+            self.generic_visit(node)
+
+        def visit_Name(self, node: ast.Name):
+            if node.id == p:
+                self.leak = True
+
+    v = V()
+    v.visit(tree)
+    return v.leak
+
+
+def op_reads(op: L.LogicalOperator, current_columns) -> Optional[set[str]]:
+    """Columns (by their CURRENT names) an operator reads."""
+    if isinstance(op, L.MapColumnOperator):
+        return {op.column}
+    if isinstance(op, (L.MapOperator, L.FilterOperator,
+                       L.WithColumnOperator)):
+        return udf_read_columns(op.udf)
+    if isinstance(op, L.ResolveOperator):
+        return udf_read_columns(op.udf)
+    if isinstance(op, L.SelectColumnsOperator):
+        out = set()
+        for c in op.selected:
+            if isinstance(c, int):
+                if current_columns is None or c >= len(current_columns):
+                    return ALL
+                out.add(current_columns[c])
+            else:
+                out.add(c)
+        return out
+    if isinstance(op, (L.RenameColumnOperator, L.IgnoreOperator,
+                       L.TakeOperator, L.DecodeOperator)):
+        return set()
+    return ALL  # unknown operator: be safe
+
+
+def required_source_columns(source_columns: tuple[str, ...],
+                            ops: list[L.LogicalOperator]) -> Optional[list[str]]:
+    """Minimal subset of source columns the chain needs, in source order;
+    None if the whole row is required somewhere."""
+    alias: dict[str, Optional[str]] = {c: c for c in source_columns}
+    required: set[str] = set()
+    cur_cols: Optional[list[str]] = list(source_columns)
+
+    def add_reads(reads) -> bool:
+        if reads is ALL:
+            return False
+        for r in reads:
+            src = alias.get(r)
+            if src:
+                required.add(src)
+        return True
+
+    for i, op in enumerate(ops):
+        reads = op_reads(op, cur_cols)
+        if not add_reads(reads):
+            return None
+        if isinstance(op, L.MapOperator):
+            # the map consumes the row — but its resolvers receive the
+            # PRE-map row, so account for their reads before stopping
+            j = i + 1
+            while j < len(ops) and isinstance(
+                    ops[j], (L.ResolveOperator, L.IgnoreOperator)):
+                if not add_reads(op_reads(ops[j], cur_cols)):
+                    return None
+                j += 1
+            return [c for c in source_columns if c in required]
+        if isinstance(op, L.WithColumnOperator):
+            alias[op.column] = None  # derived (or overwritten) column
+            if cur_cols is not None and op.column not in cur_cols:
+                cur_cols.append(op.column)
+        elif isinstance(op, L.RenameColumnOperator):
+            old = op.old if isinstance(op.old, str) else (
+                cur_cols[op.old] if cur_cols else None)
+            if old is None:
+                return None
+            alias[op.new] = alias.pop(old, None)
+            if cur_cols is not None:
+                cur_cols = [op.new if c == old else c for c in cur_cols]
+        elif isinstance(op, L.SelectColumnsOperator):
+            sel = []
+            for c in op.selected:
+                sel.append(cur_cols[c] if isinstance(c, int) and cur_cols
+                           else c)
+            alias = {c: alias.get(c) for c in sel}
+            cur_cols = list(sel)
+    # whatever survives to the stage output is needed
+    required |= {s for s in alias.values() if s}
+    return [c for c in source_columns if c in required]

@@ -67,11 +67,16 @@ class TransformStage:
         return h.hexdigest()[:16]
 
     # ------------------------------------------------------------------
-    def build_device_fn(self) -> Callable:
+    def build_device_fn(self, input_schema: Optional[T.RowType] = None
+                        ) -> Callable:
         """The fused fast-path function: staged arrays -> output arrays +
         '#err' + '#keep'. Raises NotCompilable if any fused UDF can't compile
-        (the backend then interprets every row)."""
-        schema = self.input_schema
+        (the backend then interprets every row).
+
+        `input_schema` overrides the planned schema with the RUNTIME schema
+        of the actual partitions (post-breaker/segment stages and projection-
+        pruned sources differ from sample speculation)."""
+        schema = input_schema if input_schema is not None else self.input_schema
         ops = [op for op in self.ops
                if not isinstance(op, (L.ResolveOperator, L.IgnoreOperator,
                                       L.TakeOperator))]
@@ -97,6 +102,40 @@ class TransformStage:
             return outs
 
         return fn
+
+
+def runtime_output_columns(input_schema: T.RowType,
+                           ops: list[L.LogicalOperator]):
+    """Replay the name flow of _emit_op over the RUNTIME input schema (which
+    may be projection-pruned), without tracing. Mirrors _emit_op's names
+    handling exactly."""
+    from ..runtime.columns import user_columns
+
+    names = user_columns(input_schema)
+    for op in ops:
+        if isinstance(op, (L.ResolveOperator, L.IgnoreOperator,
+                           L.TakeOperator)):
+            continue
+        if isinstance(op, L.MapOperator):
+            out_cols = op.columns()
+            names = tuple(out_cols) if out_cols else None
+        elif isinstance(op, L.WithColumnOperator):
+            if names is None:
+                return None
+            if op.column not in names:
+                names = tuple(names) + (op.column,)
+        elif isinstance(op, L.SelectColumnsOperator):
+            names = tuple(op.schema().columns)
+        elif isinstance(op, L.RenameColumnOperator):
+            if names is not None and isinstance(op.old, str) and \
+                    op.old in names:
+                names = tuple(op.new if c == op.old else c for c in names)
+            else:
+                names = op.columns()
+        elif isinstance(op, L.DecodeOperator):
+            names = user_columns(op.declared)
+        # MapColumn keeps names
+    return names
 
 
 def _emit_op(ctx: EmitCtx, op: L.LogicalOperator, row: CV, keep,
@@ -140,7 +179,16 @@ def _emit_op(ctx: EmitCtx, op: L.LogicalOperator, row: CV, keep,
     if isinstance(op, L.SelectColumnsOperator):
         if row.elts is None:
             raise NotCompilable("selectColumns on unnamed row")
-        idx = op._resolve_indices()
+        # resolve against the RUNTIME row names (projection pruning may have
+        # shifted positions relative to the sampled schema)
+        idx = []
+        for c in op.selected:
+            if isinstance(c, int):
+                idx.append(c if c >= 0 else len(row.elts) + c)
+            else:
+                if names is None or c not in names:
+                    raise NotCompilable(f"select: column {c!r} missing")
+                idx.append(list(names).index(c))
         nm = tuple(op.schema().columns)
         return tuple_cv([row.elts[i] for i in idx], names=nm), keep, nm
     if isinstance(op, L.RenameColumnOperator):
@@ -285,6 +333,10 @@ def plan_stages(sink: L.LogicalOperator):
                                      input_op=cur_input_op))
     elif stages:
         stages[-1].limit = limit
+    # projection pushdown into file sources (reference: csv.selectionPushdown)
+    for st in stages:
+        if isinstance(st, TransformStage):
+            _apply_projection(st)
     # segment each transform stage so one non-compilable UDF doesn't sink
     # the whole fused pipeline to the interpreter
     out: list = []
@@ -294,6 +346,41 @@ def plan_stages(sink: L.LogicalOperator):
         else:
             out.append(st)
     return out
+
+
+def _apply_projection(stage: TransformStage) -> None:
+    """Prune unread columns at the Arrow read: unread columns are never
+    parsed, decoded, or staged to HBM."""
+    from ..io.csvsource import CSVSourceOperator
+    from .optimizer import required_source_columns
+
+    src = stage.source
+    if not isinstance(src, CSVSourceOperator):
+        return
+    req = required_source_columns(tuple(src.stat.columns), stage.ops)
+    if req is None or len(req) >= len(src.stat.columns):
+        return
+    stage.source_projection = list(req)
+    # prune the fused decode + the stage input schema to the projection;
+    # integer selections resolve to NAMES first (positions shift when
+    # columns are pruned)
+    new_ops = []
+    for op in stage.ops:
+        if isinstance(op, L.DecodeOperator) and op.parent is src:
+            keep_idx = [src.stat.columns.index(c) for c in req]
+            declared = T.row_of(req, [op.declared.types[i] for i in keep_idx])
+            pruned = L.DecodeOperator(src, declared, op.null_values)
+            new_ops.append(pruned)
+        elif isinstance(op, L.SelectColumnsOperator) and \
+                any(isinstance(c, int) for c in op.selected):
+            full_cols = op.parent.schema().columns
+            names = [full_cols[c] if isinstance(c, int) else c
+                     for c in op.selected]
+            new_ops.append(L.SelectColumnsOperator(op.parent, names))
+        else:
+            new_ops.append(op)
+    stage.ops = new_ops
+    stage.input_schema = T.row_of(req, [T.option(T.STR)] * len(req))
 
 
 def op_compiles(op: L.LogicalOperator, input_schema: T.RowType) -> bool:
@@ -379,11 +466,15 @@ def segment_stage(stage: TransformStage) -> list:
     segments: list[TransformStage] = []
     for j, (start, ops_run, bad) in enumerate(runs):
         if j == 0:
+            # inherit the (possibly projection-pruned) input schema and the
+            # source projection — rebuilding from source.schema() would undo
+            # the pushdown and misalign positional decode
             seg = TransformStage(
                 stage.source, ops_run,
-                input_schema=None if stage.source is not None
-                else stage.input_schema,
+                input_schema=stage.input_schema,
                 input_op=None if stage.source is not None else ops_run[0])
+            if hasattr(stage, "source_projection"):
+                seg.source_projection = stage.source_projection
         else:
             seg = TransformStage(None, ops_run,
                                  input_schema=schemas_before[start],

@@ -86,15 +86,26 @@ def _extract_lambda(func: types.FunctionType) -> ast.Lambda | None:
         frag = textwrap.dedent("".join(lines[lnum:end])).strip()
         if not frag:
             continue
-        candidates = [frag]
-        # inside a call the fragment may carry unbalanced trailing closers
-        t = frag
-        for _ in range(4):
-            t = t.rstrip().rstrip(",")
-            if t.endswith((")", "]", "}")):
-                t = t[:-1]
-            candidates.append("(" + t + ")")
-        candidates.append("(" + frag + ")")
+        base_frags = [frag]
+        li = frag.find("lambda")
+        if li > 0:
+            # fragment starts mid-expression (".filter(lambda ...)"): anchor
+            # at the lambda keyword; wrong cuts are fingerprint-rejected
+            base_frags.append(frag[li:])
+        candidates = []
+        for bf in base_frags:
+            candidates.append(bf)
+            candidates.append("(" + bf + ")")
+            # trailing unbalanced closers from the enclosing call
+            t = bf
+            for _ in range(6):
+                t = t.rstrip().rstrip(",")
+                if t and t[-1] in ")]}":
+                    t = t[:-1]
+                else:
+                    break
+                candidates.append(t)
+                candidates.append("(" + t + ")")
         for cand in candidates:
             try:
                 mod = ast.parse(cand)
