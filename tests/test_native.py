@@ -1,0 +1,49 @@
+"""Native fast-transfer kernels vs pure-python encode (parity + fallback)."""
+
+import numpy as np
+import pytest
+
+from tuplex_tpu.core import typesys as T
+from tuplex_tpu.runtime import columns as C
+
+
+def _roundtrip(values, schema):
+    p = C.build_partition(values, schema)
+    return [r.unwrap() for r in p.iter_rows()], p
+
+
+def test_native_module_builds():
+    from tuplex_tpu.native import get
+
+    nat = get()
+    if nat is None:
+        pytest.skip("no compiler available")
+    data, valid, bad = nat.encode_i64([1, 2, None, "x", True, 2**70])
+    assert np.frombuffer(data, np.int64)[:2].tolist() == [1, 2]
+    assert list(valid) == [1, 1, 0, 1, 1, 1]
+    assert bad == [3, 4, 5]  # str, bool (not exact int), overflow
+
+
+def test_native_python_parity(monkeypatch):
+    from tuplex_tpu import native as N
+
+    vals = [(1, "a", 1.5, True), (None, None, None, None),
+            ("bad", "b", 2.5, False), (3, "日本", 0.0, True),
+            "not-a-tuple", (5, "e", 1.0, False, 99)]
+    schema = T.row_of(["i", "s", "f", "b"],
+                      [T.option(T.I64), T.option(T.STR),
+                       T.option(T.F64), T.option(T.BOOL)])
+    fast_rows, fast_p = _roundtrip(vals, schema)
+
+    monkeypatch.setattr(N, "_mod", None)
+    monkeypatch.setattr(N, "_tried", True)  # forces python path
+    slow_rows, slow_p = _roundtrip(vals, schema)
+    assert fast_rows == slow_rows
+    assert set(fast_p.fallback) == set(slow_p.fallback)
+
+
+def test_native_non_option_none_is_fallback():
+    schema = T.row_of(["x"], [T.I64])
+    rows, p = _roundtrip([1, None, 3], schema)
+    assert rows == [1, None, 3]
+    assert 1 in p.fallback

@@ -1,0 +1,76 @@
+"""Native host-runtime kernels: lazy-compiled CPython extension.
+
+The reference's native layer (reference: tuplex/runtime + the pybind'd fast
+transfer of PythonContext.cc) becomes a small C++ extension compiled on
+first use with the system toolchain and cached next to the source; every
+entry point has a pure-python fallback so the framework works without a
+compiler.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import sysconfig
+from typing import Optional
+
+_mod = None
+_tried = False
+
+
+def _build_dir() -> str:
+    d = os.path.join(os.path.dirname(__file__), "_build")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _compile() -> Optional[str]:
+    src = os.path.join(os.path.dirname(__file__), "src", "fasttransfer.cpp")
+    with open(src, "rb") as fp:
+        tag = hashlib.sha256(fp.read()).hexdigest()[:12]
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    out = os.path.join(_build_dir(), f"_tuplex_native_{tag}{suffix}")
+    if os.path.exists(out):
+        return out
+    include = sysconfig.get_paths()["include"]
+    cxx = os.environ.get("CXX", "g++")
+    tmp = out + f".tmp{os.getpid()}"
+    cmd = [cxx, "-O2", "-shared", "-fPIC", "-std=c++17",
+           f"-I{include}", src, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)  # atomic: killed/concurrent builds can't leave
+        return out            # a truncated .so at the cached path
+    except (subprocess.CalledProcessError, FileNotFoundError,
+            subprocess.TimeoutExpired):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def get() -> Optional[object]:
+    """The compiled module, or None when unavailable (python fallback)."""
+    global _mod, _tried
+    if _mod is not None or _tried:
+        return _mod
+    _tried = True
+    if os.environ.get("TUPLEX_TPU_NO_NATIVE"):
+        return None
+    path = _compile()
+    if path is None:
+        return None
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("_tuplex_native", path)
+    if spec is None or spec.loader is None:
+        return None
+    try:
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _mod = mod
+    except Exception:
+        _mod = None
+    return _mod

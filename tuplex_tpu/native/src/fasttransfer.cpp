@@ -1,0 +1,244 @@
+// Fast python<->columnar transfer kernels (CPython C API).
+//
+// The native analog of the reference's PythonContext fast paths
+// (reference: tuplex/python/src/PythonContext.cc:823-919 —
+// fastI64Parallelize / fastMixedSimpleTypeTupleTransfer / strDictParallelize:
+// typed bulk conversion of python lists into partition buffers, with
+// non-conforming elements routed to fallback). Here each column of a
+// parallelize()/join-output batch is encoded by one C loop instead of a
+// per-row python loop; buffers are returned as python `bytes` that numpy
+// wraps zero-copy via np.frombuffer.
+//
+// Exposed module: _tuplex_native
+//   encode_i64(list)  -> (data_bytes,  valid_bytes, bad_index_list)
+//   encode_f64(list)  -> (data_bytes,  valid_bytes, bad_index_list)
+//   encode_bool(list) -> (data_bytes,  valid_bytes, bad_index_list)
+//   encode_str(list)  -> (mat_bytes, lens_bytes, valid_bytes, width,
+//                         bad_index_list)
+//   decode_str(mat_bytes, lens_bytes, width, n) -> list[str]
+//
+// "bad" = element whose type doesn't conform (including bool where int is
+// expected — python bool is an int subtype but the type lattice separates
+// them); None is VALID (valid=0) since Option columns carry a validity mask.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct EncodedCommon {
+  PyObject *valid_bytes = nullptr;
+  PyObject *bad_list = nullptr;
+};
+
+static bool alloc_common(Py_ssize_t n, EncodedCommon &out) {
+  out.valid_bytes = PyBytes_FromStringAndSize(nullptr, n);
+  out.bad_list = PyList_New(0);
+  return out.valid_bytes && out.bad_list;
+}
+
+static PyObject *encode_i64(PyObject *, PyObject *arg) {
+  if (!PyList_Check(arg)) {
+    PyErr_SetString(PyExc_TypeError, "expected list");
+    return nullptr;
+  }
+  Py_ssize_t n = PyList_GET_SIZE(arg);
+  PyObject *data = PyBytes_FromStringAndSize(nullptr, n * 8);
+  EncodedCommon c;
+  if (!data || !alloc_common(n, c)) return nullptr;
+  int64_t *d = reinterpret_cast<int64_t *>(PyBytes_AS_STRING(data));
+  char *v = PyBytes_AS_STRING(c.valid_bytes);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *o = PyList_GET_ITEM(arg, i);
+    if (o == Py_None) {
+      d[i] = 0;
+      v[i] = 0;
+      continue;
+    }
+    if (PyLong_Check(o) && !PyBool_Check(o)) {
+      int overflow = 0;
+      long long val = PyLong_AsLongLongAndOverflow(o, &overflow);
+      if (!overflow) {
+        d[i] = static_cast<int64_t>(val);
+        v[i] = 1;
+        continue;
+      }
+    }
+    d[i] = 0;
+    v[i] = 1;  // slot unusable; caller boxes the row
+    PyObject *idx = PyLong_FromSsize_t(i);
+    PyList_Append(c.bad_list, idx);
+    Py_DECREF(idx);
+  }
+  return Py_BuildValue("(NNN)", data, c.valid_bytes, c.bad_list);
+}
+
+static PyObject *encode_f64(PyObject *, PyObject *arg) {
+  if (!PyList_Check(arg)) {
+    PyErr_SetString(PyExc_TypeError, "expected list");
+    return nullptr;
+  }
+  Py_ssize_t n = PyList_GET_SIZE(arg);
+  PyObject *data = PyBytes_FromStringAndSize(nullptr, n * 8);
+  EncodedCommon c;
+  if (!data || !alloc_common(n, c)) return nullptr;
+  double *d = reinterpret_cast<double *>(PyBytes_AS_STRING(data));
+  char *v = PyBytes_AS_STRING(c.valid_bytes);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *o = PyList_GET_ITEM(arg, i);
+    if (o == Py_None) {
+      d[i] = 0.0;
+      v[i] = 0;
+      continue;
+    }
+    if (PyFloat_Check(o)) {
+      d[i] = PyFloat_AS_DOUBLE(o);
+      v[i] = 1;
+      continue;
+    }
+    d[i] = 0.0;
+    v[i] = 1;
+    PyObject *idx = PyLong_FromSsize_t(i);
+    PyList_Append(c.bad_list, idx);
+    Py_DECREF(idx);
+  }
+  return Py_BuildValue("(NNN)", data, c.valid_bytes, c.bad_list);
+}
+
+static PyObject *encode_bool(PyObject *, PyObject *arg) {
+  if (!PyList_Check(arg)) {
+    PyErr_SetString(PyExc_TypeError, "expected list");
+    return nullptr;
+  }
+  Py_ssize_t n = PyList_GET_SIZE(arg);
+  PyObject *data = PyBytes_FromStringAndSize(nullptr, n);
+  EncodedCommon c;
+  if (!data || !alloc_common(n, c)) return nullptr;
+  char *d = PyBytes_AS_STRING(data);
+  char *v = PyBytes_AS_STRING(c.valid_bytes);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *o = PyList_GET_ITEM(arg, i);
+    if (o == Py_None) {
+      d[i] = 0;
+      v[i] = 0;
+      continue;
+    }
+    if (PyBool_Check(o)) {
+      d[i] = (o == Py_True) ? 1 : 0;
+      v[i] = 1;
+      continue;
+    }
+    d[i] = 0;
+    v[i] = 1;
+    PyObject *idx = PyLong_FromSsize_t(i);
+    PyList_Append(c.bad_list, idx);
+    Py_DECREF(idx);
+  }
+  return Py_BuildValue("(NNN)", data, c.valid_bytes, c.bad_list);
+}
+
+static PyObject *encode_str(PyObject *, PyObject *arg) {
+  if (!PyList_Check(arg)) {
+    PyErr_SetString(PyExc_TypeError, "expected list");
+    return nullptr;
+  }
+  Py_ssize_t n = PyList_GET_SIZE(arg);
+  // pass 1: utf8 views + max width
+  std::vector<const char *> ptrs(static_cast<size_t>(n), nullptr);
+  std::vector<Py_ssize_t> lens(static_cast<size_t>(n), 0);
+  std::vector<Py_ssize_t> bad;
+  Py_ssize_t w = 1;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *o = PyList_GET_ITEM(arg, i);
+    if (o == Py_None) continue;
+    if (PyUnicode_Check(o)) {
+      Py_ssize_t sz = 0;
+      const char *u = PyUnicode_AsUTF8AndSize(o, &sz);
+      if (u) {
+        ptrs[static_cast<size_t>(i)] = u;
+        lens[static_cast<size_t>(i)] = sz;
+        if (sz > w) w = sz;
+        continue;
+      }
+      PyErr_Clear();
+    }
+    bad.push_back(i);
+  }
+  PyObject *mat = PyBytes_FromStringAndSize(nullptr, n * w);
+  PyObject *lens_b = PyBytes_FromStringAndSize(nullptr, n * 4);
+  PyObject *valid_b = PyBytes_FromStringAndSize(nullptr, n);
+  PyObject *bad_list = PyList_New(0);
+  if (!mat || !lens_b || !valid_b || !bad_list) return nullptr;
+  char *m = PyBytes_AS_STRING(mat);
+  int32_t *lp = reinterpret_cast<int32_t *>(PyBytes_AS_STRING(lens_b));
+  char *v = PyBytes_AS_STRING(valid_b);
+  memset(m, 0, static_cast<size_t>(n * w));
+  for (Py_ssize_t i = 0; i < n; i++) {
+    const char *u = ptrs[static_cast<size_t>(i)];
+    if (u) {
+      memcpy(m + i * w, u, static_cast<size_t>(lens[static_cast<size_t>(i)]));
+      lp[i] = static_cast<int32_t>(lens[static_cast<size_t>(i)]);
+      v[i] = 1;
+    } else {
+      lp[i] = 0;
+      v[i] = 0;
+    }
+  }
+  for (Py_ssize_t i : bad) {
+    v[i] = 1;  // not a None: row must be boxed by the caller
+    PyObject *idx = PyLong_FromSsize_t(i);
+    PyList_Append(bad_list, idx);
+    Py_DECREF(idx);
+  }
+  return Py_BuildValue("(NNNnN)", mat, lens_b, valid_b, w, bad_list);
+}
+
+static PyObject *decode_str(PyObject *, PyObject *args) {
+  PyObject *mat_obj, *lens_obj;
+  Py_ssize_t w, n;
+  if (!PyArg_ParseTuple(args, "SSnn", &mat_obj, &lens_obj, &w, &n))
+    return nullptr;
+  const char *m = PyBytes_AS_STRING(mat_obj);
+  const int32_t *lp =
+      reinterpret_cast<const int32_t *>(PyBytes_AS_STRING(lens_obj));
+  if (PyBytes_GET_SIZE(mat_obj) < n * w ||
+      PyBytes_GET_SIZE(lens_obj) < n * 4) {
+    PyErr_SetString(PyExc_ValueError, "buffer too small");
+    return nullptr;
+  }
+  PyObject *out = PyList_New(n);
+  if (!out) return nullptr;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    int32_t li = lp[i];
+    if (li < 0) li = 0;
+    if (li > w) li = static_cast<int32_t>(w);
+    PyObject *s =
+        PyUnicode_DecodeUTF8(m + i * w, li, "replace");
+    if (!s) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, i, s);
+  }
+  return out;
+}
+
+static PyMethodDef Methods[] = {
+    {"encode_i64", encode_i64, METH_O, "bulk encode int column"},
+    {"encode_f64", encode_f64, METH_O, "bulk encode float column"},
+    {"encode_bool", encode_bool, METH_O, "bulk encode bool column"},
+    {"encode_str", encode_str, METH_O, "bulk encode str column"},
+    {"decode_str", decode_str, METH_VARARGS, "bulk decode str column"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static struct PyModuleDef Module = {PyModuleDef_HEAD_INIT, "_tuplex_native",
+                                    "native host runtime kernels", -1,
+                                    Methods};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__tuplex_native(void) { return PyModule_Create(&Module); }

@@ -304,6 +304,9 @@ def build_partition(
     placeholder slots and are boxed into `fallback` (reference: fallback
     partitions of pickled objects, PythonContext.cc:617 parallelizeAnyType).
     """
+    fast = _fast_partition(values, schema, start_index)
+    if fast is not None:
+        return fast
     n = len(values)
     # row value shape: single column -> bare value; multi -> tuple
     multi = len(schema.columns) > 1
@@ -671,6 +674,17 @@ def _leaf_to_pylist(leaf: Leaf, n: int) -> list:
     # StrLeaf: one flat buffer + byte slicing beats per-row np indexing
     w = leaf.bytes.shape[1] if leaf.bytes.ndim == 2 else 1
     flat = np.ascontiguousarray(leaf.bytes[:n]).tobytes()
+    from ..native import get as _native_get
+
+    nat = _native_get()
+    if nat is not None:
+        lens_b = np.ascontiguousarray(
+            leaf.lengths[:n].astype(np.int32)).tobytes()
+        decoded = nat.decode_str(flat, lens_b, w, n)
+        if leaf.valid is not None:
+            vv = leaf.valid[:n].tolist()
+            return [decoded[i] if vv[i] else None for i in range(n)]
+        return decoded
     lens = leaf.lengths[:n].tolist()
     if leaf.valid is not None:
         vv = leaf.valid[:n].tolist()
@@ -728,3 +742,96 @@ def _column_pylist(part: Partition, path: str, t: T.Type, n: int) -> list:
             return [() if leaf.valid[i] else None for i in range(n)]
         return [()] * n
     return _leaf_to_pylist(part.leaves[path], n)
+
+
+# ---------------------------------------------------------------------------
+# native fast transfer (reference: PythonContext.cc fast paths)
+# ---------------------------------------------------------------------------
+
+def _fast_partition(values: Sequence[Any], schema: T.RowType,
+                    start_index: int) -> Optional[Partition]:
+    """C-kernel bulk encode for flat primitive schemas; None if the schema
+    or the native module isn't eligible (generic python path then runs)."""
+    from ..native import get as native_get
+
+    nat = native_get()
+    if nat is None:
+        return None
+    kinds = []
+    for t in schema.types:
+        base = t.without_option() if t.is_optional() else t
+        if base is T.I64:
+            kinds.append(("i64", t.is_optional()))
+        elif base is T.F64:
+            kinds.append(("f64", t.is_optional()))
+        elif base is T.BOOL:
+            kinds.append(("bool", t.is_optional()))
+        elif base is T.STR:
+            kinds.append(("str", t.is_optional()))
+        else:
+            return None
+    n = len(values)
+    k = len(kinds)
+    multi = k > 1
+
+    # split rows into per-column python lists (C-speed zip for clean rows)
+    bad_rows: set[int] = set()
+    if multi:
+        clean = True
+        for v in values:
+            if not (type(v) is tuple and len(v) == k):
+                clean = False
+                break
+        if clean:
+            cols = [list(c) for c in zip(*values)] if n else [[] for _ in kinds]
+        else:
+            cols = [[None] * n for _ in range(k)]
+            for i, v in enumerate(values):
+                if type(v) is tuple and len(v) == k:
+                    for ci in range(k):
+                        cols[ci][i] = v[ci]
+                else:
+                    bad_rows.add(i)
+    else:
+        cols = [[v[0] if type(v) is tuple and len(v) == 1 else v
+                 for v in values]]
+
+    leaves: dict[str, Leaf] = {}
+    for ci, (kind, opt) in enumerate(kinds):
+        col = cols[ci]
+        if kind == "str":
+            mat_b, lens_b, valid_b, w, bad = nat.encode_str(col)
+            mat = np.frombuffer(mat_b, dtype=np.uint8).reshape(n, w).copy() \
+                if n else np.zeros((0, max(w, 1)), np.uint8)
+            lens = np.frombuffer(lens_b, dtype=np.int32).copy()
+            valid = np.frombuffer(valid_b, dtype=np.uint8).astype(np.bool_)
+            leaves[str(ci)] = StrLeaf(mat, lens,
+                                      valid.copy() if opt else None)
+        else:
+            enc = {"i64": nat.encode_i64, "f64": nat.encode_f64,
+                   "bool": nat.encode_bool}[kind]
+            data_b, valid_b, bad = enc(col)
+            dtype = {"i64": np.int64, "f64": np.float64,
+                     "bool": np.uint8}[kind]
+            data = np.frombuffer(data_b, dtype=dtype).copy()
+            if kind == "bool":
+                data = data.astype(np.bool_)
+            valid = np.frombuffer(valid_b, dtype=np.uint8).astype(np.bool_)
+            leaves[str(ci)] = NumericLeaf(data,
+                                          valid.copy() if opt else None)
+        bad_rows.update(bad)
+        if not opt:
+            # None in a non-Option column deviates from the normal case
+            bad_rows.update(np.nonzero(~valid)[0].tolist())
+
+    part = Partition(schema=schema, num_rows=n, leaves=leaves,
+                     start_index=start_index)
+    if bad_rows:
+        mask = np.ones(n, dtype=np.bool_)
+        fallback = {}
+        for i in sorted(bad_rows):
+            mask[i] = False
+            fallback[i] = values[i]
+        part.normal_mask = mask
+        part.fallback = fallback
+    return part
