@@ -1,0 +1,119 @@
+"""Auxiliary subsystems: spill, ORC, history recorder, signals
+(reference: LargerThanMemoryDataSet.cc, CacheTest.cc, SignalTest.cc,
+test/io ORC round trips, webui tests)."""
+
+import json
+import os
+
+import pytest
+
+
+def test_orc_roundtrip(ctx, tmp_path):
+    pytest.importorskip("pyarrow.orc")
+    p = str(tmp_path / "t.orc")
+    data = [(1, "a", 2.5), (2, "b", None), (3, "c", 4.5)]
+    ctx.parallelize(data, columns=["i", "s", "f"]).toorc(p)
+    ds = ctx.orc(p)
+    assert ds.columns == ["i", "s", "f"]
+    assert ds.collect() == data
+    assert ds.map(lambda x: x["i"] * 2).collect() == [2, 4, 6]
+
+
+def test_spill_larger_than_memory(tmp_path):
+    import tuplex_tpu
+
+    c = tuplex_tpu.Context({
+        "tuplex.executorMemory": "200KB",
+        "tuplex.partitionSize": "64KB",
+        "tuplex.scratchDir": str(tmp_path),
+    })
+    data = list(range(100000))
+    res = c.parallelize(data).map(lambda x: x + 1).collect()
+    assert res == [x + 1 for x in data]
+    mm = c.backend.mm
+    assert mm.swap_out_count > 0, "expected partitions to spill"
+    assert mm.swap_in_count > 0
+
+
+def test_history_recorder(tmp_path):
+    import tuplex_tpu
+    from tuplex_tpu.history import render_report
+
+    c = tuplex_tpu.Context({"tuplex.webui.enable": True,
+                            "tuplex.logDir": str(tmp_path)})
+    ds = c.parallelize([1, 0, 2]).map(lambda x: 10 // x)
+    ds.collect()
+    hist = tmp_path / "tuplex_history.jsonl"
+    recs = [json.loads(l) for l in hist.read_text().splitlines()]
+    events = [r["event"] for r in recs]
+    assert "job_start" in events and "stage" in events and "job_done" in events
+    done = [r for r in recs if r["event"] == "job_done"][-1]
+    assert done["exception_counts"] == {"ZeroDivisionError": 1}
+    out = render_report(str(tmp_path))
+    assert os.path.exists(out)
+    assert "tuplex_tpu job history" in open(out).read()
+
+
+def test_sigint_between_partitions(tmp_path):
+    import tuplex_tpu
+    from tuplex_tpu.utils import signals
+
+    c = tuplex_tpu.Context({"tuplex.partitionSize": "4KB"})
+    ds = c.parallelize(list(range(20000))).map(lambda x: x * 2)
+    # simulate SIGINT arriving mid-job
+    orig = signals.check_interrupted
+    calls = {"n": 0}
+
+    def fake_check():
+        calls["n"] += 1
+        if calls["n"] == 3:
+            signals._state.requested = True
+        orig()
+
+    signals_check = signals.check_interrupted
+    try:
+        signals.check_interrupted = fake_check
+        import tuplex_tpu.exec.local as XL
+
+        with pytest.raises(KeyboardInterrupt):
+            ds.collect()
+    finally:
+        signals.check_interrupted = signals_check
+
+
+def test_spill_through_aggregate_and_join(tmp_path):
+    # review regression: agg/join executors must swap spilled partitions in
+    import tuplex_tpu
+
+    c = tuplex_tpu.Context({
+        "tuplex.executorMemory": "64KB",
+        "tuplex.partitionSize": "32KB",
+        "tuplex.scratchDir": str(tmp_path),
+    })
+    data = [(i % 7, i) for i in range(30000)]
+    ds = c.parallelize(data, columns=["k", "v"]).aggregateByKey(
+        lambda a, b: a + b, lambda a, r: a + r["v"], 0, ["k"])
+    got = dict(ds.collect())
+    want: dict = {}
+    for k, v in data:
+        want[k] = want.get(k, 0) + v
+    assert got == want
+
+    left = c.parallelize(data[:5000], columns=["k", "v"])
+    right = c.parallelize([(i, f"r{i}") for i in range(7)],
+                          columns=["k", "name"])
+    joined = left.join(right, "k", "k").collect()
+    assert len(joined) == 5000
+
+
+def test_per_stage_swap_metrics_are_deltas(tmp_path):
+    import tuplex_tpu
+
+    c = tuplex_tpu.Context({"tuplex.executorMemory": "64KB",
+                            "tuplex.partitionSize": "32KB",
+                            "tuplex.scratchDir": str(tmp_path)})
+    c.parallelize(list(range(50000))).map(lambda x: x + 1).collect()
+    c.parallelize([1, 2, 3]).map(lambda x: x).collect()
+    last = [m for m in c.metrics.stages if "swap_out" in m][-1]
+    # the tiny second job must not inherit the first job's counters
+    assert last["swap_out"] <= 2

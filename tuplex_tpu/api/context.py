@@ -27,6 +27,15 @@ class Context:
             self.options_store.update(conf)
         self.backend = self._make_backend()
         self.metrics = Metrics()
+        from ..history import JobRecorder
+
+        self.recorder = JobRecorder(
+            self.options_store.get_str("tuplex.logDir", "."),
+            enabled=self.options_store.get_bool("tuplex.webui.enable"))
+        if self.options_store.get_bool("tuplex.redirectToPythonLogging"):
+            from ..utils.logging import redirect_to_python_logging
+
+            redirect_to_python_logging(True)
 
     def _make_backend(self):
         name = self.options_store.get_str("tuplex.backend", "local")
@@ -87,6 +96,13 @@ class Context:
         from .dataset import DataSet
 
         return DataSet(self, make_text_operator(self.options_store, pattern))
+
+    def orc(self, pattern: str, columns=None) -> "DataSet":
+        from ..io.orcsource import make_orc_operator
+        from .dataset import DataSet
+
+        return DataSet(self, make_orc_operator(self.options_store, pattern,
+                                               columns=columns))
 
     def options(self) -> dict:
         return self.options_store.as_dict()

@@ -136,6 +136,12 @@ class DataSet:
         rows = self.collect()
         write_csv(path, rows, self.columns)
 
+    def toorc(self, path: str, **kwargs) -> None:
+        from ..io.orcsource import write_orc
+
+        rows = self.collect()
+        write_orc(path, rows, self.columns)
+
     def exception_counts(self) -> dict[str, int]:
         """Counts of unresolved exceptions from the LAST action on this
         dataset chain (reference: dataset.py:707)."""
@@ -146,26 +152,47 @@ class DataSet:
 
     # ------------------------------------------------------------------
     def _execute(self, limit: int):
+        import time as _time
+
+        from ..utils.signals import capture_sigint, check_interrupted
+
+        t_job = _time.perf_counter()
         sink = L.TakeOperator(self._op, limit) if limit >= 0 else self._op
         stages = plan_stages(sink)
         backend = self._context.backend
+        recorder = self._context.recorder
+        recorder.job_started("collect" if limit < 0 else f"take({limit})",
+                             stages)
         partitions = None
         all_exceptions = []
-        for stage in stages:
-            if getattr(stage, "source", None) is not None:
-                partitions = _source_partitions(self._context, stage)
-            result = backend.execute_any(stage, partitions, self._context)
-            partitions = result.partitions
-            all_exceptions.extend(result.exceptions)
-            self._context.metrics.record_stage(result.metrics)
-        self._last_exceptions = all_exceptions
+        try:
+            with capture_sigint():
+                for stage in stages:
+                    check_interrupted()
+                    if getattr(stage, "source", None) is not None:
+                        partitions = _source_partitions(self._context, stage)
+                    result = backend.execute_any(stage, partitions,
+                                                 self._context)
+                    partitions = result.partitions
+                    all_exceptions.extend(result.exceptions)
+                    self._context.metrics.record_stage(result.metrics)
+                    recorder.stage_done(stage, result.metrics,
+                                        result.exceptions)
+        finally:
+            # interrupted jobs must not leave stale per-action state
+            self._last_exceptions = all_exceptions
         from ..runtime.columns import partition_to_pylist
 
         out = []
         for p in partitions or []:
+            self._context.backend.touch_partition(p)
             out.extend(partition_to_pylist(p))
         if limit >= 0:
             out = out[:limit]
+        counts = {}
+        for rec in all_exceptions:
+            counts[rec.exc_name] = counts.get(rec.exc_name, 0) + 1
+        recorder.job_done(len(out), _time.perf_counter() - t_job, counts)
         return out
 
 

@@ -81,6 +81,7 @@ class AggregateExecutor:
         seen_val: set = set()
         out_rows: list = []
         for part in partitions:
+            self.backend.mm.touch(part)
             sig = _row_signatures(part)
             for i in range(part.num_rows):
                 s = sig[i] if sig is not None and i not in part.fallback \
@@ -116,6 +117,7 @@ class AggregateExecutor:
             kidx = [ps.columns.index(c) for c in op.key_columns] if ps else []
             groups: dict = {}
             for part in partitions:
+                self.backend.mm.touch(part)
                 device_ok = spec is not None and self._device_fold_bykey(
                     op, spec, part, kidx, groups, excs)
                 if not device_ok:
@@ -146,6 +148,7 @@ class AggregateExecutor:
 
         groups2: dict = {(): op.initial}
         for part in partitions:
+            self.backend.mm.touch(part)
             done = False
             if spec is not None:
                 partial, bad_rows = self._device_fold(op, spec, part)

@@ -80,7 +80,9 @@ class JoinExecutor:
         build = self._build_table(op, rparts or [])
         out_parts = []
         for part in left_partitions:
+            self.backend.mm.touch(part)
             outp = self._probe_partition(op, part, rparts or [], build, excs)
+            self.backend.mm.register(outp)
             out_parts.append(outp)
         m = {"wall_s": time.perf_counter() - t0,
              "rows_out": sum(p.num_rows for p in out_parts),
@@ -93,6 +95,7 @@ class JoinExecutor:
         caches across actions would probe against old data)."""
         build: dict = {}
         for rp in rparts:
+            self.backend.mm.touch(rp)
             rk = rp.schema.columns.index(op.right_column)
             single = len(rp.schema.columns) == 1
             for vals in C.partition_to_pylist(rp):

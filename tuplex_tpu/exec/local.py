@@ -78,6 +78,14 @@ class LocalBackend:
         self.interpret_only = options.get_bool("tuplex.tpu.interpretOnly")
         self.bucket_mode = options.get_str("tuplex.tpu.padBucketing", "pow2")
         self._not_compilable: set[str] = set()
+        from ..runtime.spill import MemoryManager
+
+        self.mm = MemoryManager(
+            options.get_size("tuplex.executorMemory", 1 << 30),
+            options.get_str("tuplex.scratchDir", "/tmp/tuplex_tpu"))
+
+    def touch_partition(self, part) -> None:
+        self.mm.touch(part)
 
     def _jit_stage_fn(self, raw_fn):
         """Compile a stage fn for dispatch (overridden by MultiHostBackend
@@ -108,6 +116,7 @@ class LocalBackend:
         import jax
 
         t0 = time.perf_counter()
+        mm_snap = self.mm.metrics_snapshot()
         metrics: dict[str, Any] = {"fast_path_s": 0.0, "slow_path_s": 0.0,
                                    "compile_s": 0.0}
         device_fn = None
@@ -128,13 +137,18 @@ class LocalBackend:
         emitted_total = 0
         limit = stage.limit
 
+        from ..utils.signals import check_interrupted
+
         for part in partitions:
+            check_interrupted()
             if limit >= 0 and emitted_total >= limit:
                 break
             if skey in self._not_compilable:
                 device_fn = None
+            self.mm.touch(part)
             outp, excs, m = self._execute_partition(stage, part, device_fn,
                                                     skey)
+            self.mm.register(outp)
             metrics["fast_path_s"] += m.get("fast_path_s", 0.0)
             metrics["slow_path_s"] += m.get("slow_path_s", 0.0)
             exceptions.extend(excs)
@@ -146,6 +160,7 @@ class LocalBackend:
         metrics["wall_s"] = time.perf_counter() - t0
         metrics["rows_out"] = emitted_total
         metrics["exception_rows"] = len(exceptions)
+        metrics.update(self.mm.metrics_delta(mm_snap))
         return StageResult(out_parts, exceptions, metrics)
 
     # ------------------------------------------------------------------

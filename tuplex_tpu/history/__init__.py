@@ -1,0 +1,10 @@
+"""Job history / observability.
+
+Re-designs the reference's history server (reference: tuplex/historyserver —
+Flask+SocketIO+MongoDB; driver posts via HistoryServerConnector.cc:102-198)
+without external services: jobs append JSON-lines records under
+`tuplex.logDir`, and `render_report()` produces a static self-contained HTML
+dashboard. `serve()` exposes it on the webui port via stdlib http.server.
+"""
+
+from .recorder import JobRecorder, render_report, serve  # noqa: F401

@@ -1,0 +1,134 @@
+"""JSON-lines job records + static HTML dashboard."""
+
+from __future__ import annotations
+
+import html
+import json
+import os
+import time
+import uuid
+from typing import Optional
+
+
+class JobRecorder:
+    """Appends job/stage/exception events to <logDir>/tuplex_history.jsonl
+    (reference events: job/stage/task/exception updates, thserver/rest.py)."""
+
+    def __init__(self, log_dir: str, enabled: bool = True):
+        self.enabled = enabled
+        self.path = os.path.join(log_dir or ".", "tuplex_history.jsonl")
+        self.job_id = uuid.uuid4().hex[:12]
+        self._stage_no = 0
+
+    def _write(self, rec: dict) -> None:
+        if not self.enabled:
+            return
+        rec["job"] = self.job_id
+        rec["ts"] = round(time.time(), 3)
+        try:
+            with open(self.path, "a") as fp:
+                fp.write(json.dumps(rec, default=str) + "\n")
+        except OSError:
+            pass
+
+    def job_started(self, action: str, plan: list) -> None:
+        self._write({"event": "job_start", "action": action,
+                     "stages": [type(s).__name__ for s in plan]})
+
+    def stage_done(self, stage, metrics: dict, exceptions: list) -> None:
+        self._stage_no += 1
+        sample = [repr(e)[:200] for e in exceptions[:5]]
+        self._write({"event": "stage", "no": self._stage_no,
+                     "kind": type(stage).__name__,
+                     "metrics": metrics, "exception_sample": sample})
+
+    def job_done(self, rows: int, wall_s: float, exc_counts: dict) -> None:
+        self._write({"event": "job_done", "rows": rows,
+                     "wall_s": round(wall_s, 4),
+                     "exception_counts": exc_counts})
+
+
+def render_report(log_dir: str = ".", out_path: Optional[str] = None) -> str:
+    """Static HTML dashboard over the history file (webui analog)."""
+    src = os.path.join(log_dir or ".", "tuplex_history.jsonl")
+    recs = []
+    if os.path.exists(src):
+        with open(src) as fp:
+            for line in fp:
+                try:
+                    recs.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+    jobs: dict = {}
+    for r in recs:
+        jobs.setdefault(r.get("job", "?"), []).append(r)
+
+    rows_html = []
+    for job_id, events in jobs.items():
+        done = next((e for e in events if e["event"] == "job_done"), {})
+        stages = [e for e in events if e["event"] == "stage"]
+        excs = done.get("exception_counts") or {}
+        fast = sum(e["metrics"].get("fast_path_s", 0) for e in stages)
+        slow = sum(e["metrics"].get("slow_path_s", 0) for e in stages)
+        rows_html.append(
+            f"<tr><td><code>{html.escape(job_id)}</code></td>"
+            f"<td>{len(stages)}</td>"
+            f"<td>{done.get('rows', '—')}</td>"
+            f"<td>{done.get('wall_s', '—')}</td>"
+            f"<td>{fast:.3f}</td><td>{slow:.3f}</td>"
+            f"<td>{html.escape(json.dumps(excs)) if excs else '—'}</td></tr>")
+        for e in stages:
+            for s in e.get("exception_sample", []):
+                rows_html.append(
+                    f"<tr class=exc><td colspan=7>↳ "
+                    f"{html.escape(s)}</td></tr>")
+
+    doc = f"""<!doctype html><meta charset="utf-8">
+<title>tuplex_tpu history</title>
+<style>
+ body {{ font: 14px system-ui, sans-serif; margin: 2rem; color: #1a1a1a; }}
+ table {{ border-collapse: collapse; width: 100%; }}
+ th, td {{ text-align: left; padding: .4rem .7rem;
+           border-bottom: 1px solid #ddd; }}
+ th {{ background: #f5f5f5; }}
+ tr.exc td {{ color: #a33; font-size: 12px; border-bottom: none; }}
+ code {{ background: #f0f0f0; padding: 0 .3em; }}
+</style>
+<h1>tuplex_tpu job history</h1>
+<p>{len(jobs)} job(s) · {html.escape(src)}</p>
+<table>
+<tr><th>job</th><th>stages</th><th>rows out</th><th>wall s</th>
+<th>fast-path s</th><th>slow-path s</th><th>exceptions</th></tr>
+{''.join(rows_html)}
+</table>"""
+    out_path = out_path or os.path.join(log_dir or ".",
+                                        "tuplex_history.html")
+    with open(out_path, "w") as fp:
+        fp.write(doc)
+    return out_path
+
+
+def serve(log_dir: str = ".", port: int = 5000,
+          host: str = "127.0.0.1"):
+    """Serve ONLY the rendered dashboard via stdlib http.server (blocking).
+
+    Binds loopback by default and never exposes the filesystem — every GET
+    re-renders and returns the dashboard document."""
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            out = render_report(log_dir)
+            with open(out, "rb") as fp:
+                body = fp.read()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    with http.server.HTTPServer((host, port), Handler) as srv:
+        srv.serve_forever()

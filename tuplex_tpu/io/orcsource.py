@@ -1,0 +1,197 @@
+"""ORC input/output via Arrow (reference: io/ ORC types + OrcReader;
+dataset.toorc at python/tuplex/dataset.py:554).
+
+ORC files carry types, so unlike CSV there is no sniff/decode stage: columns
+convert straight into typed leaves (nulls become Option)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core import typesys as T
+from ..core.errors import TuplexException
+from ..core.row import Row
+from ..plan import logical as L
+from ..runtime import columns as C
+from .vfs import VirtualFileSystem
+
+
+def _arrow_to_type(at) -> T.Type:
+    import pyarrow as pa
+
+    if pa.types.is_boolean(at):
+        return T.BOOL
+    if pa.types.is_integer(at):
+        return T.I64
+    if pa.types.is_floating(at):
+        return T.F64
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return T.STR
+    return T.PYOBJECT
+
+
+def table_to_partitions(table, max_w: int, rows_per_part: int,
+                        start_index: int = 0) -> list[C.Partition]:
+    """Typed Arrow table -> typed partitions (shared by ORC and future
+    parquet/arrow sources)."""
+    import pyarrow as pa
+
+    cols = table.column_names
+    types: list[T.Type] = []
+    for f in table.schema:
+        base = _arrow_to_type(f.type)
+        col = table.column(f.name)
+        types.append(T.option(base) if col.null_count > 0 and
+                     base is not T.PYOBJECT else base)
+    schema = T.row_of(cols, types)
+    parts: list[C.Partition] = []
+    n = table.num_rows
+    start = 0
+    while start < n or (n == 0 and not parts):
+        m = min(rows_per_part, n - start) if n else 0
+        chunk = table.slice(start, m)
+        leaves: dict[str, C.Leaf] = {}
+        for ci, name in enumerate(cols):
+            arr = chunk.column(ci).combine_chunks()
+            t = types[ci]
+            base = t.without_option() if t.is_optional() else t
+            valid = None
+            if t.is_optional():
+                valid = np.asarray(arr.is_valid())
+            if base is T.STR:
+                sarr = arr.cast(pa.large_string())
+                leaves[str(ci)] = _string_leaf(sarr, m, max_w, valid)
+            elif base in (T.I64, T.F64, T.BOOL):
+                dtype = {T.I64: np.int64, T.F64: np.float64,
+                         T.BOOL: np.bool_}[base]
+                np_arr = np.asarray(
+                    arr.fill_null(0) if valid is not None else arr
+                ).astype(dtype)
+                leaves[str(ci)] = C.NumericLeaf(np_arr, valid)
+            else:
+                leaves[str(ci)] = C.ObjectLeaf(arr.to_pylist())
+        parts.append(C.Partition(schema=schema, num_rows=m, leaves=leaves,
+                                 start_index=start_index + start))
+        if n == 0:
+            break
+        start += m
+    return parts
+
+
+def _string_leaf(arr, n: int, max_w: int, valid) -> C.StrLeaf:
+    buffers = arr.buffers()
+    offsets = np.frombuffer(buffers[1], dtype=np.int64,
+                            count=len(arr) + 1 + arr.offset)[arr.offset:]
+    data = np.frombuffer(buffers[2], dtype=np.uint8) if buffers[2] \
+        else np.zeros(0, np.uint8)
+    starts = offsets[:-1]
+    lens = (offsets[1:] - starts).astype(np.int64)
+    w = int(min(max(int(lens.max()) if n else 1, 1), max_w))
+    idx = starts[:, None] + np.arange(w, dtype=np.int64)[None, :]
+    np.clip(idx, 0, max(len(data) - 1, 0), out=idx)
+    mat = data[idx] if len(data) else np.zeros((n, w), np.uint8)
+    keep = np.arange(w, dtype=np.int64)[None, :] < \
+        np.minimum(lens, w)[:, None]
+    mat = np.where(keep, mat, 0).astype(np.uint8)
+    return C.StrLeaf(mat, np.minimum(lens, w).astype(np.int32), valid)
+
+
+class ORCSourceOperator(L.LogicalOperator):
+    def __init__(self, options, pattern: str, files: list[str],
+                 columns: Optional[Sequence[str]] = None):
+        super().__init__([])
+        self.options = options
+        self.pattern = pattern
+        self.files = files
+        self.user_cols = list(columns) if columns else None
+        self._schema: Optional[T.RowType] = None
+        self._sample: Optional[list[Row]] = None
+
+    def _load_meta(self):
+        if self._schema is not None:
+            return
+        import pyarrow.orc as paorc
+
+        f = paorc.ORCFile(self.files[0])
+        # sample from the first stripe only — never materialize the file
+        # just to plan (reference: sampling reads csv.maxDetectionMemory)
+        try:
+            table = f.read_stripe(0)
+        except Exception:
+            table = f.read()
+        import pyarrow as pa
+
+        if isinstance(table, pa.RecordBatch):
+            table = pa.Table.from_batches([table])
+        max_w = self.options.get_int("tuplex.tpu.maxStrBytes", 4096)
+        parts = table_to_partitions(table.slice(0, min(256, table.num_rows)),
+                                    max_w, 256)
+        schema = parts[0].schema
+        if self.user_cols:
+            schema = T.row_of(self.user_cols, schema.types)
+        self._schema = schema
+        self._sample = []
+        for p in parts[:1]:
+            vals = C.partition_to_pylist(p)
+            cols = C.user_columns(schema)
+            for v in vals[:256]:
+                self._sample.append(Row.from_value(v, cols))
+
+    def schema(self) -> T.RowType:
+        self._load_meta()
+        return self._schema  # type: ignore[return-value]
+
+    def sample(self) -> list[Row]:
+        self._load_meta()
+        return list(self._sample or [])
+
+    def load_partitions(self, context, projection=None) -> list[C.Partition]:
+        import pyarrow.orc as paorc
+
+        max_w = context.options_store.get_int("tuplex.tpu.maxStrBytes", 4096)
+        psize = context.options_store.get_size("tuplex.partitionSize",
+                                               32 << 20)
+        parts: list[C.Partition] = []
+        offset = 0
+        for path in self.files:
+            table = paorc.ORCFile(path).read(
+                columns=list(projection) if projection else None)
+            per_row = max(16, table.nbytes // max(table.num_rows, 1) * 2)
+            rows_pp = max(256, int(psize // per_row))
+            new = table_to_partitions(table, max_w, rows_pp, offset)
+            if self.user_cols:
+                for p in new:
+                    p.schema = T.row_of(self.user_cols, p.schema.types)
+            parts.extend(new)
+            offset += table.num_rows
+        return parts
+
+
+def make_orc_operator(options, pattern: str, columns=None):
+    files = VirtualFileSystem.glob_input(pattern)
+    if not files:
+        raise TuplexException(f"no files match {pattern!r}")
+    return ORCSourceOperator(options, pattern, files, columns)
+
+
+def write_orc(path: str, rows: list, columns: Optional[Sequence[str]] = None
+              ) -> None:
+    import pyarrow as pa
+    import pyarrow.orc as paorc
+
+    import os
+
+    if path.endswith("/") or os.path.isdir(path):
+        os.makedirs(path, exist_ok=True)
+        path = os.path.join(path, "part0.orc")
+    if rows and isinstance(rows[0], tuple):
+        cols = list(zip(*rows)) if rows else []
+        names = list(columns) if columns and len(columns) == len(cols) else \
+            [f"_{i}" for i in range(len(cols))]
+        table = pa.table({n: list(c) for n, c in zip(names, cols)})
+    else:
+        name = columns[0] if columns else "_0"
+        table = pa.table({name: rows})
+    paorc.write_table(table, path)

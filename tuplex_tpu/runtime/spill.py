@@ -1,0 +1,198 @@
+"""Partition spill-to-disk under memory pressure.
+
+Reference semantics: core/include/Partition.h:207-214 swapOut/swapIn +
+Executor.h:179 evictLRUPartition — partitions beyond the executor memory
+budget write their buffers to scratchDir and reload transparently on access.
+
+A Partition's leaves serialize to one .npz file; the MemoryManager tracks
+registered partitions via WEAK references (dropped partitions unregister
+automatically and their spill files are deleted by a finalizer), keeps byte
+accounting incrementally, and evicts LRU past the budget. Host-boxed
+fallback values stay in memory (small by the normal-case contract).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+import weakref
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..utils.logging import get_logger
+from . import columns as C
+
+log = get_logger("spill")
+
+
+def _leaves_to_npz_dict(part: C.Partition) -> dict:
+    out: dict = {}
+    for path, leaf in part.leaves.items():
+        key = path.replace("#", "%23")
+        if isinstance(leaf, C.NumericLeaf):
+            out[f"n!{key}!data"] = leaf.data
+            if leaf.valid is not None:
+                out[f"n!{key}!valid"] = leaf.valid
+        elif isinstance(leaf, C.StrLeaf):
+            out[f"s!{key}!bytes"] = leaf.bytes
+            out[f"s!{key}!len"] = leaf.lengths
+            if leaf.valid is not None:
+                out[f"s!{key}!valid"] = leaf.valid
+        elif isinstance(leaf, C.NullLeaf):
+            out[f"z!{key}!n"] = np.asarray([leaf.n])
+        # ObjectLeaf stays in memory (pickling arbitrary objects not worth it)
+    return out
+
+
+class SpilledPartition:
+    """Disk image of a partition's array leaves."""
+
+    def __init__(self, path: str, obj_leaves: dict):
+        self.path = path
+        self.obj_leaves = obj_leaves  # ObjectLeafs kept live
+
+    def load(self) -> dict:
+        leaves: dict = {}
+        with np.load(self.path) as z:
+            names = set(z.files)
+            seen: set = set()
+            for f in names:
+                kind, key, _ = f.split("!", 2)
+                if key in seen:
+                    continue
+                path = key.replace("%23", "#")
+                if kind == "n":
+                    leaves[path] = C.NumericLeaf(
+                        z[f"n!{key}!data"],
+                        z[f"n!{key}!valid"] if f"n!{key}!valid" in names
+                        else None)
+                elif kind == "s":
+                    leaves[path] = C.StrLeaf(
+                        z[f"s!{key}!bytes"], z[f"s!{key}!len"],
+                        z[f"s!{key}!valid"] if f"s!{key}!valid" in names
+                        else None)
+                elif kind == "z":
+                    leaves[path] = C.NullLeaf(int(z[f"z!{key}!n"][0]))
+                seen.add(key)
+        leaves.update(self.obj_leaves)
+        return leaves
+
+    def delete(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+@dataclass
+class _Entry:
+    ref: "weakref.ref[C.Partition]"
+    nbytes: int        # bytes currently resident (0 while spilled)
+
+
+class MemoryManager:
+    """LRU partition eviction against a byte budget (reference:
+    Executor::evictLRUPartition + BitmapAllocator pressure)."""
+
+    def __init__(self, budget_bytes: int, scratch_dir: str):
+        self.budget = budget_bytes
+        self.scratch = os.path.join(scratch_dir, f"spill-{os.getpid()}")
+        self._entries: "OrderedDict[int, _Entry]" = OrderedDict()
+        self._inmem = 0
+        self._lock = threading.Lock()
+        self.swap_out_count = 0
+        self.swap_in_count = 0
+        self.swapped_bytes = 0
+
+    # ------------------------------------------------------------------
+    def register(self, part: C.Partition) -> None:
+        with self._lock:
+            pid = id(part)
+            if pid in self._entries:
+                self._entries.move_to_end(pid)
+                return
+            nb = part.nbytes()
+
+            def on_dead(_ref, mm=self, key=pid):
+                with mm._lock:
+                    e = mm._entries.pop(key, None)
+                    if e is not None:
+                        mm._inmem -= e.nbytes
+
+            self._entries[pid] = _Entry(weakref.ref(part, on_dead), nb)
+            self._inmem += nb
+            self._evict_locked()
+
+    def touch(self, part: C.Partition) -> None:
+        """Mark recently used; swap back in if spilled."""
+        with self._lock:
+            pid = id(part)
+            if pid in self._entries:
+                # MRU first, so eviction during swap-in can't pick this one
+                self._entries.move_to_end(pid)
+            if getattr(part, "_spilled", None) is not None:
+                self._swap_in_locked(part)
+
+    # ------------------------------------------------------------------
+    def _evict_locked(self) -> None:
+        if self.budget <= 0:
+            return
+        for pid, entry in list(self._entries.items()):
+            if self._inmem <= self.budget:
+                break
+            part = entry.ref()
+            if part is None or entry.nbytes == 0 or \
+                    getattr(part, "_spilled", None) is not None:
+                continue
+            self._swap_out_locked(part, entry)
+
+    def _swap_out_locked(self, part: C.Partition, entry: _Entry) -> None:
+        os.makedirs(self.scratch, exist_ok=True)
+        path = os.path.join(self.scratch, f"p{uuid.uuid4().hex}.npz")
+        arrays = _leaves_to_npz_dict(part)
+        obj = {p: l for p, l in part.leaves.items()
+               if isinstance(l, C.ObjectLeaf)}
+        np.savez(path, **arrays)
+        sp = SpilledPartition(path, obj)
+        self.swap_out_count += 1
+        self.swapped_bytes += entry.nbytes
+        self._inmem -= entry.nbytes
+        entry.nbytes = 0
+        part._spilled = sp  # type: ignore[attr-defined]
+        # orphaned spill files are removed when the partition is GC'd
+        part._spill_fin = weakref.finalize(part, sp.delete)  # type: ignore[attr-defined]
+        part.leaves = {}
+        log.debug("swapped out partition (%d rows) to %s", part.num_rows, path)
+
+    def _swap_in_locked(self, part: C.Partition) -> None:
+        sp = part._spilled  # type: ignore[attr-defined]
+        part.leaves = sp.load()
+        part._spilled = None  # type: ignore[attr-defined]
+        sp.delete()
+        self.swap_in_count += 1
+        entry = self._entries.get(id(part))
+        nb = part.nbytes()
+        if entry is not None:
+            entry.nbytes = nb
+        self._inmem += nb
+        self._evict_locked()
+
+    def ensure_loaded(self, part: C.Partition) -> C.Partition:
+        self.touch(part)
+        return part
+
+    def metrics(self) -> dict:
+        return {"swap_out": self.swap_out_count, "swap_in": self.swap_in_count,
+                "swapped_bytes": self.swapped_bytes}
+
+    def metrics_snapshot(self) -> tuple:
+        return (self.swap_out_count, self.swap_in_count, self.swapped_bytes)
+
+    def metrics_delta(self, snap: tuple) -> dict:
+        return {"swap_out": self.swap_out_count - snap[0],
+                "swap_in": self.swap_in_count - snap[1],
+                "swapped_bytes": self.swapped_bytes - snap[2]}

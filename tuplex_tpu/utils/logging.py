@@ -1,0 +1,56 @@
+"""Logging with per-subsystem handlers + python-logging redirect.
+
+Reference: utils/src/Logger.cc (spdlog, per-subsystem MessageHandlers) and
+tuplex.redirectToPythonLogging (context.py:190-200, PythonCommon.cc).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from typing import Optional
+
+_ROOT = "tuplex_tpu"
+_configured = False
+
+
+def get_logger(subsystem: str = "") -> logging.Logger:
+    global _configured
+    name = f"{_ROOT}.{subsystem}" if subsystem else _ROOT
+    logger = logging.getLogger(name)
+    if not _configured:
+        root = logging.getLogger(_ROOT)
+        if not root.handlers:
+            h = logging.StreamHandler(sys.stderr)
+            h.setFormatter(logging.Formatter(
+                "[%(asctime)s] [%(name)s] [%(levelname)s] %(message)s",
+                datefmt="%H:%M:%S"))
+            root.addHandler(h)
+            root.setLevel(logging.WARNING)
+        _configured = True
+    return logger
+
+
+def redirect_to_python_logging(enable: bool = True) -> None:
+    """With redirect on, messages propagate to the user's root logger
+    unchanged (reference: tuplex.redirectToPythonLogging)."""
+    root = logging.getLogger(_ROOT)
+    root.propagate = bool(enable)
+    for h in list(root.handlers):
+        if enable:
+            root.removeHandler(h)
+
+
+def set_level(level: str) -> None:
+    logging.getLogger(_ROOT).setLevel(level.upper())
+
+
+class Timer:
+    """Scope timer (reference: utils Timer.h)."""
+
+    def __init__(self):
+        self.start = time.perf_counter()
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self.start

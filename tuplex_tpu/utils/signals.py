@@ -1,0 +1,55 @@
+"""Graceful SIGINT handling during job execution.
+
+Reference: core/include/Signals.h:28-43 — SIGINT is captured during a job,
+checked between tasks (check_and_forward_signals), and cancels the work
+queue cleanly instead of killing the process mid-partition.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from contextlib import contextmanager
+
+
+class JobInterrupted(KeyboardInterrupt):
+    pass
+
+
+class _State:
+    def __init__(self):
+        self.requested = False
+
+
+_state = _State()
+
+
+@contextmanager
+def capture_sigint():
+    """Within the scope, SIGINT sets a flag instead of raising immediately;
+    callers poll check_interrupted() at partition boundaries. Only installs
+    from the main thread (signal API restriction); elsewhere it's a no-op."""
+    _state.requested = False
+    if threading.current_thread() is not threading.main_thread():
+        yield _state
+        return
+    prev = signal.getsignal(signal.SIGINT)
+
+    def handler(signum, frame):
+        _state.requested = True
+
+    try:
+        signal.signal(signal.SIGINT, handler)
+    except ValueError:
+        yield _state
+        return
+    try:
+        yield _state
+    finally:
+        signal.signal(signal.SIGINT, prev)
+
+
+def check_interrupted() -> None:
+    if _state.requested:
+        _state.requested = False
+        raise JobInterrupted("job cancelled by SIGINT")
