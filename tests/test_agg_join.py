@@ -145,3 +145,46 @@ def test_null_column_surprise_value(ctx, tmp_path):
     ds = ctx.csv(str(p))
     out = ds.collect()
     assert (2, "surprise") in out
+
+
+def test_multihost_psum_aggregate():
+    # mesh-parallel fold: per-shard reduce + psum over the 8-device CPU mesh
+    import tuplex_tpu
+
+    c = tuplex_tpu.Context({"tuplex.backend": "multihost"})
+    data = [(float(i % 50) / 100, float(i % 7)) for i in range(20000)]
+    ds = (c.parallelize(data, columns=["disc", "price"])
+          .filter(lambda x: x["disc"] > 0.05)
+          .aggregate(lambda a, b: a + b,
+                     lambda a, x: a + x["price"] * x["disc"], 0.0))
+    got = ds.collect()[0]
+    want = sum(p * d for d, p in data if d > 0.05)
+    assert abs(got - want) < 1e-6 * max(1.0, abs(want))
+
+
+def test_multihost_minmax_aggregate():
+    import tuplex_tpu
+
+    c = tuplex_tpu.Context({"tuplex.backend": "multihost"})
+    data = list(range(1, 5001))
+    res = c.parallelize(data).aggregate(
+        lambda a, b: (min(a[0], b[0]), max(a[1], b[1])),
+        lambda a, x: (min(a[0], x), max(a[1], x)),
+        (10**9, -(10**9))).collect()
+    assert res == [(1, 5000)]
+
+
+def test_multihost_aggregate_by_key_segment_psum():
+    # grouped mesh aggregate: per-device segment tables combined over ICI
+    import tuplex_tpu
+
+    c = tuplex_tpu.Context({"tuplex.backend": "multihost"})
+    data = [(i % 5, float(i)) for i in range(10000)]
+    ds = c.parallelize(data, columns=["k", "v"]).aggregateByKey(
+        lambda a, b: a + b, lambda a, r: a + r["v"], 0.0, ["k"])
+    got = dict(ds.collect())
+    want: dict = {}
+    for k, v in data:
+        want[k] = want.get(k, 0.0) + v
+    assert {k: round(v, 3) for k, v in got.items()} == \
+        {k: round(v, 3) for k, v in want.items()}

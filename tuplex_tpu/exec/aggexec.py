@@ -191,6 +191,12 @@ class AggregateExecutor:
     def _device_fold(self, op, spec: A.FoldSpec, part: C.Partition):
         """(partial_tuple|scalar, bad_row_indices) or (None, _) if the
         partition can't run on device."""
+        mesh = getattr(self.backend, "mesh", None)
+        if mesh is not None:
+            try:
+                return self._device_fold_mesh(op, spec, part, mesh)
+            except NotCompilable:
+                return None, range(part.num_rows)
         try:
             vals, ok_mask, err = self._eval_exprs(op, spec, part)
         except NotCompilable:
@@ -215,7 +221,42 @@ class AggregateExecutor:
         out = tuple(partials) if not spec.scalar else partials[0]
         return out, sorted(set(bad))
 
+    def _device_fold_mesh(self, op, spec: A.FoldSpec, part: C.Partition,
+                          mesh):
+        """Mesh-parallel fold: per-device shard reduction + psum over ICI
+        (SURVEY §2.10: parallel aggregation via collectives)."""
+        from ..compiler.stagefn import input_row_cv
+        from ..parallel import collectives as CC
+        from ..parallel import mesh as M
+
+        if not part.leaves and part.fallback:
+            raise NotCompilable("all-fallback partition")
+        batch = C.stage_partition(part, self.backend.bucket_mode)
+        arrays = M.pad_batch_for_mesh(batch.arrays, len(mesh.devices.flat))
+        schema = part.schema
+        eval_exprs = _make_eval_exprs(spec, schema)
+        shapes = tuple(sorted((k, v.shape, str(v.dtype))
+                              for k, v in arrays.items()))
+        run = self.backend.jit_cache.get_or_build(
+            ("meshfold", op.id, schema.name, shapes),
+            lambda: CC.sharded_fold_fn(eval_exprs, spec.reducers, mesh,
+                                       list(arrays)))
+        outs = run(arrays)
+        ok_np = np.asarray(outs[-1])[: part.num_rows] & _real_mask(part)
+        partials = [o.item() for o in outs[:-1]]
+        bad = np.nonzero(~ok_np & _real_mask(part))[0].tolist()
+        bad += [i for i in part.fallback if i not in bad]
+        out = tuple(partials) if not spec.scalar else partials[0]
+        return out, sorted(set(bad))
+
     def _device_fold_bykey(self, op, spec, part, kidx, groups, excs) -> bool:
+        mesh = getattr(self.backend, "mesh", None)
+        if mesh is not None:
+            try:
+                return self._device_fold_bykey_mesh(op, spec, part, kidx,
+                                                    groups, excs, mesh)
+            except NotCompilable:
+                return False
         try:
             vals, ok_mask, err = self._eval_exprs(op, spec, part)
         except NotCompilable:
@@ -265,6 +306,55 @@ class AggregateExecutor:
         self._python_fold(op, part, sorted(set(bad)), groups, kidx, excs)
         return True
 
+    def _device_fold_bykey_mesh(self, op, spec, part, kidx, groups, excs,
+                                mesh) -> bool:
+        """Grouped mesh aggregate: per-device segment reductions over the
+        row shard, partial tables combined with psum/pmin/pmax over ICI
+        (no shuffle — reference analog: per-task hashtables merged by
+        createFinalHashmap, here merged on the interconnect)."""
+        from ..parallel import collectives as CC
+        from ..parallel import mesh as M
+
+        if not part.leaves and part.fallback:
+            raise NotCompilable("all-fallback partition")
+        n = part.num_rows
+        real = _real_mask(part)
+        codes, uniq_rows = _factorize_keys(part, kidx, real)
+        if codes is None:
+            return False
+        nseg = len(uniq_rows)
+        batch = C.stage_partition(part, self.backend.bucket_mode)
+        arrays = M.pad_batch_for_mesh(batch.arrays, len(mesh.devices.flat))
+        b = arrays["#rowvalid"].shape[0]
+        codes_b = np.full(b, nseg, dtype=np.int32)  # padding -> dropped seg
+        codes_b[:n][real] = codes
+        schema = part.schema
+        eval_exprs = _make_eval_exprs(spec, schema)
+        shapes = tuple(sorted((k, v.shape, str(v.dtype))
+                              for k, v in arrays.items()))
+        run = self.backend.jit_cache.get_or_build(
+            ("meshseg", op.id, schema.name, nseg, shapes),
+            lambda: CC.sharded_segment_fold_fn(
+                eval_exprs, spec.reducers, nseg, mesh, list(arrays)))
+        outs = run(arrays, codes_b)
+        ok_np = np.asarray(outs[-1])[:n] & real
+        seg_partials = [np.asarray(o)[:nseg] for o in outs[:-1]]
+        for si, row_i in enumerate(uniq_rows):
+            row = part.decode_row(int(row_i))
+            k = tuple(row.values[j] for j in kidx)
+            acc = groups.get(k, op.initial)
+            accs = list(acc) if isinstance(acc, tuple) else [acc]
+            merged = []
+            for j, reducer in enumerate(spec.reducers):
+                v = seg_partials[j][si].item()
+                merged.append(_combine_scalar(reducer, accs[j], v)
+                              if reducer != "sum" else accs[j] + v)
+            groups[k] = tuple(merged) if isinstance(acc, tuple) else merged[0]
+        bad = np.nonzero(~ok_np & real)[0].tolist()
+        bad += [i for i in part.fallback if i not in bad]
+        self._python_fold(op, part, sorted(set(bad)), groups, kidx, excs)
+        return True
+
     # ------------------------------------------------------------------
     def _eval_exprs(self, op, spec: A.FoldSpec, part: C.Partition):
         """Evaluate fold exprs over the staged partition; returns
@@ -287,6 +377,27 @@ class AggregateExecutor:
             datas.append(cv.data)
         ok = arrays["#rowvalid"] & (ctx.err == 0)
         return datas, ok, ctx.err
+
+
+def _make_eval_exprs(spec: A.FoldSpec, schema):
+    """Emitter-traced fold expressions as a closure usable inside shard_map
+    (shared by scalar and grouped mesh folds)."""
+    from ..compiler.stagefn import input_row_cv
+
+    def eval_exprs(arrs):
+        ctx = EmitCtx(arrs["#rowvalid"].shape[0], arrs["#rowvalid"])
+        em = Emitter(ctx, spec.globals)
+        row = input_row_cv(arrs, schema)
+        frame = Frame(em, {spec.row_param: row})
+        datas = []
+        for expr in spec.exprs:
+            cv = frame.eval(expr)
+            cv = frame._require_numeric(cv, "aggregate expr")
+            datas.append(cv.data)
+        ok = arrs["#rowvalid"] & (ctx.err == 0)
+        return datas, ok
+
+    return eval_exprs
 
 
 def _real_mask(part: C.Partition) -> np.ndarray:

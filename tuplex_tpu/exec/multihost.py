@@ -33,6 +33,14 @@ class MultiHostBackend(LocalBackend):
 
         shape = options.get_str("tuplex.tpu.meshShape", "auto")
         n = len(jax.devices()) if shape == "auto" else int(shape.split("x")[0])
+        # pow2 batch buckets must shard evenly: round down to a power of two
+        p2 = 1 << (n.bit_length() - 1)
+        if p2 != n:
+            from ..utils.logging import get_logger
+
+            get_logger("multihost").warning(
+                "mesh size %d is not a power of two; using %d devices", n, p2)
+            n = p2
         self.mesh = M.make_mesh(n)
         self.n_devices = n
 

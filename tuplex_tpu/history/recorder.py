@@ -20,6 +20,10 @@ class JobRecorder:
         self.job_id = uuid.uuid4().hex[:12]
         self._stage_no = 0
 
+    def _new_job(self) -> None:
+        self.job_id = uuid.uuid4().hex[:12]
+        self._stage_no = 0
+
     def _write(self, rec: dict) -> None:
         if not self.enabled:
             return
@@ -32,6 +36,7 @@ class JobRecorder:
             pass
 
     def job_started(self, action: str, plan: list) -> None:
+        self._new_job()  # each action is its own job in the dashboard
         self._write({"event": "job_start", "action": action,
                      "stages": [type(s).__name__ for s in plan]})
 

@@ -304,34 +304,20 @@ def _table_to_partition(table, schema: T.RowType, max_w: int,
     for ci, arr in enumerate(col_arrays):
         import pyarrow as pa
 
-        if arr.num_chunks if hasattr(arr, "num_chunks") else 0:
-            arr = arr.combine_chunks()
         if isinstance(arr, pa.ChunkedArray):
             arr = arr.combine_chunks()
         arr = arr.cast(pa.large_string())
-        buffers = arr.buffers()
-        # large_string: [validity, offsets(int64), data]
-        offsets = np.frombuffer(buffers[1], dtype=np.int64,
-                                count=len(arr) + 1 + arr.offset)[arr.offset:]
-        data = np.frombuffer(buffers[2], dtype=np.uint8) if buffers[2] \
-            else np.zeros(0, np.uint8)
-        starts = offsets[:-1]
-        lens = (offsets[1:] - starts).astype(np.int64)
         valid = np.ones(n, dtype=np.bool_)
         if arr.null_count:
             valid = np.asarray(arr.is_valid())
-        over = lens > max_w
-        too_long_rows |= over
-        w = int(min(lens.max() if n else 1, max_w))
-        w = max(w, 1)
-        idx = starts[:, None] + np.arange(w, dtype=np.int64)[None, :]
-        np.clip(idx, 0, max(len(data) - 1, 0), out=idx)
-        mat = data[idx] if len(data) else np.zeros((n, w), np.uint8)
-        keep = np.arange(w, dtype=np.int64)[None, :] < \
-            np.minimum(lens, w)[:, None]
-        mat = np.where(keep, mat, 0).astype(np.uint8)
-        leaves[str(ci)] = C.StrLeaf(mat, np.minimum(lens, w).astype(np.int32),
-                                    valid)
+        leaf = C.arrow_string_to_leaf(arr, n, max_w, valid)
+        # rows with over-long cells keep their slot but box via fallback
+        buffers = arr.buffers()
+        offsets = np.frombuffer(buffers[1], dtype=np.int64,
+                                count=len(arr) + 1 + arr.offset)[arr.offset:]
+        full_lens = (offsets[1:] - offsets[:-1]).astype(np.int64)
+        too_long_rows |= full_lens > max_w
+        leaves[str(ci)] = leaf
 
     part = C.Partition(schema=schema, num_rows=n, leaves=leaves,
                        start_index=start_index)

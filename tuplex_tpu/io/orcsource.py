@@ -62,7 +62,8 @@ def table_to_partitions(table, max_w: int, rows_per_part: int,
                 valid = np.asarray(arr.is_valid())
             if base is T.STR:
                 sarr = arr.cast(pa.large_string())
-                leaves[str(ci)] = _string_leaf(sarr, m, max_w, valid)
+                leaves[str(ci)] = C.arrow_string_to_leaf(sarr, m, max_w,
+                                                         valid)
             elif base in (T.I64, T.F64, T.BOOL):
                 dtype = {T.I64: np.int64, T.F64: np.float64,
                          T.BOOL: np.bool_}[base]
@@ -78,24 +79,6 @@ def table_to_partitions(table, max_w: int, rows_per_part: int,
             break
         start += m
     return parts
-
-
-def _string_leaf(arr, n: int, max_w: int, valid) -> C.StrLeaf:
-    buffers = arr.buffers()
-    offsets = np.frombuffer(buffers[1], dtype=np.int64,
-                            count=len(arr) + 1 + arr.offset)[arr.offset:]
-    data = np.frombuffer(buffers[2], dtype=np.uint8) if buffers[2] \
-        else np.zeros(0, np.uint8)
-    starts = offsets[:-1]
-    lens = (offsets[1:] - starts).astype(np.int64)
-    w = int(min(max(int(lens.max()) if n else 1, 1), max_w))
-    idx = starts[:, None] + np.arange(w, dtype=np.int64)[None, :]
-    np.clip(idx, 0, max(len(data) - 1, 0), out=idx)
-    mat = data[idx] if len(data) else np.zeros((n, w), np.uint8)
-    keep = np.arange(w, dtype=np.int64)[None, :] < \
-        np.minimum(lens, w)[:, None]
-    mat = np.where(keep, mat, 0).astype(np.uint8)
-    return C.StrLeaf(mat, np.minimum(lens, w).astype(np.int32), valid)
 
 
 class ORCSourceOperator(L.LogicalOperator):

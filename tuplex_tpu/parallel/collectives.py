@@ -1,0 +1,106 @@
+"""Mesh-parallel reductions: the ICI collective layer.
+
+The reference combines per-thread aggregates on the driver
+(reference: LocalBackend.cc:911-919 thread-local tables + 2219
+createFinalHashmap). On a mesh the same associative-combine contract becomes
+XLA collectives: every device folds its row shard, then `psum`/`pmin`/`pmax`
+over the data axis combines partials ON THE INTERCONNECT — no host
+round-trip (SURVEY §2.10 item 5: "segment-reduce on device + psum over ICI").
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+from ..runtime.jaxcfg import jax, jnp
+from .mesh import DATA_AXIS
+
+
+def sharded_fold_fn(eval_exprs: Callable, reducers: Sequence[str], mesh,
+                    array_keys: Sequence[str], axis: str = DATA_AXIS):
+    """Build a jitted mesh-parallel fold (ONE compile per cache entry: the
+    returned callable has stable identity — cache it per stage/shape).
+
+    eval_exprs(arrays) -> (list_of_[B]_value_arrays, ok_mask[B]) — the
+    emitter-traced fold expressions (same trace as the single-chip path).
+    Each device reduces its row shard locally, then combines with psum/
+    pmin/pmax over the mesh axis; the result replicates on every device.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def local_fold(arrays):
+        vals, ok = eval_exprs(arrays)
+        outs = []
+        for v, red in zip(vals, reducers):
+            is_float = jnp.issubdtype(v.dtype, jnp.floating)
+            if red == "sum":
+                ident = jnp.asarray(0, v.dtype)
+                part = jnp.where(ok, v, ident).sum()
+                outs.append(jax.lax.psum(part, axis))
+            elif red == "min":
+                ident = jnp.asarray(jnp.inf if is_float else (1 << 62),
+                                    v.dtype)
+                part = jnp.where(ok, v, ident).min()
+                outs.append(jax.lax.pmin(part, axis))
+            else:
+                ident = jnp.asarray(-jnp.inf if is_float else -(1 << 62),
+                                    v.dtype)
+                part = jnp.where(ok, v, ident).max()
+                outs.append(jax.lax.pmax(part, axis))
+        # ok mask travels back row-sharded so the host can route err rows to
+        # the interpreter fold
+        return tuple(outs) + (ok,)
+
+    specs = {k: P(axis) for k in array_keys}
+    fn = shard_map(local_fold, mesh=mesh, in_specs=(specs,),
+                   out_specs=tuple(P() for _ in reducers) + (P(axis),),
+                   check_vma=False)
+    return jax.jit(fn)
+
+
+def sharded_segment_fold_fn(eval_exprs: Callable, reducers: Sequence[str],
+                            nseg: int, mesh, array_keys: Sequence[str],
+                            axis: str = DATA_AXIS):
+    """Mesh-parallel aggregateByKey: per-device segment reduction over local
+    rows, then psum/pmin/pmax of the [nseg] partial tables across the mesh
+    (the shuffle-free grouped aggregate: key codes are global, partial
+    tables combine on ICI)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def local_fold(arrays, codes):
+        vals, ok = eval_exprs(arrays)
+        outs = []
+        for v, red in zip(vals, reducers):
+            is_float = jnp.issubdtype(v.dtype, jnp.floating)
+            if red == "sum":
+                ident = jnp.asarray(0, v.dtype)
+                masked = jnp.where(ok, v, ident)
+                seg = jax.ops.segment_sum(masked, codes,
+                                          num_segments=nseg + 1)
+                outs.append(jax.lax.psum(seg, axis))
+            elif red == "min":
+                ident = jnp.asarray(jnp.inf if is_float else (1 << 62),
+                                    v.dtype)
+                masked = jnp.where(ok, v, ident)
+                seg = jax.ops.segment_min(masked, codes,
+                                          num_segments=nseg + 1,
+                                          indices_are_sorted=False)
+                outs.append(jax.lax.pmin(seg, axis))
+            else:
+                ident = jnp.asarray(-jnp.inf if is_float else -(1 << 62),
+                                    v.dtype)
+                masked = jnp.where(ok, v, ident)
+                seg = jax.ops.segment_max(masked, codes,
+                                          num_segments=nseg + 1)
+                outs.append(jax.lax.pmax(seg, axis))
+        return tuple(outs) + (ok,)
+
+    specs = {k: P(axis) for k in array_keys}
+    fn = shard_map(local_fold, mesh=mesh, in_specs=(specs, P(axis)),
+                   out_specs=tuple(P() for _ in reducers) + (P(axis),),
+                   check_vma=False)
+    return jax.jit(fn)

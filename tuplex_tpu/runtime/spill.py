@@ -104,6 +104,7 @@ class MemoryManager:
         self._entries: "OrderedDict[int, _Entry]" = OrderedDict()
         self._inmem = 0
         self._lock = threading.Lock()
+        self._dead: list[int] = []  # filled by weakref callbacks, no lock
         self.swap_out_count = 0
         self.swap_in_count = 0
         self.swapped_bytes = 0
@@ -117,33 +118,45 @@ class MemoryManager:
                 return
             nb = part.nbytes()
 
+            # callbacks may fire while WE hold the lock (a strong ref
+            # dropped inside eviction): never lock here — just enqueue
             def on_dead(_ref, mm=self, key=pid):
-                with mm._lock:
-                    e = mm._entries.pop(key, None)
-                    if e is not None:
-                        mm._inmem -= e.nbytes
+                mm._dead.append(key)  # list.append is atomic
 
             self._entries[pid] = _Entry(weakref.ref(part, on_dead), nb)
             self._inmem += nb
-            self._evict_locked()
+            self._reap_locked()
+            self._evict_locked(exclude=pid)
 
     def touch(self, part: C.Partition) -> None:
         """Mark recently used; swap back in if spilled."""
         with self._lock:
+            self._reap_locked()
             pid = id(part)
             if pid in self._entries:
-                # MRU first, so eviction during swap-in can't pick this one
                 self._entries.move_to_end(pid)
             if getattr(part, "_spilled", None) is not None:
                 self._swap_in_locked(part)
 
+    def _reap_locked(self) -> None:
+        while self._dead:
+            key = self._dead.pop()
+            e = self._entries.pop(key, None)
+            if e is not None:
+                self._inmem -= e.nbytes
+
     # ------------------------------------------------------------------
-    def _evict_locked(self) -> None:
+    def _evict_locked(self, exclude: int = -1) -> None:
+        """`exclude`: the entry being registered/loaded RIGHT NOW — even a
+        partition bigger than the whole budget must stay resident while its
+        caller reads it."""
         if self.budget <= 0:
             return
         for pid, entry in list(self._entries.items()):
             if self._inmem <= self.budget:
                 break
+            if pid == exclude:
+                continue
             part = entry.ref()
             if part is None or entry.nbytes == 0 or \
                     getattr(part, "_spilled", None) is not None:
@@ -179,7 +192,7 @@ class MemoryManager:
         if entry is not None:
             entry.nbytes = nb
         self._inmem += nb
-        self._evict_locked()
+        self._evict_locked(exclude=id(part))
 
     def ensure_loaded(self, part: C.Partition) -> C.Partition:
         self.touch(part)
