@@ -153,3 +153,23 @@ def test_non_ascii_dual_mode_exact(ctx):
     vals = ["hello", "héllo", "日本語", "x"]
     res = ctx.parallelize(vals).filter(lambda s: len(s) > 3).collect()
     assert res == [s for s in vals if len(s) > 3]
+
+
+def test_filter_pushdown_reorders(ctx):
+    # filter on an untouched column hops over the withColumn; rows it drops
+    # never reach the (raising) withColumn UDF
+    data = [(1, 10), (0, -5), (3, 20)]
+    ds = (ctx.parallelize(data, columns=["a", "b"])
+          .withColumn("c", lambda x: 100 // x["a"])   # raises for a=0
+          .filter(lambda x: x["b"] > 0))              # drops the a=0 row
+    assert ds.collect() == [(1, 10, 100), (3, 20, 33)]
+    # pushed down -> the dropped row never raises
+    assert ds.exception_counts() == {}
+
+    ctx.options_store.set("tuplex.optimizer.filterPushdown", False)
+    ds2 = (ctx.parallelize(data, columns=["a", "b"])
+           .withColumn("c", lambda x: 100 // x["a"])
+           .filter(lambda x: x["b"] > 0))
+    assert ds2.collect() == [(1, 10, 100), (3, 20, 33)]
+    assert ds2.exception_counts() == {"ZeroDivisionError": 1}
+    ctx.options_store.set("tuplex.optimizer.filterPushdown", True)

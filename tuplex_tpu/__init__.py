@@ -17,7 +17,8 @@ from .core.errors import TuplexException
 
 __version__ = "0.1.0"
 
-__all__ = ["Context", "DataSet", "Metrics", "TuplexException", "__version__"]
+__all__ = ["Context", "DataSet", "Metrics", "LambdaContext",
+           "TuplexException", "__version__"]
 
 
 def __getattr__(name):
@@ -31,4 +32,7 @@ def __getattr__(name):
     if name == "Metrics":
         from .api.metrics import Metrics
         return Metrics
+    if name == "LambdaContext":
+        from .api.context import LambdaContext
+        return LambdaContext
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

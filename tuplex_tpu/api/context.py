@@ -31,7 +31,9 @@ class Context:
 
         self.recorder = JobRecorder(
             self.options_store.get_str("tuplex.logDir", "."),
-            enabled=self.options_store.get_bool("tuplex.webui.enable"))
+            enabled=self.options_store.get_bool("tuplex.webui.enable"),
+            exception_display_limit=self.options_store.get_int(
+                "tuplex.webui.exceptionDisplayLimit", 5))
         if self.options_store.get_bool("tuplex.redirectToPythonLogging"):
             from ..utils.logging import redirect_to_python_logging
 
@@ -164,3 +166,14 @@ def _infer_row_schema(sample: list, columns, threshold: float) -> T.RowType:
     nc, _, _ = T.normal_case_type(sample, threshold)
     names = list(columns) if columns else ["_0"]
     return T.row_of(names[:1], [nc])
+
+
+class LambdaContext(Context):
+    """Distributed-by-default Context (reference: python/tuplex/__init__.py
+    exports LambdaContext preset to the serverless backend; here the
+    distributed seam is the mesh backend)."""
+
+    def __init__(self, conf=None, **kwargs):
+        merged = dict(conf or {})
+        merged.setdefault("tuplex.backend", "multihost")
+        super().__init__(merged, **kwargs)

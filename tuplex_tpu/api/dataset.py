@@ -158,7 +158,7 @@ class DataSet:
 
         t_job = _time.perf_counter()
         sink = L.TakeOperator(self._op, limit) if limit >= 0 else self._op
-        stages = plan_stages(sink)
+        stages = plan_stages(sink, self._context.options_store)
         backend = self._context.backend
         recorder = self._context.recorder
         recorder.job_started("collect" if limit < 0 else f"take({limit})",

@@ -30,16 +30,7 @@ from ..runtime import columns as C
 from .local import ExceptionRecord
 
 
-_SUM_IDENT = 0
-_BIG = (1 << 62)
-
-
-def _identity(reducer: str, is_float: bool):
-    if reducer == "sum":
-        return 0.0 if is_float else 0
-    if reducer == "min":
-        return float("inf") if is_float else _BIG
-    return float("-inf") if is_float else -_BIG
+from ..parallel.collectives import reduce_identity as _identity
 
 
 def _combine_scalar(reducer: str, a, b):
@@ -225,7 +216,6 @@ class AggregateExecutor:
                           mesh):
         """Mesh-parallel fold: per-device shard reduction + psum over ICI
         (SURVEY §2.10: parallel aggregation via collectives)."""
-        from ..compiler.stagefn import input_row_cv
         from ..parallel import collectives as CC
         from ..parallel import mesh as M
 
@@ -338,17 +328,19 @@ class AggregateExecutor:
                 eval_exprs, spec.reducers, nseg, mesh, list(arrays)))
         outs = run(arrays, codes_b)
         ok_np = np.asarray(outs[-1])[:n] & real
-        seg_partials = [np.asarray(o)[:nseg] for o in outs[:-1]]
+        counts = np.asarray(outs[-2])[:nseg]
+        seg_partials = [np.asarray(o)[:nseg] for o in outs[:-2]]
         for si, row_i in enumerate(uniq_rows):
+            if counts[si] == 0:
+                continue  # every row of this key failed: no ghost group —
+                          # the interpreter fold below decides its fate
             row = part.decode_row(int(row_i))
             k = tuple(row.values[j] for j in kidx)
             acc = groups.get(k, op.initial)
             accs = list(acc) if isinstance(acc, tuple) else [acc]
-            merged = []
-            for j, reducer in enumerate(spec.reducers):
-                v = seg_partials[j][si].item()
-                merged.append(_combine_scalar(reducer, accs[j], v)
-                              if reducer != "sum" else accs[j] + v)
+            merged = [_combine_scalar(reducer, accs[j],
+                                      seg_partials[j][si].item())
+                      for j, reducer in enumerate(spec.reducers)]
             groups[k] = tuple(merged) if isinstance(acc, tuple) else merged[0]
         bad = np.nonzero(~ok_np & real)[0].tolist()
         bad += [i for i in part.fallback if i not in bad]

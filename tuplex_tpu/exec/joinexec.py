@@ -67,7 +67,7 @@ class JoinExecutor:
         # --- build side: execute the right sub-plan (stage N-1) ------------
         from ..api.dataset import _source_partitions
 
-        right_stages = plan_stages(op.right)
+        right_stages = plan_stages(op.right, context.options_store)
         rparts: Optional[list] = None
         excs: list[ExceptionRecord] = []
         for rs in right_stages:

@@ -14,7 +14,9 @@ class JobRecorder:
     """Appends job/stage/exception events to <logDir>/tuplex_history.jsonl
     (reference events: job/stage/task/exception updates, thserver/rest.py)."""
 
-    def __init__(self, log_dir: str, enabled: bool = True):
+    def __init__(self, log_dir: str, enabled: bool = True,
+                 exception_display_limit: int = 5):
+        self.exception_display_limit = exception_display_limit
         self.enabled = enabled
         self.path = os.path.join(log_dir or ".", "tuplex_history.jsonl")
         self.job_id = uuid.uuid4().hex[:12]
@@ -42,7 +44,8 @@ class JobRecorder:
 
     def stage_done(self, stage, metrics: dict, exceptions: list) -> None:
         self._stage_no += 1
-        sample = [repr(e)[:200] for e in exceptions[:5]]
+        sample = [repr(e)[:200]
+                  for e in exceptions[: self.exception_display_limit]]
         self._write({"event": "stage", "no": self._stage_no,
                      "kind": type(stage).__name__,
                      "metrics": metrics, "exception_sample": sample})

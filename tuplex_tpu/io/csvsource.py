@@ -310,12 +310,9 @@ def _table_to_partition(table, schema: T.RowType, max_w: int,
         valid = np.ones(n, dtype=np.bool_)
         if arr.null_count:
             valid = np.asarray(arr.is_valid())
-        leaf = C.arrow_string_to_leaf(arr, n, max_w, valid)
+        leaf, full_lens = C.arrow_string_to_leaf(arr, n, max_w, valid,
+                                                 return_full_lens=True)
         # rows with over-long cells keep their slot but box via fallback
-        buffers = arr.buffers()
-        offsets = np.frombuffer(buffers[1], dtype=np.int64,
-                                count=len(arr) + 1 + arr.offset)[arr.offset:]
-        full_lens = (offsets[1:] - offsets[:-1]).astype(np.int64)
         too_long_rows |= full_lens > max_w
         leaves[str(ci)] = leaf
 

@@ -367,12 +367,19 @@ def parse_i64(bytes_, lens):
     bad = jnp.any(digit_zone & ~is_digit, axis=1)
     ndigits = sl - digit_start
     bad = bad | (ndigits <= 0)
-    d = jnp.where(digit_zone & is_digit, (sb - 48).astype(jnp.int64), 0)
-    # Horner over static width; positions past len contribute *1 each (skip)
+    # Horner over a GATHERED digit window: i64 holds <= 19 digits, so only
+    # the first 20 positions after the sign matter (beyond that the value
+    # overflows anyway -> rows flagged bad). This caps the sequential chain
+    # at 20 steps regardless of column width.
+    win = min(w, 20)
+    pos_w = digit_start[:, None] + jnp.arange(win, dtype=jnp.int32)[None, :]
+    wb = jnp.take_along_axis(sb, jnp.clip(pos_w, 0, w - 1), axis=1)
+    in_zone_w = pos_w < sl[:, None]
+    dw = jnp.where(in_zone_w, (wb - 48).astype(jnp.int64), 0)
     val = jnp.zeros(n, dtype=jnp.int64)
-    for j in range(w):
-        in_zone = digit_zone[:, j]
-        val = jnp.where(in_zone, val * 10 + d[:, j], val)
+    for j in range(win):
+        val = jnp.where(in_zone_w[:, j], val * 10 + dw[:, j], val)
+    bad = bad | (ndigits > 19)  # would overflow i64: python-int territory
     val = jnp.where(neg, -val, val)
     return val, bad
 

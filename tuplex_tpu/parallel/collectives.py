@@ -17,6 +17,23 @@ import numpy as np
 from ..runtime.jaxcfg import jax, jnp
 from .mesh import DATA_AXIS
 
+_BIG = 1 << 62
+
+
+def reduce_identity(reducer: str, is_float: bool):
+    """Neutral element per reducer — single source of truth shared with the
+    host-side merge (exec/aggexec)."""
+    if reducer == "sum":
+        return 0.0 if is_float else 0
+    if reducer == "min":
+        return float("inf") if is_float else _BIG
+    return float("-inf") if is_float else -_BIG
+
+
+def _ident_arr(reducer: str, dtype):
+    return jnp.asarray(
+        reduce_identity(reducer, jnp.issubdtype(dtype, jnp.floating)), dtype)
+
 
 def sharded_fold_fn(eval_exprs: Callable, reducers: Sequence[str], mesh,
                     array_keys: Sequence[str], axis: str = DATA_AXIS):
@@ -35,21 +52,13 @@ def sharded_fold_fn(eval_exprs: Callable, reducers: Sequence[str], mesh,
         vals, ok = eval_exprs(arrays)
         outs = []
         for v, red in zip(vals, reducers):
-            is_float = jnp.issubdtype(v.dtype, jnp.floating)
+            masked = jnp.where(ok, v, _ident_arr(red, v.dtype))
             if red == "sum":
-                ident = jnp.asarray(0, v.dtype)
-                part = jnp.where(ok, v, ident).sum()
-                outs.append(jax.lax.psum(part, axis))
+                outs.append(jax.lax.psum(masked.sum(), axis))
             elif red == "min":
-                ident = jnp.asarray(jnp.inf if is_float else (1 << 62),
-                                    v.dtype)
-                part = jnp.where(ok, v, ident).min()
-                outs.append(jax.lax.pmin(part, axis))
+                outs.append(jax.lax.pmin(masked.min(), axis))
             else:
-                ident = jnp.asarray(-jnp.inf if is_float else -(1 << 62),
-                                    v.dtype)
-                part = jnp.where(ok, v, ident).max()
-                outs.append(jax.lax.pmax(part, axis))
+                outs.append(jax.lax.pmax(masked.max(), axis))
         # ok mask travels back row-sharded so the host can route err rows to
         # the interpreter fold
         return tuple(outs) + (ok,)
@@ -75,32 +84,28 @@ def sharded_segment_fold_fn(eval_exprs: Callable, reducers: Sequence[str],
         vals, ok = eval_exprs(arrays)
         outs = []
         for v, red in zip(vals, reducers):
-            is_float = jnp.issubdtype(v.dtype, jnp.floating)
+            masked = jnp.where(ok, v, _ident_arr(red, v.dtype))
             if red == "sum":
-                ident = jnp.asarray(0, v.dtype)
-                masked = jnp.where(ok, v, ident)
                 seg = jax.ops.segment_sum(masked, codes,
                                           num_segments=nseg + 1)
                 outs.append(jax.lax.psum(seg, axis))
             elif red == "min":
-                ident = jnp.asarray(jnp.inf if is_float else (1 << 62),
-                                    v.dtype)
-                masked = jnp.where(ok, v, ident)
                 seg = jax.ops.segment_min(masked, codes,
-                                          num_segments=nseg + 1,
-                                          indices_are_sorted=False)
+                                          num_segments=nseg + 1)
                 outs.append(jax.lax.pmin(seg, axis))
             else:
-                ident = jnp.asarray(-jnp.inf if is_float else -(1 << 62),
-                                    v.dtype)
-                masked = jnp.where(ok, v, ident)
                 seg = jax.ops.segment_max(masked, codes,
                                           num_segments=nseg + 1)
                 outs.append(jax.lax.pmax(seg, axis))
-        return tuple(outs) + (ok,)
+        # per-segment ok counts: the host skips creating groups whose rows
+        # ALL failed (ghost-group guard), + the ok mask for err routing
+        counts = jax.lax.psum(
+            jax.ops.segment_sum(ok.astype(jnp.int32), codes,
+                                num_segments=nseg + 1), axis)
+        return tuple(outs) + (counts, ok)
 
     specs = {k: P(axis) for k in array_keys}
     fn = shard_map(local_fold, mesh=mesh, in_specs=(specs, P(axis)),
-                   out_specs=tuple(P() for _ in reducers) + (P(axis),),
+                   out_specs=tuple(P() for _ in reducers) + (P(), P(axis)),
                    check_vma=False)
     return jax.jit(fn)

@@ -33,7 +33,7 @@ class CacheOperator(L.LogicalOperator):
         from ..api.dataset import _source_partitions
         from .physical import plan_stages
 
-        stages = plan_stages(self.parent)
+        stages = plan_stages(self.parent, context.options_store)
         partitions = None
         for stage in stages:
             if getattr(stage, "source", None) is not None:

@@ -147,3 +147,52 @@ def required_source_columns(source_columns: tuple[str, ...],
     # whatever survives to the stage output is needed
     required |= {s for s in alias.values() if s}
     return [c for c in source_columns if c in required]
+
+
+def filter_pushdown(ops: list) -> list:
+    """Move filters ahead of operators whose outputs they don't read
+    (reference: LogicalPlan.cc optimizeFilters — pushing filters toward the
+    source shrinks every downstream operator's working set).
+
+    A filter hops over a preceding op when:
+      * the op is a Map: never (row shape changes);
+      * the op is a WithColumn/MapColumn: the filter doesn't read the
+        written column;
+      * the op is Rename/Select: names translate through;
+    and neither op has resolvers attached (resolver semantics bind to
+    operator order).
+    """
+    guarded: set[int] = set()
+    for i, op in enumerate(ops):
+        if isinstance(op, (L.ResolveOperator, L.IgnoreOperator)) and i > 0:
+            guarded.add(id(ops[i - 1]))
+            guarded.add(id(op))
+
+    result = list(ops)
+    changed = True
+    while changed:
+        changed = False
+        for i in range(1, len(result)):
+            f = result[i]
+            prev = result[i - 1]
+            if not isinstance(f, L.FilterOperator):
+                continue
+            if id(f) in guarded or id(prev) in guarded:
+                continue
+            reads = udf_read_columns(f.udf)
+            if reads is ALL:
+                continue
+            if isinstance(prev, L.WithColumnOperator):
+                if prev.column in reads:
+                    continue
+            elif isinstance(prev, L.MapColumnOperator):
+                if prev.column in reads:
+                    continue
+            elif isinstance(prev, L.RenameColumnOperator):
+                if prev.new in reads:
+                    continue  # name doesn't exist before the rename
+            else:
+                continue  # Map/Select/Decode/aggregates: don't hop
+            result[i - 1], result[i] = f, prev
+            changed = True
+    return result

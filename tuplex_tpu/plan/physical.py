@@ -292,7 +292,7 @@ class JoinStage:
         self.output_columns = op.columns()
 
 
-def plan_stages(sink: L.LogicalOperator):
+def plan_stages(sink: L.LogicalOperator, options=None):
     """Walk the DAG sink→source splitting at pipeline breakers (reference:
     PhysicalPlan.cc:60-238 splitIntoAndPlanStages)."""
     chain: list[L.LogicalOperator] = []
@@ -333,6 +333,16 @@ def plan_stages(sink: L.LogicalOperator):
                                      input_op=cur_input_op))
     elif stages:
         stages[-1].limit = limit
+    # filter pushdown within each stage (reference: optimizeFilters;
+    # dropped rows stop raising downstream exceptions — same semantics
+    # change the reference's tuplex.optimizer.filterPushdown makes)
+    if options is None or options.get_bool(
+            "tuplex.optimizer.filterPushdown", True):
+        from .optimizer import filter_pushdown
+
+        for st in stages:
+            if isinstance(st, TransformStage):
+                st.ops = filter_pushdown(st.ops)
     # projection pushdown into file sources (reference: csv.selectionPushdown)
     for st in stages:
         if isinstance(st, TransformStage):

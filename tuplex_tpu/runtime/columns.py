@@ -838,9 +838,12 @@ def _fast_partition(values: Sequence[Any], schema: T.RowType,
 
 
 def arrow_string_to_leaf(arr, n: int, max_w: int,
-                         valid: Optional[np.ndarray] = None) -> StrLeaf:
+                         valid: Optional[np.ndarray] = None,
+                         return_full_lens: bool = False):
     """Arrow large_string array -> fixed-width byte-matrix leaf (vectorized
-    offsets gather; shared by the CSV and ORC sources)."""
+    offsets gather; shared by the CSV and ORC sources). With
+    return_full_lens, also returns the UNCLAMPED byte lengths so callers can
+    detect over-long cells without re-reading the buffers."""
     buffers = arr.buffers()
     offsets = np.frombuffer(buffers[1], dtype=np.int64,
                             count=len(arr) + 1 + arr.offset)[arr.offset:]
@@ -855,4 +858,5 @@ def arrow_string_to_leaf(arr, n: int, max_w: int,
     keep = np.arange(w, dtype=np.int64)[None, :] < \
         np.minimum(lens, w)[:, None]
     mat = np.where(keep, mat, 0).astype(np.uint8)
-    return StrLeaf(mat, np.minimum(lens, w).astype(np.int32), valid)
+    leaf = StrLeaf(mat, np.minimum(lens, w).astype(np.int32), valid)
+    return (leaf, lens) if return_full_lens else leaf

@@ -112,6 +112,7 @@ class MemoryManager:
     # ------------------------------------------------------------------
     def register(self, part: C.Partition) -> None:
         with self._lock:
+            self._reap_locked()  # BEFORE membership: ids get reused after GC
             pid = id(part)
             if pid in self._entries:
                 self._entries.move_to_end(pid)
@@ -125,7 +126,6 @@ class MemoryManager:
 
             self._entries[pid] = _Entry(weakref.ref(part, on_dead), nb)
             self._inmem += nb
-            self._reap_locked()
             self._evict_locked(exclude=pid)
 
     def touch(self, part: C.Partition) -> None:

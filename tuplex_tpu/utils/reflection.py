@@ -83,7 +83,8 @@ def _extract_lambda(func: types.FunctionType) -> ast.Lambda | None:
     loose_hits: dict[str, ast.Lambda] = {}  # unparse -> node
     max_end = min(lnum + 40, len(lines))
     for end in range(lnum + 1, max_end + 1):
-        frag = textwrap.dedent("".join(lines[lnum:end])).strip()
+        frag = textwrap.dedent(
+            _cut_comments("".join(lines[lnum:end]))).strip()
         if not frag:
             continue
         base_frags = [frag]
@@ -126,6 +127,43 @@ def _extract_lambda(func: types.FunctionType) -> ast.Lambda | None:
     # zero or AMBIGUOUS loose matches (e.g. two closure lambdas sharing a
     # name/const set): no trustworthy source -> interpreter-only
     return None
+
+
+def _cut_comments(text: str) -> str:
+    """Remove `# ...` comments with full quote awareness (incl. triple-quoted
+    strings spanning lines) — comments after a lambda otherwise swallow the
+    paren-balancing candidates."""
+    out = []
+    quote: str | None = None   # "'", '"', "\'\'\'", '\"\"\"'
+    i = 0
+    n = len(text)
+    while i < n:
+        ch = text[i]
+        if quote:
+            if ch == "\\" and len(quote) == 1:
+                out.append(text[i:i + 2])
+                i += 2
+                continue
+            if text.startswith(quote, i):
+                out.append(quote)
+                i += len(quote)
+                quote = None
+                continue
+            out.append(ch)
+            i += 1
+            continue
+        if ch in "\"'":
+            quote = text[i:i + 3] if text.startswith(ch * 3, i) else ch
+            out.append(quote)
+            i += len(quote)
+            continue
+        if ch == "#":
+            while i < n and text[i] != "\n":
+                i += 1
+            continue
+        out.append(ch)
+        i += 1
+    return "".join(out)
 
 
 def _node_fingerprint(node: ast.Lambda, fp_fn) -> tuple | None:
