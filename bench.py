@@ -6,6 +6,9 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 value      = input rows/sec through the full framework pipeline (CSV read +
              device decode + 10-op fused UDF stage + dual-mode resolve +
              collect), steady-state (post-compile), best of N runs.
+             NOTE: defaults are 100k rows / best-of-2 since round 1 (override
+             with BENCH_ROWS/BENCH_RUNS); rows are always reported on stderr
+             so runs at different sizes aren't silently compared.
 vs_baseline = speedup over the pure-CPython interpreter implementation of the
              SAME pipeline on the same data (the reference's own comparison
              methodology: benchmarks/zillow runs 1 warmup + timed runs).
@@ -20,9 +23,9 @@ import sys
 import tempfile
 import time
 
-N_ROWS = int(os.environ.get("BENCH_ROWS", "200000"))
+N_ROWS = int(os.environ.get("BENCH_ROWS", "100000"))
 BASELINE_ROWS = int(os.environ.get("BENCH_BASELINE_ROWS", "40000"))
-RUNS = int(os.environ.get("BENCH_RUNS", "3"))
+RUNS = int(os.environ.get("BENCH_RUNS", "2"))
 
 
 def main() -> None:

@@ -131,6 +131,14 @@ class LocalBackend:
             except NotCompilable:
                 self._not_compilable.add(skey)
                 device_fn = None
+            except Exception as e:  # any build failure: interpreter is
+                from ..utils.logging import get_logger  # always correct
+
+                get_logger("exec").warning(
+                    "stage build failed (%s: %s); falling back to the "
+                    "interpreter", type(e).__name__, e)
+                self._not_compilable.add(skey)
+                device_fn = None
 
         out_parts: list[C.Partition] = []
         exceptions: list[ExceptionRecord] = []
@@ -178,10 +186,25 @@ class LocalBackend:
         if device_fn is not None and part.n_normal() > 0:
             t0 = time.perf_counter()
             batch = C.stage_partition(part, self.bucket_mode)
+            first_call = ("stagefn", skey) not in getattr(
+                self.jit_cache, "_traced", set())
             try:
                 outs = device_fn(batch.arrays)
+                if not hasattr(self.jit_cache, "_traced"):
+                    self.jit_cache._traced = set()
+                self.jit_cache._traced.add(("stagefn", skey))
             except NotCompilable:
                 # surfaces at TRACE time (first call): route to interpreter
+                self._not_compilable.add(skey)
+                device_fn = None
+            except Exception as e:
+                if not first_call:
+                    raise  # executed before: a real runtime failure
+                from ..utils.logging import get_logger
+
+                get_logger("exec").warning(
+                    "stage trace failed (%s: %s); falling back to the "
+                    "interpreter", type(e).__name__, e)
                 self._not_compilable.add(skey)
                 device_fn = None
             else:

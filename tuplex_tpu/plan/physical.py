@@ -54,16 +54,7 @@ class TransformStage:
         h = hashlib.sha256()
         h.update(self.input_schema.name.encode())
         for op in self.ops:
-            h.update(type(op).__name__.encode())
-            udf = getattr(op, "udf", None)
-            if udf is not None:
-                h.update(udf.source.encode())
-                for k in sorted(udf.globals):
-                    h.update(f"{k}={udf.globals[k]!r}".encode())
-            for attr in ("column", "selected", "old", "new", "declared",
-                         "null_values"):
-                if hasattr(op, attr):
-                    h.update(repr(getattr(op, attr)).encode())
+            h.update(_op_identity(op).encode())
         return h.hexdigest()[:16]
 
     # ------------------------------------------------------------------
@@ -393,11 +384,51 @@ def _apply_projection(stage: TransformStage) -> None:
     stage.input_schema = T.row_of(req, [T.option(T.STR)] * len(req))
 
 
+_op_compiles_cache: dict = {}
+
+
 def op_compiles(op: L.LogicalOperator, input_schema: T.RowType) -> bool:
     """Abstract-trace ONE operator against its input schema (tiny shapes,
-    jax.eval_shape: no device work) — False if the emitter rejects it."""
+    jax.eval_shape: no device work) — False if the emitter rejects it.
+    Cached per (op, schema): operators are immutable once planned and this
+    runs on EVERY action otherwise (~100ms per probe)."""
     if isinstance(op, (L.ResolveOperator, L.IgnoreOperator, L.TakeOperator)):
         return True
+    ck = (_op_identity(op), input_schema.name)
+    hit = _op_compiles_cache.get(ck)
+    if hit is not None:
+        return hit
+    result = _op_compiles_uncached(op, input_schema)
+    if len(_op_compiles_cache) > 4096:
+        _op_compiles_cache.clear()
+    _op_compiles_cache[ck] = result
+    return result
+
+
+def _op_identity(op: L.LogicalOperator) -> str:
+    """Content identity of an operator, hashed — shared by the jit cache key
+    and the compile-probe cache so the two can never disagree. Captured
+    globals hash by repr; value-unfaithful reprs are why trace failures at
+    EXECUTION time also fall back to the interpreter (exec/local.py)."""
+    h = hashlib.sha256()
+    h.update(type(op).__name__.encode())
+    udf = getattr(op, "udf", None)
+    if udf is not None:
+        h.update(udf.source.encode())
+        for k in sorted(udf.globals):
+            h.update(f"{k}={udf.globals[k]!r}".encode())
+        if not udf.source:
+            h.update(str(id(udf.func)).encode())  # sourceless: object id
+    for attr in ("column", "selected", "old", "new", "null_values"):
+        if hasattr(op, attr):
+            h.update(repr(getattr(op, attr)).encode())
+    if hasattr(op, "declared"):
+        h.update(op.declared.name.encode())
+    return h.hexdigest()[:20]
+
+
+def _op_compiles_uncached(op: L.LogicalOperator,
+                          input_schema: T.RowType) -> bool:
     from ..runtime.columns import flatten_type
     from ..runtime.jaxcfg import jax
     import numpy as np
