@@ -188,3 +188,18 @@ def test_multihost_aggregate_by_key_segment_psum():
         want[k] = want.get(k, 0.0) + v
     assert {k: round(v, 3) for k, v in got.items()} == \
         {k: round(v, 3) for k, v in want.items()}
+
+
+def test_join_empty_build_side(ctx):
+    left = ctx.parallelize([(1, "a")], columns=["k", "l"])
+    right = ctx.parallelize([(9, "x")], columns=["k", "r"]).filter(
+        lambda x: x["k"] < 0)   # empties the build side
+    assert left.join(right, "k", "k").collect() == []
+    assert left.leftJoin(right, "k", "k").collect() == [("a", 1, None)]
+
+
+def test_join_cross_dtype_keys(ctx):
+    # i64 keys vs f64 keys must match by VALUE (1 == 1.0)
+    left = ctx.parallelize([(1, "a"), (2, "b")], columns=["k", "l"])
+    right = ctx.parallelize([(1.0, "X"), (3.0, "Y")], columns=["k", "r"])
+    assert left.join(right, "k", "k").collect() == [("a", 1, "X")]

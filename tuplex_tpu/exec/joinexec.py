@@ -28,14 +28,20 @@ from .local import ExceptionRecord, StageResult
 
 
 def _key_signatures(part: C.Partition, ci: int) -> Optional[np.ndarray]:
-    """[N] object array of bytes signatures for the key column, None if the
-    column isn't vectorizable. None-valued keys get signature b'' + marker."""
+    """[N, W] byte-signature matrix for the key column, None if the column
+    isn't signature-comparable. Byte equality must IMPLY python equality:
+    floats normalize -0.0 and reject NaN (NaN != NaN, but bytes match)."""
     pieces = []
     for path, lt in C.flatten_type(part.schema.types[ci], str(ci)):
         leaf = part.leaves.get(path)
         if isinstance(leaf, C.NumericLeaf):
+            data = leaf.data
+            if data.dtype.kind == "f":
+                if np.isnan(data).any():
+                    return None  # NaN keys: python equality semantics differ
+                data = np.where(data == 0, 0.0, data)  # -0.0 == 0.0
             pieces.append(np.ascontiguousarray(
-                leaf.data.reshape(part.num_rows, -1)).view(np.uint8).reshape(
+                data.reshape(part.num_rows, -1)).view(np.uint8).reshape(
                     part.num_rows, -1))
             if leaf.valid is not None:
                 pieces.append(leaf.valid.reshape(-1, 1).view(np.uint8))
@@ -77,11 +83,23 @@ class JoinExecutor:
             rparts = res.partitions
             excs.extend(res.exceptions)
 
-        build = self._build_table(op, rparts or [])
+        # one path for ALL partitions so every output shares one schema
+        vec = _VectorBuild.try_build(op, rparts or [], self.backend)
+        if vec is not None and not all(
+                vec.can_probe(part) for part in left_partitions):
+            vec = None
+        build = None
         out_parts = []
         for part in left_partitions:
             self.backend.mm.touch(part)
-            outp = self._probe_partition(op, part, rparts or [], build, excs)
+            if vec is not None:
+                outp = vec.probe(part)
+                assert outp is not None
+            else:
+                if build is None:
+                    build = self._build_table(op, rparts or [])
+                outp = self._probe_partition(op, part, rparts or [], build,
+                                             excs)
             self.backend.mm.register(outp)
             out_parts.append(outp)
         m = {"wall_s": time.perf_counter() - t0,
@@ -155,3 +173,209 @@ def _hashable(v) -> bool:
         return True
     except TypeError:
         return False
+
+
+def _concat_leaves(parts: list[C.Partition]) -> Optional[C.Partition]:
+    """Concatenate partitions (same schema) into one; None if any leaf kind
+    can't concatenate."""
+    if not parts:
+        return None
+    C.harmonize_partitions(parts)
+    schema = parts[0].schema
+    paths = set(parts[0].leaves)
+    if any(set(p.leaves) != paths for p in parts):
+        return None
+    leaves: dict[str, C.Leaf] = {}
+    n = sum(p.num_rows for p in parts)
+    for path in paths:
+        ls = [p.leaves[path] for p in parts]
+        if all(isinstance(l, C.NumericLeaf) for l in ls):
+            data = np.concatenate([l.data for l in ls])
+            valid = None
+            if any(l.valid is not None for l in ls):
+                valid = np.concatenate(
+                    [l.valid if l.valid is not None
+                     else np.ones(len(l), np.bool_) for l in ls])
+            leaves[path] = C.NumericLeaf(data, valid)
+        elif all(isinstance(l, C.StrLeaf) for l in ls):
+            leaves[path] = C.StrLeaf(
+                np.concatenate([l.bytes for l in ls]),
+                np.concatenate([l.lengths for l in ls]),
+                np.concatenate([l.valid if l.valid is not None
+                                else np.ones(len(l), np.bool_)
+                                for l in ls])
+                if any(l.valid is not None for l in ls) else None)
+        elif all(isinstance(l, C.NullLeaf) for l in ls):
+            leaves[path] = C.NullLeaf(n)
+        else:
+            return None
+    return C.Partition(schema=schema, num_rows=n, leaves=leaves)
+
+
+def _gather_leaves(part: C.Partition, idx: np.ndarray, valid_rows=None
+                   ) -> Optional[dict]:
+    """Leaf dict gathered at idx; rows where valid_rows is False become
+    invalid slots (left-join None fill)."""
+    out: dict[str, C.Leaf] = {}
+    m = len(idx)
+    for path, leaf in part.leaves.items():
+        if isinstance(leaf, C.NumericLeaf):
+            data = leaf.data[idx] if m else leaf.data[:0]
+            valid = leaf.valid[idx] if leaf.valid is not None and m else (
+                leaf.valid[:0] if leaf.valid is not None else None)
+            if valid_rows is not None:
+                v = valid if valid is not None else np.ones(m, np.bool_)
+                valid = v & valid_rows
+                data = np.where(valid_rows, data, 0)
+            out[path] = C.NumericLeaf(data, valid)
+        elif isinstance(leaf, C.StrLeaf):
+            b = leaf.bytes[idx] if m else leaf.bytes[:0]
+            ln = leaf.lengths[idx] if m else leaf.lengths[:0]
+            valid = leaf.valid[idx] if leaf.valid is not None and m else (
+                leaf.valid[:0] if leaf.valid is not None else None)
+            if valid_rows is not None:
+                v = valid if valid is not None else np.ones(m, np.bool_)
+                valid = v & valid_rows
+            out[path] = C.StrLeaf(b, ln, valid)
+        elif isinstance(leaf, C.NullLeaf):
+            out[path] = C.NullLeaf(m)
+        else:
+            return None
+    return out
+
+
+class _VectorBuild:
+    """Vectorized broadcast-join build: unique build keys + CSR row groups.
+
+    The fast path of the reference's per-task hashtable probe
+    (LocalBackend.cc:213 + HashJoinStage), done with np.unique over key
+    signatures and numpy gathers — no per-row python on the hot path.
+    Applies when both sides are fully normal-case; anything boxed falls back
+    to the row-wise hybrid path.
+    """
+
+    @classmethod
+    def try_build(cls, op, rparts: list[C.Partition], backend):
+        if not rparts:
+            return None
+        if any(p.fallback for p in rparts):
+            return None
+        for p in rparts:
+            backend.mm.touch(p)
+        big = _concat_leaves(rparts)
+        if big is None or big.num_rows == 0:
+            return None  # empty build: row-wise path handles it
+        rk = big.schema.columns.index(op.right_column)
+        sig = _key_signatures(big, rk)
+        if sig is None:
+            return None
+        view = np.ascontiguousarray(sig).view(
+            [("v", np.void, sig.shape[1])]).ravel()
+        uniq, inverse = np.unique(view, return_inverse=True)
+        order = np.argsort(inverse, kind="stable")
+        counts = np.bincount(inverse, minlength=len(uniq))
+        offsets = np.concatenate([[0], np.cumsum(counts)])
+        self = cls()
+        self.op = op
+        self.big = big
+        self.rk = rk
+        self.uniq_view = uniq
+        self.order = order
+        self.counts = counts
+        self.offsets = offsets
+        self.key_width = sig.shape[1]
+        return self
+
+    def can_probe(self, lpart: C.Partition) -> bool:
+        """Cheap qualification; ALL partitions must pass or the whole join
+        uses the row-wise path (mixed paths would mix output schemas)."""
+        op = self.op
+        if lpart.fallback or op.left_column not in lpart.schema.columns:
+            return False
+        lk = lpart.schema.columns.index(op.left_column)
+        lt = lpart.schema.types[lk]
+        rt = self.big.schema.types[self.rk]
+        if lt.name != rt.name:
+            return False  # e.g. i64 vs f64 keys: byte equality would diverge
+        sig = _key_signatures(lpart, lk)
+        # width mismatch (str keys of different bucket W): fallback rather
+        # than padding — harmonize only covers one dataset's partitions
+        return sig is not None and sig.shape[1] == self.key_width
+
+    def probe(self, lpart: C.Partition) -> Optional[C.Partition]:
+        op = self.op
+        ls = lpart.schema
+        lk = ls.columns.index(op.left_column)
+        sig = _key_signatures(lpart, lk)
+        if sig is None or sig.shape[1] != self.key_width:
+            return None
+        return self._probe_sig(lpart, sig)
+
+    def _probe_sig(self, lpart: C.Partition, sig: np.ndarray
+                   ) -> Optional[C.Partition]:
+        op = self.op
+        ls = lpart.schema
+        lk = ls.columns.index(op.left_column)
+        n = lpart.num_rows
+        view = np.ascontiguousarray(sig).view(
+            [("v", np.void, sig.shape[1])]).ravel()
+        pos = np.searchsorted(self.uniq_view, view)
+        pos_c = np.clip(pos, 0, len(self.uniq_view) - 1)
+        matched = (pos < len(self.uniq_view)) & \
+            (self.uniq_view[pos_c] == view)
+        cnt = np.where(matched, self.counts[pos_c], 0)
+        if op.how == "left":
+            out_per_row = np.maximum(cnt, 1)
+        else:
+            out_per_row = cnt
+        m = int(out_per_row.sum())
+        left_idx = np.repeat(np.arange(n), out_per_row)
+        # build-row index per output row: offsets[code] + intra-group rank
+        row_starts = np.concatenate([[0], np.cumsum(out_per_row)])[:-1]
+        intra = np.arange(m) - np.repeat(row_starts, out_per_row)
+        code = self.offsets[np.repeat(pos_c, out_per_row)]
+        has_match = np.repeat(matched, out_per_row)
+        build_rows = np.where(
+            has_match, self.order[np.clip(code + intra, 0,
+                                          max(len(self.order) - 1, 0))], 0)
+        # gather left (minus key), key, right (minus key)
+        lgather = _gather_leaves(lpart, left_idx)
+        rgather = _gather_leaves(self.big, build_rows,
+                                 valid_rows=has_match
+                                 if op.how == "left" else None)
+        if lgather is None or rgather is None:
+            return None
+        rs = self.big.schema
+        out_cols: list[str] = []
+        out_types: list = []
+        leaves: dict[str, C.Leaf] = {}
+
+        def put(col_t, src_leaves, src_ci, make_opt=False):
+            ci_out = len(out_types)
+            t = col_t
+            if make_opt:
+                t = T.option(t)
+            out_types.append(t)
+            for path, leaf in src_leaves.items():
+                if path == str(src_ci) or path.startswith(f"{src_ci}.") or \
+                        path.startswith(f"{src_ci}#"):
+                    # make_opt leaves already carry validity: _gather_leaves
+                    # was called with valid_rows=has_match for left joins
+                    newp = str(ci_out) + path[len(str(src_ci)):]
+                    leaves[newp] = leaf
+
+        for i, (c, t) in enumerate(zip(ls.columns, ls.types)):
+            if i == lk:
+                continue
+            out_cols.append(op._decorate(c, 0))
+            put(t, lgather, i)
+        out_cols.append(op.left_column)
+        put(ls.types[lk], lgather, lk)
+        for i, (c, t) in enumerate(zip(rs.columns, rs.types)):
+            if i == self.rk:
+                continue
+            out_cols.append(op._decorate(c, 1))
+            put(t, rgather, i, make_opt=(op.how == "left"))
+        schema = T.row_of(out_cols, out_types)
+        return C.Partition(schema=schema, num_rows=m, leaves=leaves,
+                           start_index=lpart.start_index)

@@ -186,13 +186,14 @@ class LocalBackend:
         if device_fn is not None and part.n_normal() > 0:
             t0 = time.perf_counter()
             batch = C.stage_partition(part, self.bucket_mode)
-            first_call = ("stagefn", skey) not in getattr(
+            trace_key = ("stagefn", skey, batch.spec())  # jit retraces
+            first_call = trace_key not in getattr(          # per shape
                 self.jit_cache, "_traced", set())
             try:
                 outs = device_fn(batch.arrays)
                 if not hasattr(self.jit_cache, "_traced"):
                     self.jit_cache._traced = set()
-                self.jit_cache._traced.add(("stagefn", skey))
+                self.jit_cache._traced.add(trace_key)
             except NotCompilable:
                 # surfaces at TRACE time (first call): route to interpreter
                 self._not_compilable.add(skey)

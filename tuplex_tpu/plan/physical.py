@@ -385,6 +385,8 @@ def _apply_projection(stage: TransformStage) -> None:
 
 
 _op_compiles_cache: dict = {}
+import itertools as _it
+_uid_counter = _it.count()
 
 
 def op_compiles(op: L.LogicalOperator, input_schema: T.RowType) -> bool:
@@ -418,7 +420,13 @@ def _op_identity(op: L.LogicalOperator) -> str:
         for k in sorted(udf.globals):
             h.update(f"{k}={udf.globals[k]!r}".encode())
         if not udf.source:
-            h.update(str(id(udf.func)).encode())  # sourceless: object id
+            # a per-function uid (NOT id(): addresses get reused after GC)
+            try:
+                uid = udf.func.__dict__.setdefault(
+                    "__tpx_uid__", f"u{next(_uid_counter)}")
+            except (AttributeError, TypeError):
+                uid = f"anon{id(udf.func)}"
+            h.update(str(uid).encode())
     for attr in ("column", "selected", "old", "new", "null_values"):
         if hasattr(op, attr):
             h.update(repr(getattr(op, attr)).encode())
