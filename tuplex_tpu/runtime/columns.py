@@ -701,6 +701,8 @@ def partition_to_pylist(part: Partition) -> list:
     """Bulk row decode (reference analog: PythonDataSet.cc fast decoders —
     bulk converters instead of per-row boxing)."""
     n = part.num_rows
+    if n == 0:
+        return []  # empty partitions may carry no leaf arrays at all
     cols = []
     for ci, ct in enumerate(part.schema.types):
         cols.append(_column_pylist(part, str(ci), ct, n))
