@@ -354,3 +354,32 @@ def test_ambiguous_closure_lambdas_fall_back():
                 body=_ast.parse(s.source, mode="eval").body),
                 "<t>", "eval"), {"y": y})
             assert lam(10) == f(10)
+
+
+def test_option_equality_no_typeerror():
+    # Python: None == "x" -> False, None == None -> True; no exception
+    vals = ["A", None, "B", None]
+    check(lambda x: x == "A", vals)
+    check(lambda x: x != "A", vals)
+    check(lambda x: "yes" if x == "A" else "no", vals)
+    nums = [1, None, 3]
+    check(lambda x: x == 1, nums)
+    check(lambda x: x != 1, nums)
+
+
+def test_null_column_in_dead_branch_compiles():
+    # all-None column used inside a branch that's dead for those rows:
+    # must compile and never raise for rows that don't take the branch
+    rows = [(None, 10.0), (None, 20.0)]
+    check(lambda x: float(x["d"]) if x["d"] else x["v"], rows,
+          columns=["d", "v"])
+    check(lambda x: len(x["d"]) if x["d"] else -1, rows, columns=["d", "v"])
+    # and rows that DO hit the null op raise TypeError like Python
+    check(lambda x: float(x["d"]), rows, columns=["d", "v"])
+
+
+def test_mixed_type_option_equality():
+    # Option[str] vs Option[i64]: values never equal, but None == None
+    rows = [("A", 1), (None, None), ("B", 2), (None, 3)]
+    check(lambda x: x["s"] == x["n"], rows, columns=["s", "n"])
+    check(lambda x: x["s"] != x["n"], rows, columns=["s", "n"])

@@ -440,6 +440,11 @@ class Frame:
 
     def _require_numeric(self, v: CV, what: str) -> CV:
         v = self._unwrap_option(v, what)
+        if v.t is T.NULL:
+            # the TypeError is already flagged under the ACTIVE mask by
+            # _unwrap_option; a typed dummy lets dead branches trace on
+            # (e.g. `float(x) if x else d` over an all-null column)
+            return CV(t=T.I64, data=jnp.zeros(self.ctx.b, dtype=jnp.int64))
         if v.is_const:
             if isinstance(v.const, (bool, int, float)):
                 return materialize(v, self.ctx.b)
@@ -451,9 +456,9 @@ class Frame:
     def _unwrap_option(self, v: CV, what: str) -> CV:
         """Using an Option value in a non-None-tolerant op raises TypeError
         for rows where it's None (Python: None + 1 -> TypeError)."""
-        if v.t is T.NULL and not v.is_const:
+        if v.t is T.NULL:  # incl. the literal None constant
             self.raise_where(jnp.ones(self.ctx.b, bool), ExceptionCode.TYPEERROR)
-            return v
+            return CV(t=T.NULL)  # non-const marker: callers emit typed dummies
         if v.valid is not None:
             self.raise_where(~v.valid, ExceptionCode.TYPEERROR)
             return CV(t=v.base, data=v.data, sbytes=v.sbytes, slen=v.slen,
@@ -569,9 +574,23 @@ class Frame:
                   data=jnp.power(self._as_i64(a), jnp.where(neg, 0, bd)))
 
     # -- string ops ---------------------------------------------------------
-    def _to_strpair(self, v: CV):
-        """(bytes, lens) for a str CV (materializing consts)."""
-        v = self._unwrap_option(v, "string op")
+    def _option_eq(self, a: CV, b: CV, raw_eq, op):
+        """Validity-aware equality truth table: values equal AND both
+        present, OR both None (Python: None == None)."""
+        av = a.valid if a.valid is not None else jnp.ones(self.ctx.b, bool)
+        bv = b.valid if b.valid is not None else jnp.ones(self.ctx.b, bool)
+        a_null = a.t is T.NULL
+        b_null = b.t is T.NULL
+        if a_null:
+            av = jnp.zeros(self.ctx.b, bool)
+        if b_null:
+            bv = jnp.zeros(self.ctx.b, bool)
+        eq = (av & bv & raw_eq) | (~av & ~bv)
+        return eq if isinstance(op, ast.Eq) else ~eq
+
+    def _strip_option_strpair(self, v: CV):
+        """(bytes, lens) of a possibly-Option str WITHOUT raising for None
+        rows (callers gate on validity themselves)."""
         if v.is_const:
             if not isinstance(v.const, str):
                 raise NotCompilable("expected str")
@@ -579,6 +598,13 @@ class Frame:
         if v.base is not T.STR:
             raise NotCompilable(f"expected str, got {v.t}")
         return v.sbytes, v.slen
+
+    def _to_strpair(self, v: CV):
+        """(bytes, lens) for a str CV (materializing consts)."""
+        v = self._unwrap_option(v, "string op")
+        if v.t is T.NULL:  # error already flagged under the active mask
+            return S.broadcast_const("", self.ctx.b)
+        return self._strip_option_strpair(v)
 
     def _str_binop(self, op: ast.operator, a: CV, b: CV) -> CV:
         if isinstance(op, ast.Add):
@@ -859,6 +885,13 @@ class Frame:
         a_str = a.base is T.STR or (a.is_const and isinstance(a.const, str))
         b_str = b.base is T.STR or (b.is_const and isinstance(b.const, str))
         if a_str and b_str:
+            if isinstance(op, (ast.Eq, ast.NotEq)) and \
+                    (a.valid is not None or b.valid is not None):
+                # Python: None == "x" is False (no TypeError) — keep Option
+                # rows on device instead of erroring them to the interpreter
+                ab, al = self._strip_option_strpair(a)
+                bb, bl = self._strip_option_strpair(b)
+                return self._option_eq(a, b, S.equals(ab, al, bb, bl), op)
             ab, al = self._to_strpair(a)
             bb, bl = self._to_strpair(b)
             if isinstance(op, ast.Eq):
@@ -875,13 +908,20 @@ class Frame:
                 return S.compare_lt(bb, bl, ab, al, or_equal=True)
             raise NotCompilable("string comparison op")
         if a_str != b_str:
-            # str vs non-str: == False, != True; ordering raises TypeError
-            if isinstance(op, ast.Eq):
-                return jnp.zeros(self.ctx.b, dtype=bool)
-            if isinstance(op, ast.NotEq):
-                return jnp.ones(self.ctx.b, dtype=bool)
+            # str vs non-str: values never equal, but None == None is True
+            # when both sides are Option/None
+            if isinstance(op, (ast.Eq, ast.NotEq)):
+                return self._option_eq(a, b,
+                                       jnp.zeros(self.ctx.b, dtype=bool), op)
             self.raise_where(jnp.ones(self.ctx.b, bool), ExceptionCode.TYPEERROR)
             return jnp.zeros(self.ctx.b, dtype=bool)
+        if isinstance(op, (ast.Eq, ast.NotEq)) and \
+                (a.valid is not None or b.valid is not None):
+            a2 = CV(t=a.base, data=a.data) if a.valid is not None else a
+            b2 = CV(t=b.base, data=b.data) if b.valid is not None else b
+            an = self._require_numeric(a2, "comparison")
+            bn = self._require_numeric(b2, "comparison")
+            return self._option_eq(a, b, an.data == bn.data, op)
         an = self._require_numeric(a, "comparison")
         bn = self._require_numeric(b, "comparison")
         ad, bd = an.data, bn.data
@@ -931,6 +971,8 @@ class Frame:
             except (ValueError, TypeError):
                 pass
         v = self._unwrap_option(v, "int()")
+        if v.t is T.NULL:
+            return CV(t=T.I64, data=jnp.zeros(self.ctx.b, dtype=jnp.int64))
         if v.base is T.STR:
             val, bad = S.parse_i64(v.sbytes, v.slen)
             self.raise_where(bad, ExceptionCode.VALUEERROR)
@@ -951,6 +993,8 @@ class Frame:
             except (ValueError, TypeError):
                 pass
         v = self._unwrap_option(v, "float()")
+        if v.t is T.NULL:  # error already flagged; dummy keeps tracing
+            return CV(t=T.F64, data=jnp.zeros(self.ctx.b, dtype=jnp.float64))
         if v.base is T.STR:
             val, bad = S.parse_f64(v.sbytes, v.slen)
             self.raise_where(bad, ExceptionCode.VALUEERROR)
@@ -974,10 +1018,15 @@ class Frame:
     def _builtin_len(self, args: list[CV]) -> CV:
         v = args[0]
         if v.is_const:
-            return const_cv(len(v.const))
+            try:
+                return const_cv(len(v.const))
+            except TypeError:
+                pass  # e.g. None: falls through to the unwrap error path
         if v.elts is not None:
             return const_cv(len(v.elts))
         v = self._unwrap_option(v, "len()")
+        if v.t is T.NULL:
+            return CV(t=T.I64, data=jnp.zeros(self.ctx.b, dtype=jnp.int64))
         if v.base is T.STR:
             self._ascii_guard(v.sbytes, v.slen)
             return CV(t=T.I64, data=v.slen.astype(jnp.int64))
