@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import tempfile
 import time
@@ -26,10 +27,59 @@ import time
 N_ROWS = int(os.environ.get("BENCH_ROWS", "100000"))
 BASELINE_ROWS = int(os.environ.get("BENCH_BASELINE_ROWS", "40000"))
 RUNS = int(os.environ.get("BENCH_RUNS", "2"))
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "240"))
+PROBE_ATTEMPTS = int(os.environ.get("BENCH_TPU_PROBE_ATTEMPTS", "3"))
+
+
+def _probe_tpu() -> str:
+    """Decide the platform BEFORE any in-process backend init.
+
+    Round 1 failed here: the axon TPU tunnel raised UNAVAILABLE mid-trace,
+    the framework silently fell back to the interpreter, and the recorded
+    number measured the wrong thing entirely. Strategy: probe the TPU in a
+    SUBPROCESS (a wedged tunnel then hangs the child, not the bench), retry
+    with backoff, and if the TPU is genuinely unreachable run on CPU XLA —
+    the compiled path still executes and fast_path_s stays honest — while
+    shouting the platform downgrade on stderr.
+    """
+    probe_src = (
+        "import jax; ds = jax.devices(); "
+        "print('PLATFORM=' + ds[0].platform)"
+    )
+    for attempt in range(PROBE_ATTEMPTS):
+        try:
+            r = subprocess.run([sys.executable, "-c", probe_src],
+                               capture_output=True, text=True,
+                               timeout=PROBE_TIMEOUT_S)
+            for line in r.stdout.splitlines():
+                if line.startswith("PLATFORM="):
+                    plat = line.split("=", 1)[1]
+                    print(f"bench: TPU probe attempt {attempt + 1}: "
+                          f"platform={plat}", file=sys.stderr)
+                    if plat != "cpu":
+                        return plat
+            print(f"bench: TPU probe attempt {attempt + 1} failed "
+                  f"(rc={r.returncode}): {r.stderr.strip()[-400:]}",
+                  file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"bench: TPU probe attempt {attempt + 1} timed out after "
+                  f"{PROBE_TIMEOUT_S}s (wedged tunnel?)", file=sys.stderr)
+        if attempt + 1 < PROBE_ATTEMPTS:
+            time.sleep(15 * (attempt + 1))
+    print("bench: *** TPU UNAVAILABLE — benchmarking on CPU XLA. This is "
+          "NOT the headline configuration. ***", file=sys.stderr)
+    return "cpu"
 
 
 def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    platform = _probe_tpu()
+    import jax
+
+    if platform == "cpu":
+        # sitecustomize force-registers the axon plugin; only a post-import
+        # config update keeps backend init off the wedge-prone tunnel
+        jax.config.update("jax_platforms", "cpu")
     import tuplex_tpu
     from tuplex_tpu.models import zillow
 
@@ -69,22 +119,33 @@ def main() -> None:
         print(f"OUTPUT MISMATCH: got {len(got)} rows, want {len(want)}",
               file=sys.stderr)
 
+    fast_s = ctx.metrics.fastPathWallTime()
     result = {
         "metric": "zillow_z1_rows_per_sec",
         "value": round(rate, 1),
         "unit": "rows/s",
         "vs_baseline": round(rate / base_rate, 3),
+        "platform": platform,
     }
     # extra context on stderr (driver only parses stdout JSON line)
     print(json.dumps({
         "rows": N_ROWS, "best_s": round(best, 3),
         "runs_s": [round(t, 3) for t in times],
+        "platform": platform,
         "interp_rows_per_sec": round(base_rate, 1),
         "output_rows": len(got) if got else 0,
         "output_matches_interpreter": ok,
-        "fast_path_s": round(ctx.metrics.fastPathWallTime(), 3),
+        "fast_path_s": round(fast_s, 3),
         "slow_path_s": round(ctx.metrics.slowPathWallTime(), 3),
     }), file=sys.stderr)
+    if fast_s == 0.0:
+        # the whole pipeline ran on the interpreter: the number above does
+        # not measure the compiled path at all. Never report that silently.
+        print("bench: *** FAST PATH NEVER RAN — the number above measures "
+              "the interpreter fallback, not the framework. ***",
+              file=sys.stderr)
+        if os.environ.get("BENCH_REQUIRE_FAST"):
+            sys.exit(1)
     print(json.dumps(result))
 
 

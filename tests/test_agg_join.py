@@ -203,3 +203,46 @@ def test_join_cross_dtype_keys(ctx):
     left = ctx.parallelize([(1, "a"), (2, "b")], columns=["k", "l"])
     right = ctx.parallelize([(1.0, "X"), (3.0, "Y")], columns=["k", "r"])
     assert left.join(right, "k", "k").collect() == [("a", 1, "X")]
+
+
+def test_join_option_key_csv_null_values(ctx, tmp_path):
+    # ADVICE r1 (high): CSV None keys kept their original sbytes ('NA') so the
+    # vectorized probe gave the same python None distinct signatures and
+    # silently dropped matches vs the row-wise dict path.
+    p = tmp_path / "left.csv"
+    p.write_text("k,v\nx,1\nNA,2\ny,3\nNA,4\n")
+    left = ctx.csv(str(p), null_values=["NA"])
+    right = ctx.parallelize([(None, "none"), ("x", "ex")],
+                            columns=["k", "w"])
+    got = sorted(left.join(right, "k", "k").collect())
+    # python dict semantics: None == None matches both NA rows
+    assert got == [(1, "x", "ex"), (2, None, "none"), (4, None, "none")]
+
+
+def test_aggregate_by_key_option_csv_null_values(ctx, tmp_path):
+    # same canonicalization defect class in _factorize_keys: two None keys
+    # with different raw placeholder bytes must land in ONE group
+    p = tmp_path / "t.csv"
+    p.write_text("k,v\nNA,1\nnull,2\na,3\nNA,4\n")
+    ds = ctx.csv(str(p), null_values=["NA", "null"]).aggregateByKey(
+        lambda a, b: a + b, lambda a, r: a + r["v"], 0, ["k"])
+    got = dict(ds.collect())
+    assert got == {None: 7, "a": 3}
+
+
+def test_multihost_non_pow2_mesh():
+    # r1 weak: 6 devices silently became 4 (plus a dead pow2 raise). Now the
+    # batch pads to a multiple of the mesh size; padded rows carry
+    # #rowvalid=False and outputs slice back to the true row count.
+    import tuplex_tpu
+
+    c = tuplex_tpu.Context({"tuplex.backend": "multihost",
+                            "tuplex.tpu.meshShape": "6"})
+    assert c.backend.n_devices == 6
+    data = list(range(1000))
+    got = c.parallelize(data).map(lambda x: x * 2).filter(
+        lambda x: x % 3 == 0).collect()
+    assert got == [x * 2 for x in data if (x * 2) % 3 == 0]
+    res = c.parallelize(data).aggregate(
+        lambda a, b: a + b, lambda a, x: a + x, 0).collect()
+    assert res == [sum(data)]

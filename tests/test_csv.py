@@ -169,3 +169,30 @@ def test_pushdown_keeps_map_resolver_columns(ctx, csvdir):
           .map(lambda x: 100 // x["a"])
           .resolve(ZeroDivisionError, lambda x: x["b"]))
     assert ds.collect() == [100, 20, 33]
+
+
+def test_csv_user_columns_override_with_projection(ctx, tmp_path):
+    # ADVICE r1 (medium): with header=True + user-overridden column names,
+    # projection pushdown keyed Arrow include_columns by the user names while
+    # the table was read under the FILE's header names -> ArrowKeyError.
+    p = tmp_path / "o.csv"
+    p.write_text("colA,colB,colC\n1,x,10\n2,y,20\n3,z,30\n")
+    ds = ctx.csv(str(p), columns=["a", "b", "c"], header=True)
+    # subset-reading UDF triggers projection pushdown into the Arrow read
+    got = ds.map(lambda r: r["c"]).collect()
+    assert got == [10, 20, 30]
+    # no-projection path: cells must still be read as strings then decoded
+    got2 = sorted(ctx.csv(str(p), columns=["a", "b", "c"],
+                          header=True).collect())
+    assert got2 == [(1, "x", 10), (2, "y", 20), (3, "z", 30)]
+
+
+def test_malformed_rows_merge_in_order(ctx, csvdir):
+    # ADVICE r1 (low): structurally-invalid rows must come back at their
+    # ORIGINAL positions (reference merge-in-order), not as a trailing blob
+    path = write(csvdir / "m.csv",
+                 "a,b\n1,x\n2,y,EXTRA\n3,z\n4,w,E,F\n5,v\n")
+    got = ctx.csv(path).map(lambda r: r["a"]).collect()
+    # bad rows (2 and 4) box through the fallback path; their first cell
+    # still parses as the normal-case i64 via the interpreter
+    assert got == [1, 2, 3, 4, 5]

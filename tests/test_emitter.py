@@ -383,3 +383,11 @@ def test_mixed_type_option_equality():
     rows = [("A", 1), (None, None), ("B", 2), (None, 3)]
     check(lambda x: x["s"] == x["n"], rows, columns=["s", "n"])
     check(lambda x: x["s"] != x["n"], rows, columns=["s", "n"])
+
+
+def test_format_percent_escape():
+    # ADVICE r1 (low): '%%d' must render the literal '%d' without consuming
+    # an argument (CPython treats %% as an escape wherever it appears)
+    check(lambda x: "100%% of %d" % x, [42, -1])
+    check(lambda x: "%d%%" % x, [7])
+    check(lambda x: "%s%%%s" % (x, x), ["a", "bc"])

@@ -179,3 +179,21 @@ def test_format_i64():
     assert S.to_python_strings(b, l) == [str(int(v)) for v in vals]
     b, l = S.format_i64(vals, width=5, pad_zero=True)
     assert S.to_python_strings(b, l) == ["%05d" % int(v) for v in vals]
+
+
+def test_parse_i64_19_digit_overflow():
+    # ADVICE r1 (low): 19-digit values above i64 max wrapped silently in the
+    # Horner loop instead of routing to the interpreter
+    vals = ["9223372036854775807",      # i64 max: fine
+            "9223372036854775808",      # max+1: must flag bad
+            "9999999999999999999",      # 19 nines: must flag bad
+            "-9223372036854775807",     # -max: fine
+            "1000000000000000000"]      # 19 digits, in range: fine
+    b, l = enc(vals)
+    got, bad = S.parse_i64(b, l)
+    bad = np.asarray(bad).tolist()
+    got = np.asarray(got).tolist()
+    assert bad == [False, True, True, False, False]
+    assert got[0] == 9223372036854775807
+    assert got[3] == -9223372036854775807
+    assert got[4] == 10 ** 18

@@ -93,6 +93,36 @@ def materialize(cv: CV, b: int) -> CV:
     raise NotCompilable(f"cannot materialize constant {type(v).__name__}")
 
 
+def cv_arrays(cv: CV, out: list) -> None:
+    """Append the CV tree's arrays to `out` in deterministic order
+    (inverse: cv_rebuild)."""
+    if cv.is_const:
+        return
+    for f in ("data", "valid", "sbytes", "slen"):
+        v = getattr(cv, f)
+        if v is not None:
+            out.append(v)
+    if cv.elts is not None:
+        for e in cv.elts:
+            cv_arrays(e, out)
+
+
+def cv_rebuild(cv: CV, it) -> CV:
+    """Rebuild a CV tree consuming arrays from `it`."""
+    import dataclasses
+
+    if cv.is_const:
+        return cv
+    kw = {}
+    for f in ("data", "valid", "sbytes", "slen"):
+        if getattr(cv, f) is not None:
+            kw[f] = next(it)
+    elts = cv.elts
+    if elts is not None:
+        elts = tuple(cv_rebuild(e, it) for e in elts)
+    return dataclasses.replace(cv, elts=elts, **kw)
+
+
 def dtype_for(t: T.Type):
     if t is T.BOOL:
         return np.bool_

@@ -440,29 +440,12 @@ def _row_signatures(part: C.Partition) -> Optional[np.ndarray]:
 def _factorize_keys(part: C.Partition, kidx: list[int], ok_mask: np.ndarray):
     """(codes[n_ok], unique_first_row_indices) — vectorized key factorization
     over the key columns' leaf bytes."""
-    pieces = []
-    for ci in kidx:
-        for path, lt in C.flatten_type(part.schema.types[ci], str(ci)):
-            leaf = part.leaves.get(path)
-            if isinstance(leaf, C.NumericLeaf):
-                pieces.append(np.ascontiguousarray(
-                    leaf.data.reshape(part.num_rows, -1)).view(
-                        np.uint8).reshape(part.num_rows, -1))
-                if leaf.valid is not None:
-                    pieces.append(leaf.valid.reshape(-1, 1).view(np.uint8))
-            elif isinstance(leaf, C.StrLeaf):
-                pieces.append(leaf.bytes)
-                pieces.append(leaf.lengths.astype("<i4").view(
-                    np.uint8).reshape(part.num_rows, -1))
-                if leaf.valid is not None:
-                    pieces.append(leaf.valid.reshape(-1, 1).view(np.uint8))
-            elif isinstance(leaf, C.NullLeaf):
-                continue
-            else:
-                return None, None
-    if not pieces:
+    # canonical signatures: None slots zeroed, stale str padding zeroed —
+    # raw leaf bytes would give the same python key distinct group codes
+    # (same defect class as the joinexec Option-key bug)
+    mat = C.key_signature_matrix(part, kidx, reject_nan=False)
+    if mat is None:
         return None, None
-    mat = np.ascontiguousarray(np.concatenate(pieces, axis=1))
     sub = mat[ok_mask]
     if len(sub) == 0:
         return np.zeros(0, np.int32), np.zeros(0, np.int64)

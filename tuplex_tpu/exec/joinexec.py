@@ -28,37 +28,10 @@ from .local import ExceptionRecord, StageResult
 
 
 def _key_signatures(part: C.Partition, ci: int) -> Optional[np.ndarray]:
-    """[N, W] byte-signature matrix for the key column, None if the column
-    isn't signature-comparable. Byte equality must IMPLY python equality:
-    floats normalize -0.0 and reject NaN (NaN != NaN, but bytes match)."""
-    pieces = []
-    for path, lt in C.flatten_type(part.schema.types[ci], str(ci)):
-        leaf = part.leaves.get(path)
-        if isinstance(leaf, C.NumericLeaf):
-            data = leaf.data
-            if data.dtype.kind == "f":
-                if np.isnan(data).any():
-                    return None  # NaN keys: python equality semantics differ
-                data = np.where(data == 0, 0.0, data)  # -0.0 == 0.0
-            pieces.append(np.ascontiguousarray(
-                data.reshape(part.num_rows, -1)).view(np.uint8).reshape(
-                    part.num_rows, -1))
-            if leaf.valid is not None:
-                pieces.append(leaf.valid.reshape(-1, 1).view(np.uint8))
-        elif isinstance(leaf, C.StrLeaf):
-            pieces.append(leaf.bytes)
-            pieces.append(leaf.lengths.astype("<i4").view(np.uint8).reshape(
-                part.num_rows, -1))
-            if leaf.valid is not None:
-                pieces.append(leaf.valid.reshape(-1, 1).view(np.uint8))
-        elif isinstance(leaf, C.NullLeaf):
-            pieces.append(np.zeros((part.num_rows, 1), np.uint8))
-        else:
-            return None
-    if not pieces:
-        return None
-    mat = np.ascontiguousarray(np.concatenate(pieces, axis=1))
-    return mat
+    """[N, W] canonical byte-signature matrix for the key column, None if the
+    column isn't signature-comparable (see C.key_signature_matrix for the
+    canonicalization contract — byte equality must imply python equality)."""
+    return C.key_signature_matrix(part, [ci], reject_nan=True)
 
 
 class JoinExecutor:

@@ -50,10 +50,15 @@ class StageResult:
 
 class JitCache:
     """LRU cache of compiled stage executables (reference analog: ORCv2
-    LLJIT symbol cache, core/include/llvm13/JITCompiler_llvm13.h:30-72)."""
+    LLJIT symbol cache, core/include/llvm13/JITCompiler_llvm13.h:30-72).
+
+    Traced-shape bookkeeping lives WITH the cache entry and is dropped on
+    eviction — round 1 bolted it on externally, so a rebuilt evicted stage
+    claimed first_call=False and turned a trace failure into a hard raise."""
 
     def __init__(self, capacity: int = 128):
         self._store: OrderedDict = OrderedDict()
+        self._traced: dict = {}           # key -> set of batch specs
         self.capacity = capacity
         self.hits = 0
         self.misses = 0
@@ -66,9 +71,17 @@ class JitCache:
         self.misses += 1
         fn = builder()
         self._store[key] = fn
+        self._traced.pop(key, None)       # fresh executable: nothing traced
         if len(self._store) > self.capacity:
-            self._store.popitem(last=False)
+            old_key, _ = self._store.popitem(last=False)
+            self._traced.pop(old_key, None)
         return fn
+
+    def was_traced(self, key, spec) -> bool:
+        return spec in self._traced.get(key, ())
+
+    def note_traced(self, key, spec) -> None:
+        self._traced.setdefault(key, set()).add(spec)
 
 
 class LocalBackend:
@@ -186,14 +199,12 @@ class LocalBackend:
         if device_fn is not None and part.n_normal() > 0:
             t0 = time.perf_counter()
             batch = C.stage_partition(part, self.bucket_mode)
-            trace_key = ("stagefn", skey, batch.spec())  # jit retraces
-            first_call = trace_key not in getattr(          # per shape
-                self.jit_cache, "_traced", set())
+            cache_key = ("stagefn", skey)
+            spec = batch.spec()                     # jit retraces per shape
+            first_call = not self.jit_cache.was_traced(cache_key, spec)
             try:
                 outs = device_fn(batch.arrays)
-                if not hasattr(self.jit_cache, "_traced"):
-                    self.jit_cache._traced = set()
-                self.jit_cache._traced.add(trace_key)
+                self.jit_cache.note_traced(cache_key, spec)
             except NotCompilable:
                 # surfaces at TRACE time (first call): route to interpreter
                 self._not_compilable.add(skey)
@@ -227,16 +238,21 @@ class LocalBackend:
             fallback_idx.update(range(n))
 
         # ---- interpreter path (ResolveTask analog) ------------------------
+        # one compiled closure chain per stage + bulk row decode: no per-row
+        # op dispatch (reference: PythonPipelineBuilder.cc)
         t0 = time.perf_counter()
         resolved: dict[int, Row] = {}
         exceptions: list[ExceptionRecord] = []
-        for i in sorted(fallback_idx):
-            row = part.decode_row(i)
-            status, payload = run_python_pipeline(stage.ops, row)
-            if status == "ok":
-                resolved[i] = payload
-            elif status == "exc":
-                exceptions.append(payload)
+        if fallback_idx:
+            pipeline = stage.python_pipeline()
+            order = sorted(fallback_idx)
+            for i, row in zip(order, C.decode_rows(part, order)):
+                status, payload = pipeline(row)
+                if status == "ok":
+                    resolved[i] = payload
+                elif status == "exc":
+                    op_id, exc_name, value = payload
+                    exceptions.append(ExceptionRecord(op_id, exc_name, value))
         metrics["slow_path_s"] = time.perf_counter() - t0
 
         outp = self._merge(stage, part, compiled_ok, out_arrays, resolved)
@@ -394,115 +410,5 @@ def _try_fold_row(leaves: dict, schema: T.RowType, k: int, value: Any) -> bool:
     return True
 
 
-# ---------------------------------------------------------------------------
-# interpreter pipeline (PythonPipelineBuilder + ResolveTask analog)
-# ---------------------------------------------------------------------------
-
-def run_python_pipeline(ops: list[L.LogicalOperator], row: Row):
-    """Run one row through the operator chain in CPython, honoring
-    resolvers/ignores attached after each operator (reference:
-    physical/ResolveTask.cc — compiled resolver first, else interpreter,
-    cascade to fallback)."""
-    i = 0
-    while i < len(ops):
-        op = ops[i]
-        if isinstance(op, (L.ResolveOperator, L.IgnoreOperator,
-                           L.TakeOperator)):
-            i += 1
-            continue
-        try:
-            row2 = _apply_op_python(op, row)
-        except Exception as e:
-            # scan resolvers attached directly after this operator
-            j = i + 1
-            handled = False
-            while j < len(ops) and isinstance(
-                    ops[j], (L.ResolveOperator, L.IgnoreOperator)):
-                r = ops[j]
-                if isinstance(e, r.exc_class):
-                    if isinstance(r, L.IgnoreOperator):
-                        return "drop", None
-                    try:
-                        row2 = _apply_resolver_python(op, r, row)
-                        handled = True
-                        break
-                    except Exception:
-                        pass  # resolver itself raised: try next
-                j += 1
-            if not handled:
-                return "exc", ExceptionRecord(op.id, type(e).__name__,
-                                              row.unwrap())
-        if row2 is None and isinstance(op, L.FilterOperator):
-            return "drop", None
-        row = row2
-        i += 1
-    return "ok", row
-
-
-def _apply_op_python(op: L.LogicalOperator, row: Row) -> Optional[Row]:
-    if isinstance(op, L.MapOperator):
-        v = L.apply_udf_python(op.udf, row)
-        if isinstance(v, dict):
-            return Row(list(v.values()), list(v.keys()))
-        return Row.from_value(v, op.columns())
-    if isinstance(op, L.FilterOperator):
-        return row if L.apply_udf_python(op.udf, row) else None
-    if isinstance(op, L.WithColumnOperator):
-        v = L.apply_udf_python(op.udf, row)
-        cols = list(row.columns or ())
-        vals = list(row.values)
-        if op.column in cols:
-            vals[cols.index(op.column)] = v
-        else:
-            cols.append(op.column)
-            vals.append(v)
-        return Row(vals, cols)
-    if isinstance(op, L.MapColumnOperator):
-        ci = list(row.columns or ()).index(op.column)
-        vals = list(row.values)
-        vals[ci] = op.udf.func(vals[ci])
-        return Row(vals, row.columns)
-    if isinstance(op, L.SelectColumnsOperator):
-        s = op.schema()
-        if row.columns is not None:
-            idx = [list(row.columns).index(c) if isinstance(c, str)
-                   else (c if c >= 0 else len(row.values) + c)
-                   for c in op.selected]
-        else:
-            idx = op._resolve_indices()
-        return Row([row.values[i] for i in idx], s.columns)
-    if isinstance(op, L.RenameColumnOperator):
-        return Row(row.values, op.schema().columns)
-    if isinstance(op, L.DecodeOperator):
-        vals = [L.decode_cell_python(v, t, op.null_values)
-                for v, t in zip(row.values, op.declared.types)]
-        from ..runtime.columns import user_columns
-
-        return Row(vals, user_columns(op.declared))
-    raise TuplexException(f"interpreter: unsupported op {op!r}")
-
-
-def _apply_resolver_python(op: L.LogicalOperator, res: L.ResolveOperator,
-                           row: Row) -> Optional[Row]:
-    v = L.apply_udf_python(res.udf, row)
-    if isinstance(op, L.FilterOperator):
-        return row if v else None
-    if isinstance(op, L.MapOperator):
-        if isinstance(v, dict):
-            return Row(list(v.values()), list(v.keys()))
-        return Row.from_value(v, op.columns())
-    if isinstance(op, L.WithColumnOperator):
-        cols = list(row.columns or ())
-        vals = list(row.values)
-        if op.column in cols:
-            vals[cols.index(op.column)] = v
-        else:
-            cols.append(op.column)
-            vals.append(v)
-        return Row(vals, cols)
-    if isinstance(op, L.MapColumnOperator):
-        ci = list(row.columns or ()).index(op.column)
-        vals = list(row.values)
-        vals[ci] = v
-        return Row(vals, row.columns)
-    return Row.from_value(v, op.columns())
+# interpreter pipeline: see compiler/pypipeline.build_python_pipeline
+# (PythonPipelineBuilder + ResolveTask analog), driven per stage above.

@@ -33,23 +33,22 @@ class MultiHostBackend(LocalBackend):
 
         shape = options.get_str("tuplex.tpu.meshShape", "auto")
         n = len(jax.devices()) if shape == "auto" else int(shape.split("x")[0])
-        # pow2 batch buckets must shard evenly: round down to a power of two
-        p2 = 1 << (n.bit_length() - 1)
-        if p2 != n:
-            from ..utils.logging import get_logger
-
-            get_logger("multihost").warning(
-                "mesh size %d is not a power of two; using %d devices", n, p2)
-            n = p2
         self.mesh = M.make_mesh(n)
         self.n_devices = n
 
     def _jit_stage_fn(self, raw_fn):
-        if self.n_devices & (self.n_devices - 1):
-            raise ValueError(
-                "mesh size must be a power of two so pow2 batch buckets "
-                "shard evenly (got %d devices)" % self.n_devices)
-        return M.shard_stage_fn(raw_fn, self.mesh)
+        """Row-shard over ALL mesh devices. Non-pow2 meshes work too: the
+        batch pads up to a multiple of the mesh size before dispatch (padded
+        rows carry #rowvalid=False and the host slices outputs back to the
+        partition's row count) — round 1 silently rounded 6 devices down to
+        4 and kept a dead pow2 raise here."""
+        inner = M.shard_stage_fn(raw_fn, self.mesh)
+        n_dev = self.n_devices
+
+        def padded_dispatch(arrays):
+            return inner(M.pad_batch_for_mesh(arrays, n_dev))
+
+        return padded_dispatch
 
 
 def init_multihost(coordinator_address: Optional[str] = None,

@@ -233,10 +233,17 @@ class CSVSourceOperator(L.LogicalOperator):
             bad_rows.append((row.number or 0, row.text or ""))
             return "skip"
 
+        # Always read under the USER-FACING column names (skipping the header
+        # line instead of parsing it): with user-overridden `columns=`, the
+        # file's header names differ from stat.columns, and keying
+        # include_columns / column_types by the wrong namespace raised
+        # ArrowKeyError / silently skipped the read-as-string coercion
+        # (advisor finding, round 1).
         read_opts = pacsv.ReadOptions(
             use_threads=True,
             block_size=1 << 24,
-            column_names=stat.columns if not stat.has_header else None,
+            column_names=stat.columns,
+            skip_rows=1 if stat.has_header else 0,
             autogenerate_column_names=False)
         parse_opts = pacsv.ParseOptions(
             delimiter=stat.delimiter,
@@ -251,13 +258,23 @@ class CSVSourceOperator(L.LogicalOperator):
         table = pacsv.read_csv(path, read_options=read_opts,
                                parse_options=parse_opts,
                                convert_options=conv_opts)
-        if not projection and stat.has_header and \
-                table.column_names != stat.columns:
-            table = table.rename_columns(stat.columns[: table.num_columns])
 
         max_w = context.options_store.get_int("tuplex.tpu.maxStrBytes", 4096)
         rows_per_part = _csv_rows_per_partition(context, table)
         n = table.num_rows
+        proj_idx = [stat.columns.index(c) for c in out_columns]
+        if bad_rows:
+            # Arrow's InvalidRow.number is None in this version, so recover
+            # each bad row's original position with one lenient python-csv
+            # scan (dirty path only) and splice it back at its slot as a
+            # boxed fallback row — keeps merge-in-order exact for malformed
+            # rows like the reference (advisor finding, round 1).
+            scanned = _scan_bad_records(path, stat)
+            if len(scanned) == len(bad_rows):
+                yield from _spliced_partitions(
+                    table, scanned, raw_schema, proj_idx, max_w,
+                    rows_per_part, base_index)
+                return
         start = 0
         while start < n:
             m = min(rows_per_part, n - start)
@@ -265,9 +282,10 @@ class CSVSourceOperator(L.LogicalOperator):
             yield _table_to_partition(chunk, raw_schema, max_w,
                                       base_index + start)
             start += m
-        # structurally-invalid rows: re-parse leniently, box as fallback rows
+        # position recovery failed (python csv disagreed with Arrow about
+        # which rows are malformed): append bad rows as one trailing
+        # partition — output order for them diverges from the reference
         if bad_rows:
-            proj_idx = [stat.columns.index(c) for c in out_columns]
             vals = []
             for _, text in bad_rows:
                 try:
@@ -279,6 +297,71 @@ class CSVSourceOperator(L.LogicalOperator):
                                   for i in proj_idx))
             yield C.build_partition(
                 vals, raw_schema, start_index=base_index + n)
+
+
+def _scan_bad_records(path: str, stat: "CSVStatistic"
+                      ) -> list[tuple[int, list]]:
+    """[(data-row ordinal, cells)] for records whose cell count != k —
+    python-csv replica of Arrow's invalid-row criterion, used to recover the
+    original positions Arrow doesn't report. Ordinals count ALL non-empty
+    data records (good + bad) in file order, excluding the header."""
+    k = stat.num_columns
+    out: list[tuple[int, list]] = []
+    with VirtualFileSystem.open_read(path, "rb") as fp:
+        text = fp.read().decode("utf-8", errors="replace")
+    ordinal = 0
+    skip_header = stat.has_header
+    for rec in _pycsv.reader(_io.StringIO(text), delimiter=stat.delimiter):
+        if not rec:
+            continue  # blank line: Arrow skips it too
+        if skip_header:
+            skip_header = False
+            continue
+        if len(rec) != k:
+            out.append((ordinal, rec))
+        ordinal += 1
+    return out
+
+
+def _spliced_partitions(table, scanned: list, raw_schema: T.RowType,
+                        proj_idx: list[int], max_w: int, rows_per_part: int,
+                        base_index: int):
+    """Partitions over the ORIGINAL row-ordinal space: surviving Arrow rows
+    keep their true slots, structurally-bad rows occupy theirs as boxed
+    fallback slots (normal_mask False -> interpreter path)."""
+    n = table.num_rows
+    nb = len(scanned)
+    bad_ord = np.asarray([o for o, _ in scanned], dtype=np.int64)
+    boxed = [tuple(cells[i] if i < len(cells) else None for i in proj_idx)
+             for _, cells in scanned]
+    total = n + nb
+    # original ordinal of the j-th surviving row: j + |{i : bad_ord[i]-i <= j}|
+    surv = np.arange(n, dtype=np.int64) + np.searchsorted(
+        bad_ord - np.arange(nb), np.arange(n), side="right")
+    start = 0
+    while start < total:
+        m = int(min(rows_per_part, total - start))
+        j0, j1 = np.searchsorted(surv, [start, start + m])
+        bi0, bi1 = np.searchsorted(bad_ord, [start, start + m])
+        tp = _table_to_partition(table.slice(int(j0), int(j1 - j0)),
+                                 raw_schema, max_w, base_index + start)
+        if bi1 == bi0:
+            yield tp  # no bad slots here: chunk is contiguous, j1-j0 == m
+        else:
+            pos = surv[j0:j1] - start
+            gp = C.gather_partition(tp, pos, np.arange(j1 - j0), m)
+            gp.start_index = base_index + start
+            mask = np.ones(m, np.bool_)
+            if tp.normal_mask is not None:
+                mask[pos] = tp.normal_mask
+            fb = {int(pos[i]): v for i, v in tp.fallback.items()}
+            for o, bx in zip(bad_ord[bi0:bi1].tolist(), boxed[bi0:bi1]):
+                mask[o - start] = False
+                fb[o - start] = bx
+            gp.normal_mask = mask
+            gp.fallback = fb
+            yield gp
+        start += m
 
 
 def _csv_rows_per_partition(context, table) -> int:

@@ -377,9 +377,18 @@ def parse_i64(bytes_, lens):
     in_zone_w = pos_w < sl[:, None]
     dw = jnp.where(in_zone_w, (wb - 48).astype(jnp.int64), 0)
     val = jnp.zeros(n, dtype=jnp.int64)
+    i64max = jnp.int64(9223372036854775807)
+    ovf = jnp.zeros(n, dtype=jnp.bool_)
     for j in range(win):
-        val = jnp.where(in_zone_w[:, j], val * 10 + dw[:, j], val)
-    bad = bad | (ndigits > 19)  # would overflow i64: python-int territory
+        step = in_zone_w[:, j]
+        # val*10+d wraps silently in int64; detect BEFORE accumulating so
+        # 19-digit magnitudes above i64 max route to the interpreter instead
+        # of returning a wrapped value (advisor finding, round 1). The one
+        # representable edge (-2**63) is conservatively routed too.
+        ovf = ovf | (step & (val > (i64max - dw[:, j]) // 10))
+        val = jnp.where(step, val * 10 + dw[:, j], val)
+    bad = bad | ovf
+    bad = bad | (ndigits > 19)  # always overflows i64: python-int territory
     val = jnp.where(neg, -val, val)
     return val, bad
 

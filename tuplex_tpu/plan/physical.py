@@ -47,6 +47,17 @@ class TransformStage:
 
     force_interpret = False   # set on segments around non-compilable ops
 
+    def python_pipeline(self):
+        """Cached per-stage compiled Python fallback pipeline (reference:
+        PythonPipelineBuilder.cc generates one function per stage; ROUND 1
+        interpreted the op list per row instead)."""
+        pipe = getattr(self, "_py_pipeline", None)
+        if pipe is None:
+            from ..compiler.pypipeline import build_python_pipeline
+
+            pipe = self._py_pipeline = build_python_pipeline(self.ops)
+        return pipe
+
     def key(self) -> str:
         """Cache key for the jit'd executable: operator chain + UDF sources +
         captured globals + input schema (specialization contract of the
@@ -86,6 +97,7 @@ class TransformStage:
             names = user_columns(schema)
             for op in ops:
                 row, keep, names = _emit_op(ctx, op, row, keep, names)
+                row, keep = _fusion_barrier(ctx, row, keep)
             outs, out_t = result_arrays(row, b)
             outs = dict(outs)
             outs["#err"] = ctx.err
@@ -93,6 +105,30 @@ class TransformStage:
             return outs
 
         return fn
+
+
+def _fusion_barrier(ctx: EmitCtx, row: CV, keep):
+    """Cap XLA fusion scope at operator boundaries.
+
+    Without this, XLA-CPU's producer fusion pulls an entire multi-operator
+    string pipeline into ONE kLoop fusion whose per-element evaluation
+    recomputes [B, W]-shaped intermediates per output element — measured 24s
+    instead of ~1s for the Zillow extractPrice stage. The barrier is a
+    runtime no-op; it only tells the fusion pass to materialize each
+    operator's outputs (the reference analog: each LLVM pipeline stage writes
+    its row before the next reads it)."""
+    from ..compiler.values import cv_arrays, cv_rebuild
+    from ..runtime.jaxcfg import lax
+
+    leaves: list = []
+    cv_arrays(row, leaves)
+    n_row = len(leaves)
+    leaves.extend((keep, ctx.err, ctx.active))
+    out = lax.optimization_barrier(tuple(leaves))
+    it = iter(out[:n_row])
+    row2 = cv_rebuild(row, it)
+    keep2, ctx.err, ctx.active = out[n_row], out[n_row + 1], out[n_row + 2]
+    return row2, keep2
 
 
 def runtime_output_columns(input_schema: T.RowType,

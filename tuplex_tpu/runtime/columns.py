@@ -637,6 +637,54 @@ def gather_partition(part: Partition, out_positions: np.ndarray,
                      start_index=part.start_index)
 
 
+def key_signature_matrix(part: Partition, cis: Sequence[int],
+                         reject_nan: bool = True) -> Optional[np.ndarray]:
+    """[N, W] canonical byte-signature matrix over the given key columns,
+    None if any leaf isn't signature-comparable. Byte equality must IMPLY
+    python equality, so every representation quirk is canonicalized first:
+    invalid (None) slots are zeroed (CSV null_values keep their original
+    sbytes; merge_cv Options carry the dead branch's data), str bytes past
+    the length are zeroed (stage outputs carry stale padding), floats
+    normalize -0.0 and (for joins) reject NaN since NaN != NaN."""
+    pieces: list[np.ndarray] = []
+    n = part.num_rows
+    for ci in cis:
+        for path, _lt in flatten_type(part.schema.types[ci], str(ci)):
+            leaf = part.leaves.get(path)
+            if isinstance(leaf, NumericLeaf):
+                data = leaf.data
+                if leaf.valid is not None:
+                    data = np.where(
+                        leaf.valid.reshape((n,) + (1,) * (data.ndim - 1)),
+                        data, 0)
+                if data.dtype.kind == "f":
+                    if reject_nan and np.isnan(data).any():
+                        return None  # NaN keys: python equality differs
+                    data = np.where(data == 0, 0.0, data)  # -0.0 == 0.0
+                pieces.append(np.ascontiguousarray(
+                    data.reshape(n, -1)).view(np.uint8).reshape(n, -1))
+                if leaf.valid is not None:
+                    pieces.append(leaf.valid.reshape(-1, 1).view(np.uint8))
+            elif isinstance(leaf, StrLeaf):
+                b, ln = leaf.bytes, leaf.lengths
+                if leaf.valid is not None:
+                    b = np.where(leaf.valid[:, None], b, 0)
+                    ln = np.where(leaf.valid, ln, 0)
+                b = np.where(
+                    np.arange(b.shape[1])[None, :] < ln[:, None], b, 0)
+                pieces.append(b)
+                pieces.append(ln.astype("<i4").view(np.uint8).reshape(n, -1))
+                if leaf.valid is not None:
+                    pieces.append(leaf.valid.reshape(-1, 1).view(np.uint8))
+            elif isinstance(leaf, NullLeaf):
+                pieces.append(np.zeros((n, 1), np.uint8))
+            else:
+                return None
+    if not pieces:
+        return None
+    return np.ascontiguousarray(np.concatenate(pieces, axis=1))
+
+
 def harmonize_partitions(parts: list) -> list:
     """Pad every partition's str leaves to the dataset-wide pow2 width and
     align row-count buckets, so ONE jit executable serves every partition
@@ -695,6 +743,33 @@ def _leaf_to_pylist(leaf: Leaf, n: int) -> list:
         ]
     return [flat[i * w: i * w + lens[i]].decode("utf-8", "replace")
             for i in range(n)]
+
+
+def decode_rows(part: Partition, indices) -> "list[Row]":
+    """Bulk-decode the given local row positions into boxed Rows — the
+    batched replacement for per-row decode_row on the interpreter path
+    (reference analog: PythonDataSet.cc bulk converters)."""
+    from ..core.row import Row
+
+    idx = np.asarray(list(indices), dtype=np.int64)
+    m = len(idx)
+    if m == 0:
+        return []
+    cols = part.user_columns
+    single = len(part.schema.types) == 1
+    gp = gather_partition(part, np.arange(m, dtype=np.int64), idx, m)
+    gp.fallback = {}
+    vals = partition_to_pylist(gp)
+    fb = part.fallback
+    rows: list[Row] = []
+    for j, i in enumerate(idx.tolist()):
+        if i in fb:
+            rows.append(Row.from_value(fb[i], cols))
+        elif single:
+            rows.append(Row((vals[j],), cols))
+        else:
+            rows.append(Row(vals[j], cols))
+    return rows
 
 
 def partition_to_pylist(part: Partition) -> list:
