@@ -7,15 +7,26 @@ it). Round 1 instead interpreted the operator list per row — isinstance
 dispatch, resolver scans, and column-index lookups on every single row made
 the slow path ~20x slower than a naive Python loop.
 
-This module is the closure-chain equivalent of the reference's codegen: all
-per-op decisions (UDF calling convention, column indices, cell decoders,
-resolver lists) are taken ONCE at build time; the returned `pipeline(row)`
-touches only prebuilt closures. Exceptions return as plain tuples
-(op_id, exc_name, row_value) so this module stays import-light.
+Two tiers here, both built ONCE per stage:
+
+* source tier (`_try_build_source_pipeline`) — the real PythonPipelineBuilder
+  analog: generates one Python function with row fields as plain locals and
+  each UDF's dict access rewritten to positional parameters (reference:
+  UDF.h:183 rewriteDictAccessInAST), then `exec`s it. No Row objects, no
+  per-op list copies on the good-row path; exceptions drop into prebuilt
+  per-op resolver helpers.
+* closure tier (`_build_closure_pipeline`) — per-op closures chained in a
+  loop; used when the stage shape can't be source-specialized (dynamic
+  column names, mid-chain Map, odd row arities) and as the per-row escape
+  hatch for rows whose shape doesn't match the generated code.
+
+Exceptions return as plain tuples (op_id, exc_name, row_value) so this
+module stays import-light.
 """
 
 from __future__ import annotations
 
+import ast
 from typing import Any, Callable, Optional
 
 from ..core import typesys as T
@@ -224,9 +235,20 @@ def _build_op(op: L.LogicalOperator):
     raise TuplexException(f"interpreter: unsupported op {op!r}")
 
 
-def build_python_pipeline(ops: list) -> Callable[[Row], tuple]:
-    """ONE closure per stage: pipeline(row) -> ("ok", Row) | ("drop", None)
-    | ("exc", (op_id, exc_name, row_value))."""
+def build_python_pipeline(ops: list, input_names: Optional[tuple] = None
+                          ) -> Callable[[Row], tuple]:
+    """ONE function per stage: pipeline(row) -> ("ok", Row) | ("drop", None)
+    | ("exc", (op_id, exc_name, row_value)). Tries the generated-source tier
+    first (needs the runtime input column names); falls back to closures."""
+    closure = _build_closure_pipeline(ops)
+    if input_names:
+        src = _try_build_source_pipeline(ops, tuple(input_names), closure)
+        if src is not None:
+            return src
+    return closure
+
+
+def _build_closure_pipeline(ops: list) -> Callable[[Row], tuple]:
     steps = []
     i = 0
     while i < len(ops):
@@ -273,3 +295,350 @@ def build_python_pipeline(ops: list) -> Callable[[Row], tuple]:
         return ("ok", row)
 
     return pipeline
+
+
+# ===========================================================================
+# source tier — PythonPipelineBuilder.cc analog
+# ===========================================================================
+
+class _RowParamRewriter(ast.NodeTransformer):
+    """Rewrite `x["col"]` / `x[i]` on the row parameter into positional
+    argument names (reference: UDF.h:183 rewriteDictAccessInAST). Any other
+    use of the row parameter marks the UDF non-specializable."""
+
+    def __init__(self, param: str, names: tuple):
+        self.param = param
+        self.names = names
+        self.used: dict[int, str] = {}     # column index -> arg name
+        self.failed = False
+
+    def _arg_for(self, ci: int) -> ast.Name:
+        name = self.used.get(ci)
+        if name is None:
+            name = f"_a{ci}"
+            self.used[ci] = name
+        return ast.Name(id=name, ctx=ast.Load())
+
+    def visit_Subscript(self, node: ast.Subscript):
+        # match BEFORE generic_visit: descending first would see the row
+        # param's Name node and wrongly flag the UDF as non-specializable
+        if isinstance(node.value, ast.Name) and node.value.id == self.param \
+                and isinstance(node.ctx, ast.Load) \
+                and isinstance(node.slice, ast.Constant):
+            key = node.slice.value
+            if isinstance(key, str) and key in self.names:
+                return self._arg_for(self.names.index(key))
+            if isinstance(key, int) and not isinstance(key, bool) \
+                    and -len(self.names) <= key < len(self.names):
+                return self._arg_for(key % len(self.names))
+        self.generic_visit(node)
+        return node
+
+    def visit_Name(self, node: ast.Name):
+        if node.id == self.param:
+            self.failed = True  # row escapes (passed whole / reassigned)
+        return node
+
+    def _visit_nested_scope(self, node):
+        # a nested lambda/def whose parameter shadows the row param creates
+        # a NEW binding: its subscripts must NOT be rewritten to the outer
+        # row's columns. ast.arg isn't a Name, so visit_Name can't catch it.
+        if any(a.arg == self.param for a in
+               node.args.posonlyargs + node.args.args + node.args.kwonlyargs):
+            self.failed = True
+            return node
+        if node.args.vararg and node.args.vararg.arg == self.param:
+            self.failed = True
+            return node
+        if node.args.kwarg and node.args.kwarg.arg == self.param:
+            self.failed = True
+            return node
+        self.generic_visit(node)
+        return node
+
+    def visit_Lambda(self, node: ast.Lambda):
+        return self._visit_nested_scope(node)
+
+    def visit_FunctionDef(self, node):
+        return self._visit_nested_scope(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self.failed = True
+        return node
+
+
+_SPEC_COUNTER = [0]
+
+
+def _specialize_udf(udf, names: tuple):
+    """(callable, arg_column_indices) taking the accessed columns
+    positionally, or None if the UDF can't be specialized."""
+    if not udf.source or udf.tree is None:
+        return None
+    a = udf.tree.args
+    if a.vararg or a.kwarg or a.kwonlyargs or a.posonlyargs or a.defaults:
+        return None   # exotic signatures keep the generic calling convention
+    if getattr(udf.tree, "decorator_list", None):
+        return None   # decorators change behavior; the live func must run
+    params = udf.params
+    if len(params) > 1:
+        # multi-param UDF spreads row fields across params already
+        if len(params) == len(names):
+            return udf.func, list(range(len(names)))
+        return None
+    if len(params) != 1:
+        return None
+    tree = udf.tree
+    import copy
+
+    body = copy.deepcopy(
+        tree.body if isinstance(tree, ast.Lambda) else tree)
+    rw = _RowParamRewriter(params[0], names)
+    if isinstance(tree, ast.Lambda):
+        new_body = rw.visit(body)
+        if rw.failed:
+            return None
+        arg_cis = sorted(rw.used)
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=rw.used[ci]) for ci in arg_cis],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        fn_ast = ast.Lambda(args=args, body=new_body)
+        mod = ast.Expression(body=fn_ast)
+        ast.fix_missing_locations(mod)
+        code = compile(mod, f"<tpx-spec-{udf.name}>", "eval")
+        glb = dict(udf.globals)
+        fn = eval(code, glb)  # noqa: S307 — our own rewritten UDF source
+        return fn, arg_cis
+    # FunctionDef
+    new_stmts = [rw.visit(s) for s in body.body]
+    if rw.failed:
+        return None
+    arg_cis = sorted(rw.used)
+    _SPEC_COUNTER[0] += 1
+    fname = f"_tpx_spec_{_SPEC_COUNTER[0]}"
+    args = ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=rw.used[ci]) for ci in arg_cis],
+        kwonlyargs=[], kw_defaults=[], defaults=[])
+    fn_ast = ast.FunctionDef(name=fname, args=args, body=new_stmts,
+                             decorator_list=[], type_params=[])
+    mod = ast.Module(body=[fn_ast], type_ignores=[])
+    ast.fix_missing_locations(mod)
+    code = compile(mod, f"<tpx-spec-{udf.name}>", "exec")
+    glb = dict(udf.globals)
+    exec(code, glb)  # noqa: S102 — our own rewritten UDF source
+    return glb[fname], arg_cis
+
+
+def _make_resolver_helper(op, resolvers, names: tuple):
+    """Exception-path handler for one generated op: tries the attached
+    resolvers against a freshly boxed Row (rare path — Row cost fine).
+    Returns status codes: 0=resolved value, 1=drop, 2=unhandled."""
+    _, inject_fn = _build_op(op)
+    res = [(cls, _make_udf_caller(r.udf) if r is not None else None)
+           for cls, r in resolvers]
+
+    def handle(e, vals: tuple):
+        row = Row(vals, names)
+        for exc_class, res_call in res:
+            if isinstance(e, exc_class):
+                if res_call is None:
+                    return 1, None
+                try:
+                    return 0, inject_fn(res_call(row), row)
+                except Exception:
+                    pass
+        return 2, None
+
+    return handle
+
+
+def _try_build_source_pipeline(ops: list, input_names: tuple, closure):
+    """Generate + exec ONE Python function for the stage; None when the
+    stage shape can't be specialized (dynamic names, mid-chain Map, ...).
+
+    Layout: each current column lives in a local `c<slot>`; ops append or
+    rewrite slots; the good-row path never builds a Row or copies a list.
+    Rows whose arity/names don't match the generated layout delegate to the
+    closure tier at entry — exact parity by construction."""
+    steps = []
+    i = 0
+    while i < len(ops):
+        op = ops[i]
+        if isinstance(op, (L.ResolveOperator, L.IgnoreOperator,
+                           L.TakeOperator)):
+            i += 1
+            continue
+        resolvers = []
+        j = i + 1
+        while j < len(ops) and isinstance(
+                ops[j], (L.ResolveOperator, L.IgnoreOperator)):
+            r = ops[j]
+            resolvers.append((r.exc_class,
+                              None if isinstance(r, L.IgnoreOperator) else r))
+            j += 1
+        steps.append((op, resolvers))
+        i += 1
+
+    names = tuple(input_names)
+    k_in = len(names)
+    env: dict[str, Any] = {"_Row": Row, "_closure": closure,
+                           "_DROP": ("drop", None), "_exc": _exc_result,
+                           "_IN_NAMES": names}
+    src: list[str] = ["def _tpx_pipeline(_row):",
+                      "    _v = _row.values",
+                      f"    if len(_v) != {k_in} or "
+                      "_row.columns != _IN_NAMES:",
+                      "        return _closure(_row)"]
+    for ci in range(k_in):
+        src.append(f"    c{ci} = _v[{ci}]")
+    cur = list(range(k_in))     # local slot per current column
+    next_slot = k_in
+
+    def row_tuple() -> str:
+        """Expression for the current row as a TUPLE of locals."""
+        return "(" + ", ".join(f"c{s}" for s in cur) + ("," if len(cur) == 1
+                                                        else "") + ")"
+
+    def row_unwrapped() -> str:
+        """Expression matching Row.unwrap(): bare value for single column."""
+        return f"c{cur[0]}" if len(cur) == 1 else row_tuple()
+
+    def udf_call_expr(si: int, udf) -> Optional[str]:
+        """Call expression for a UDF over the current columns; specialized
+        to positional locals when possible, else a boxed-Row call."""
+        spec = _specialize_udf(udf, names)
+        if spec is not None:
+            fn, arg_cis = spec
+            env[f"_u{si}"] = fn
+            return f"_u{si}(" + ", ".join(f"c{cur[ci]}"
+                                          for ci in arg_cis) + ")"
+        env[f"_u{si}"] = _make_udf_caller(udf)
+        env[f"_nm{si}"] = names
+        return f"_u{si}(_Row({row_tuple()}, _nm{si}))"
+
+    def emit_handler(si: int, op, resolvers, on_resolved: list[str]):
+        """except-block body: resolver cascade then exception record."""
+        if resolvers:
+            env[f"_h{si}"] = _make_resolver_helper(op, resolvers, names)
+            src.append(f"        _st, _x = _h{si}(_e, {row_tuple()})")
+            src.append("        if _st == 1:")
+            src.append("            return _DROP")
+            src.append("        if _st == 2:")
+            src.append(f"            return _exc({op.id}, _e, "
+                       f"{row_unwrapped()})")
+            src.extend(on_resolved)
+        else:
+            src.append(f"        return _exc({op.id}, _e, "
+                       f"{row_unwrapped()})")
+
+    for si, (op, resolvers) in enumerate(steps):
+        is_last = si == len(steps) - 1
+        if isinstance(op, L.DecodeOperator):
+            from ..runtime.columns import user_columns
+
+            out_cols = user_columns(op.declared)
+            if out_cols is None or len(out_cols) != len(cur) or \
+                    len(op.declared.types) != len(cur):
+                return None
+            for m, s in enumerate(cur):
+                env[f"_d{si}_{m}"] = _make_cell_decoder(
+                    op.declared.types[m], op.null_values)
+                src.append(f"    c{s} = _d{si}_{m}(c{s})")
+            names = tuple(out_cols)
+        elif isinstance(op, L.WithColumnOperator):
+            call = udf_call_expr(si, op.udf)
+            replace = op.column in names
+            slot = cur[names.index(op.column)] if replace else next_slot
+            inj_idx = names.index(op.column) if replace else len(cur)
+            src.append("    try:")
+            src.append(f"        c{slot} = {call}")
+            src.append("    except Exception as _e:")
+            emit_handler(si, op, resolvers,
+                         [f"        c{slot} = _x.values[{inj_idx}]"])
+            if not replace:
+                next_slot += 1
+                cur.append(slot)
+                names = names + (op.column,)
+        elif isinstance(op, L.MapColumnOperator):
+            if op.column not in names:
+                return None
+            ci = names.index(op.column)
+            slot = cur[ci]
+            env[f"_u{si}"] = op.udf.func
+            src.append("    try:")
+            src.append(f"        c{slot} = _u{si}(c{slot})")
+            src.append("    except Exception as _e:")
+            emit_handler(si, op, resolvers,
+                         [f"        c{slot} = _x.values[{ci}]"])
+        elif isinstance(op, L.FilterOperator):
+            call = udf_call_expr(si, op.udf)
+            src.append("    try:")
+            src.append(f"        if not {call}:")
+            src.append("            return _DROP")
+            src.append("    except Exception as _e:")
+            emit_handler(si, op, resolvers,
+                         ["        if _x is None:",
+                          "            return _DROP"])
+        elif isinstance(op, L.SelectColumnsOperator):
+            idx = []
+            for c in op.selected:
+                if isinstance(c, int) and not isinstance(c, bool):
+                    if not -len(cur) <= c < len(cur):
+                        return None
+                    idx.append(c % len(cur))
+                elif isinstance(c, str) and c in names:
+                    idx.append(names.index(c))
+                else:
+                    return None
+            # duplicated selections get their OWN slot: later in-place ops
+            # (mapColumn / withColumn replace) target the first occurrence
+            # only (tuple.index semantics) and must not write through an alias
+            seen: set = set()
+            new_cur = []
+            for i2 in idx:
+                s = cur[i2]
+                if s in seen:
+                    src.append(f"    c{next_slot} = c{s}")
+                    s = next_slot
+                    next_slot += 1
+                seen.add(s)
+                new_cur.append(s)
+            cur = new_cur
+            names = tuple(op.schema().columns)
+            if len(names) != len(cur):
+                return None
+        elif isinstance(op, L.RenameColumnOperator):
+            names = tuple(op.schema().columns)
+            if len(names) != len(cur):
+                return None
+        elif isinstance(op, L.MapOperator) and is_last:
+            # terminal map: generic result wrapping (dict/tuple/bare)
+            call = udf_call_expr(si, op.udf)
+            _, inject = _build_op(op)
+            env[f"_inj{si}"] = inject
+            src.append("    try:")
+            src.append(f"        _x = {call}")
+            src.append("    except Exception as _e:")
+            emit_handler(si, op, resolvers,
+                         ["        return (\"ok\", _x)"])
+            src.append(f"    return (\"ok\", _inj{si}(_x, None))")
+            return _finish_source(src, env)
+        else:
+            return None   # unsupported op shape for the source tier
+
+    env["_OUT_NAMES"] = names
+    src.append(f"    return (\"ok\", _Row({row_tuple()}, _OUT_NAMES))")
+    return _finish_source(src, env)
+
+
+def _exc_result(op_id: int, e: BaseException, rowval):
+    return ("exc", (op_id, type(e).__name__, rowval))
+
+
+def _finish_source(src: list, env: dict):
+    code = "\n".join(src)
+    try:
+        exec(compile(code, "<tpx-pipeline>", "exec"), env)  # noqa: S102
+    except SyntaxError:
+        return None
+    return env["_tpx_pipeline"]

@@ -13,12 +13,33 @@ from typing import Any, Iterator, Optional, Sequence
 from .typesys import Type, infer_type, tuple_of
 
 
+# column-name -> index maps interned per columns tuple: Row['col'] is on the
+# interpreter hot path (reference: generated Row class resolves names to
+# positions at codegen time, PythonPipelineBuilder.cc:1-60)
+_COL_INDEX: dict = {}
+
+
+def _col_index_map(columns: tuple) -> dict:
+    m = _COL_INDEX.get(columns)
+    if m is None:
+        # reversed so the FIRST occurrence of a duplicated name wins,
+        # matching tuple.index semantics
+        m = {c: i for i, c in reversed(list(enumerate(columns)))}
+        if len(_COL_INDEX) > 4096:
+            # data-dependent column sets (dict-returning map UDFs) must not
+            # grow the interned cache without bound
+            _COL_INDEX.clear()
+        _COL_INDEX[columns] = m
+    return m
+
+
 class Row:
     __slots__ = ("values", "columns")
 
     def __init__(self, values: Sequence[Any], columns: Optional[Sequence[str]] = None):
-        self.values: tuple = tuple(values)
-        self.columns: Optional[tuple] = tuple(columns) if columns else None
+        self.values: tuple = values if type(values) is tuple else tuple(values)
+        self.columns: Optional[tuple] = None if not columns else (
+            columns if type(columns) is tuple else tuple(columns))
 
     @classmethod
     def from_value(cls, value: Any, columns: Optional[Sequence[str]] = None) -> "Row":
@@ -51,9 +72,13 @@ class Row:
 
     def __getitem__(self, key):
         if isinstance(key, str):
-            if self.columns is None:
+            cols = self.columns
+            if cols is None:
                 raise KeyError(key)
-            return self.values[self.columns.index(key)]
+            i = _col_index_map(cols).get(key)
+            if i is None:
+                return self.values[cols.index(key)]  # same error as before
+            return self.values[i]
         return self.values[key]
 
     def __eq__(self, other) -> bool:

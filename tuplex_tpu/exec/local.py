@@ -244,7 +244,7 @@ class LocalBackend:
         resolved: dict[int, Row] = {}
         exceptions: list[ExceptionRecord] = []
         if fallback_idx:
-            pipeline = stage.python_pipeline()
+            pipeline = stage.python_pipeline(part.user_columns)
             order = sorted(fallback_idx)
             for i, row in zip(order, C.decode_rows(part, order)):
                 status, payload = pipeline(row)
@@ -324,7 +324,11 @@ class LocalBackend:
 
 
 def _schema_from_rows(rows: list[Row]) -> Optional[T.RowType]:
-    """Normal-case schema speculated from actual interpreter-produced rows."""
+    """Normal-case schema speculated from actual interpreter-produced rows.
+
+    Types from a bounded SAMPLE (speculation, like every other schema here):
+    rows outside the sampled normal case are boxed by build_partition's
+    fallback path, so a capped scan is safe and O(1) in dataset size."""
     rows = [r for r in rows if r is not None]
     if not rows:
         return None
@@ -334,9 +338,10 @@ def _schema_from_rows(rows: list[Row]) -> Optional[T.RowType]:
     cols = rows[0].columns
     if cols is None or len(cols) != k:
         cols = tuple(f"_{i}" for i in range(k))
+    sample = rows[:256]
     types = []
     for ci in range(k):
-        nc, _, _ = T.normal_case_type([r.values[ci] for r in rows])
+        nc, _, _ = T.normal_case_type([r.values[ci] for r in sample])
         if nc is T.UNKNOWN:
             return None
         types.append(nc)

@@ -47,15 +47,20 @@ class TransformStage:
 
     force_interpret = False   # set on segments around non-compilable ops
 
-    def python_pipeline(self):
+    def python_pipeline(self, input_names: Optional[tuple] = None):
         """Cached per-stage compiled Python fallback pipeline (reference:
         PythonPipelineBuilder.cc generates one function per stage; ROUND 1
-        interpreted the op list per row instead)."""
-        pipe = getattr(self, "_py_pipeline", None)
+        interpreted the op list per row instead). Keyed by the RUNTIME input
+        column names — the source tier binds column positions at build."""
+        cache = getattr(self, "_py_pipelines", None)
+        if cache is None:
+            cache = self._py_pipelines = {}
+        key = tuple(input_names) if input_names else None
+        pipe = cache.get(key)
         if pipe is None:
             from ..compiler.pypipeline import build_python_pipeline
 
-            pipe = self._py_pipeline = build_python_pipeline(self.ops)
+            pipe = cache[key] = build_python_pipeline(self.ops, key)
         return pipe
 
     def key(self) -> str:
