@@ -16,7 +16,7 @@ from typing import Any, Callable, Optional, Sequence
 from ..core import typesys as T
 from ..core.errors import TuplexException
 from ..plan import logical as L
-from ..plan.physical import plan_stages
+from ..plan.physical import TransformStage, plan_stages
 
 
 class DataSet:
@@ -170,7 +170,14 @@ class DataSet:
                 for stage in stages:
                     check_interrupted()
                     if getattr(stage, "source", None) is not None:
-                        partitions = _source_partitions(self._context, stage)
+                        # take(n): stream partitions lazily so the backend
+                        # stops pulling source data once n rows survive
+                        # (reference: range tasks, LocalBackend.cc:552-611;
+                        # round 1 loaded the WHOLE source for take(5))
+                        lazy = getattr(stage, "limit", -1) >= 0 and \
+                            isinstance(stage, TransformStage)
+                        partitions = _source_partitions(
+                            self._context, stage, lazy=lazy)
                     result = backend.execute_any(stage, partitions,
                                                  self._context)
                     partitions = result.partitions
@@ -196,28 +203,40 @@ class DataSet:
         return out
 
 
-def _source_partitions(context, stage):
-    """Materialize the stage source into columnar partitions."""
+def _source_partitions(context, stage, lazy: bool = False):
+    """Materialize the stage source into columnar partitions.
+
+    `lazy=True` returns a GENERATOR (no dataset-wide harmonization): used by
+    take(n) so the backend can stop consuming once the limit is met. Lazy
+    batches may have differing str widths — worst case a few extra jit
+    retraces, which a take() of a handful of rows never hits."""
     from ..runtime import columns as C
 
     src = stage.source
     if isinstance(src, L.ParallelizeOperator):
         schema = src.schema()
         part_rows = _rows_per_partition(context, schema, len(src.data))
-        parts = []
-        for off in range(0, len(src.data), part_rows):
-            chunk = src.data[off: off + part_rows]
-            parts.append(C.build_partition(chunk, schema, start_index=off))
-        return C.harmonize_partitions(parts)
+
+        def gen_parallel():
+            for off in range(0, len(src.data), part_rows):
+                chunk = src.data[off: off + part_rows]
+                yield C.build_partition(chunk, schema, start_index=off)
+
+        if lazy:
+            return gen_parallel()
+        return C.harmonize_partitions(list(gen_parallel()))
     if hasattr(src, "load_partitions"):
         import inspect
 
         proj = getattr(stage, "source_projection", None)
         sig = inspect.signature(src.load_partitions)
-        if "projection" in sig.parameters:
-            parts = src.load_partitions(context, proj)
-        else:
-            parts = src.load_partitions(context)
+        kwargs = {"projection": proj} if "projection" in sig.parameters \
+            else {}
+        if lazy and hasattr(src, "iter_partitions"):
+            return src.iter_partitions(context, **kwargs)
+        parts = src.load_partitions(context, **kwargs)
+        if lazy:
+            return iter(parts)
         return C.harmonize_partitions(parts)
     raise TuplexException(f"unknown source {src!r}")
 

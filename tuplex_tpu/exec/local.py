@@ -125,15 +125,23 @@ class LocalBackend:
 
     # ------------------------------------------------------------------
     def execute(self, stage: TransformStage,
-                partitions: list[C.Partition]) -> StageResult:
-        import jax
+                partitions) -> StageResult:
+        """Window-pipelined dual-mode execution (reference analog:
+        Executor/WorkQueue task parallelism, Executor.h:45-109 +
+        LocalBackend.cc:1531-1586). Device dispatch is ASYNC — while the
+        device crunches partition i, the host stages partition i+1 and
+        merges partition i-1; `partitions` may be a lazy iterator, so
+        take(n) stops pulling source data once the limit is satisfied."""
+        from collections import deque
 
         t0 = time.perf_counter()
         mm_snap = self.mm.metrics_snapshot()
         metrics: dict[str, Any] = {"fast_path_s": 0.0, "slow_path_s": 0.0,
                                    "compile_s": 0.0}
+        parts_it = iter(partitions)
+        first_part = next(parts_it, None)
         device_fn = None
-        in_schema = partitions[0].schema if partitions else None
+        in_schema = first_part.schema if first_part is not None else None
         skey = stage.key() + "/" + (in_schema.name if in_schema else "")
         if not self.interpret_only and skey not in self._not_compilable \
                 and in_schema is not None:
@@ -157,18 +165,22 @@ class LocalBackend:
         exceptions: list[ExceptionRecord] = []
         emitted_total = 0
         limit = stage.limit
+        window_size = max(1, self.options.get_int(
+            "tuplex.tpu.dispatchWindow", 3))
+        window: deque = deque()
 
         from ..utils.signals import check_interrupted
 
-        for part in partitions:
-            check_interrupted()
+        def collect_one():
+            nonlocal emitted_total
+            part, outs, dispatch_s = window.popleft()
             if limit >= 0 and emitted_total >= limit:
-                break
-            if skey in self._not_compilable:
-                device_fn = None
+                return  # limit met: drop already-dispatched work unprocessed
+            # registering a previous output may have spilled this partition
+            # in the dispatch->collect gap; touch swaps it back in
             self.mm.touch(part)
-            outp, excs, m = self._execute_partition(stage, part, device_fn,
-                                                    skey)
+            outp, excs, m = self._collect_partition(stage, part, outs,
+                                                    dispatch_s)
             self.mm.register(outp)
             metrics["fast_path_s"] += m.get("fast_path_s", 0.0)
             metrics["slow_path_s"] += m.get("slow_path_s", 0.0)
@@ -178,6 +190,25 @@ class LocalBackend:
             emitted_total += outp.num_rows
             out_parts.append(outp)
 
+        def parts_stream():
+            if first_part is not None:
+                yield first_part
+            yield from parts_it
+
+        for part in parts_stream():
+            check_interrupted()
+            if limit >= 0 and emitted_total >= limit:
+                break
+            if skey in self._not_compilable:
+                device_fn = None
+            self.mm.touch(part)
+            window.append(self._dispatch_partition(part, device_fn, skey))
+            if len(window) >= window_size:
+                collect_one()
+        while window:
+            check_interrupted()
+            collect_one()
+
         metrics["wall_s"] = time.perf_counter() - t0
         metrics["rows_out"] = emitted_total
         metrics["exception_rows"] = len(exceptions)
@@ -185,8 +216,39 @@ class LocalBackend:
         return StageResult(out_parts, exceptions, metrics)
 
     # ------------------------------------------------------------------
-    def _execute_partition(self, stage: TransformStage, part: C.Partition,
-                           device_fn, skey: str):
+    def _dispatch_partition(self, part: C.Partition, device_fn, skey: str):
+        """Stage the batch and launch the device call WITHOUT blocking
+        (jax dispatch is async; the result is awaited in _collect_partition).
+        Returns (part, pending_outs | None, dispatch_seconds)."""
+        if device_fn is None or part.n_normal() == 0:
+            return (part, None, 0.0)
+        t0 = time.perf_counter()
+        batch = C.stage_partition(part, self.bucket_mode)
+        cache_key = ("stagefn", skey)
+        spec = batch.spec()                     # jit retraces per shape
+        first_call = not self.jit_cache.was_traced(cache_key, spec)
+        try:
+            outs = device_fn(batch.arrays)
+            self.jit_cache.note_traced(cache_key, spec)
+        except NotCompilable:
+            # surfaces at TRACE time (first call): route to interpreter
+            self._not_compilable.add(skey)
+            return (part, None, time.perf_counter() - t0)
+        except Exception as e:
+            if not first_call:
+                raise  # executed before: a real runtime failure
+            from ..utils.logging import get_logger
+
+            get_logger("exec").warning(
+                "stage trace failed (%s: %s); falling back to the "
+                "interpreter", type(e).__name__, e)
+            self._not_compilable.add(skey)
+            return (part, None, time.perf_counter() - t0)
+        return (part, outs, time.perf_counter() - t0)
+
+    # ------------------------------------------------------------------
+    def _collect_partition(self, stage: TransformStage, part: C.Partition,
+                           pending_outs, dispatch_s: float):
         import jax
 
         metrics: dict[str, float] = {}
@@ -196,45 +258,25 @@ class LocalBackend:
         compiled_ok = np.zeros(n, dtype=np.bool_)
         out_arrays: dict[str, np.ndarray] = {}
 
-        if device_fn is not None and part.n_normal() > 0:
+        if pending_outs is not None:
             t0 = time.perf_counter()
-            batch = C.stage_partition(part, self.bucket_mode)
-            cache_key = ("stagefn", skey)
-            spec = batch.spec()                     # jit retraces per shape
-            first_call = not self.jit_cache.was_traced(cache_key, spec)
-            try:
-                outs = device_fn(batch.arrays)
-                self.jit_cache.note_traced(cache_key, spec)
-            except NotCompilable:
-                # surfaces at TRACE time (first call): route to interpreter
-                self._not_compilable.add(skey)
-                device_fn = None
-            except Exception as e:
-                if not first_call:
-                    raise  # executed before: a real runtime failure
-                from ..utils.logging import get_logger
-
-                get_logger("exec").warning(
-                    "stage trace failed (%s: %s); falling back to the "
-                    "interpreter", type(e).__name__, e)
-                self._not_compilable.add(skey)
-                device_fn = None
+            outs = jax.device_get(pending_outs)
+            metrics["fast_path_s"] = dispatch_s + time.perf_counter() - t0
+            err = np.asarray(outs.pop("#err"))[:n]
+            keep = np.asarray(outs.pop("#keep"))[:n]
+            rowvalid = np.zeros(n, dtype=np.bool_)
+            if part.normal_mask is None:
+                rowvalid[:] = True
             else:
-                outs = jax.device_get(outs)
-                metrics["fast_path_s"] = time.perf_counter() - t0
-                err = np.asarray(outs.pop("#err"))[:n]
-                keep = np.asarray(outs.pop("#keep"))[:n]
-                rowvalid = np.zeros(n, dtype=np.bool_)
-                if part.normal_mask is None:
-                    rowvalid[:] = True
-                else:
-                    rowvalid[:] = part.normal_mask
-                err_rows = rowvalid & (err != 0)
-                fallback_idx.update(np.nonzero(err_rows)[0].tolist())
-                compiled_ok = rowvalid & keep & (err == 0)
-                out_arrays = {k: np.asarray(v) for k, v in outs.items()}
-        if device_fn is None or part.n_normal() == 0:
-            # whole partition interpreted (UDF not compilable / forced)
+                rowvalid[:] = part.normal_mask
+            err_rows = rowvalid & (err != 0)
+            fallback_idx.update(np.nonzero(err_rows)[0].tolist())
+            compiled_ok = rowvalid & keep & (err == 0)
+            out_arrays = {k: np.asarray(v) for k, v in outs.items()}
+        else:
+            # whole partition interpreted (UDF not compilable / forced /
+            # no normal-case rows)
+            metrics["fast_path_s"] = dispatch_s
             fallback_idx.update(range(n))
 
         # ---- interpreter path (ResolveTask analog) ------------------------

@@ -220,6 +220,63 @@ class CSVSourceOperator(L.LogicalOperator):
                 offset += p.num_rows
         return parts
 
+    def iter_partitions(self, context, projection=None):
+        """STREAMING read: yield partitions as Arrow record batches arrive,
+        so take(n) touches only the file prefix it consumes (reference:
+        range tasks over inputSplitSize, LocalBackend.cc:552-611).
+
+        Structurally-invalid rows are yielded as one trailing fallback
+        partition per file (position splicing needs a whole-file scan, which
+        streaming exists to avoid); the eager load_partitions path keeps
+        exact merge-in-order for them."""
+        import pyarrow as pa
+        import pyarrow.csv as pacsv
+
+        stat = self.stat
+        max_w = context.options_store.get_int("tuplex.tpu.maxStrBytes", 4096)
+        out_columns = list(projection) if projection else stat.columns
+        raw_schema = T.row_of(out_columns,
+                              [T.option(T.STR)] * len(out_columns))
+        proj_idx = [stat.columns.index(c) for c in out_columns]
+        split = context.options_store.get_size(
+            "tuplex.inputSplitSize", 1 << 22)
+        read_opts = pacsv.ReadOptions(
+            use_threads=True,
+            block_size=max(1 << 14, min(split, 1 << 26)),
+            column_names=stat.columns,
+            skip_rows=1 if stat.has_header else 0,
+            autogenerate_column_names=False)
+        conv_opts = pacsv.ConvertOptions(
+            column_types={c: pa.string() for c in stat.columns},
+            include_columns=list(projection) if projection else None,
+            strings_can_be_null=False)
+        offset = 0
+        for path in self.files:
+            bad_rows: list[tuple[int, str]] = []
+
+            def on_invalid(row, _bad=bad_rows):
+                _bad.append((row.number or 0, row.text or ""))
+                return "skip"
+
+            parse_opts = pacsv.ParseOptions(
+                delimiter=stat.delimiter,
+                invalid_row_handler=on_invalid)
+            with pacsv.open_csv(path, read_options=read_opts,
+                                parse_options=parse_opts,
+                                convert_options=conv_opts) as reader:
+                for batch in reader:
+                    if batch.num_rows == 0:
+                        continue
+                    tbl = pa.Table.from_batches([batch])
+                    p = _table_to_partition(tbl, raw_schema, max_w, offset)
+                    offset += p.num_rows
+                    yield p
+            if bad_rows:
+                p = _bad_rows_partition(bad_rows, stat, proj_idx, raw_schema,
+                                        offset)
+                offset += p.num_rows
+                yield p
+
     def _read_file(self, context, path: str, base_index: int,
                    projection=None):
         import pyarrow as pa
@@ -286,17 +343,24 @@ class CSVSourceOperator(L.LogicalOperator):
         # which rows are malformed): append bad rows as one trailing
         # partition — output order for them diverges from the reference
         if bad_rows:
-            vals = []
-            for _, text in bad_rows:
-                try:
-                    cells = next(_pycsv.reader([text],
-                                               delimiter=stat.delimiter))
-                except Exception:
-                    cells = [text]
-                vals.append(tuple(cells[i] if i < len(cells) else None
-                                  for i in proj_idx))
-            yield C.build_partition(
-                vals, raw_schema, start_index=base_index + n)
+            yield _bad_rows_partition(bad_rows, stat, proj_idx, raw_schema,
+                                      base_index + n)
+
+
+def _bad_rows_partition(bad_rows: list, stat: "CSVStatistic",
+                        proj_idx: list, raw_schema: T.RowType,
+                        start_index: int) -> C.Partition:
+    """Trailing partition of leniently re-parsed structurally-bad rows
+    (shared by the eager fallback and streaming paths)."""
+    vals = []
+    for _, text in bad_rows:
+        try:
+            cells = next(_pycsv.reader([text], delimiter=stat.delimiter))
+        except Exception:
+            cells = [text]
+        vals.append(tuple(cells[i] if i < len(cells) else None
+                          for i in proj_idx))
+    return C.build_partition(vals, raw_schema, start_index=start_index)
 
 
 def _scan_bad_records(path: str, stat: "CSVStatistic"
