@@ -246,3 +246,130 @@ def test_multihost_non_pow2_mesh():
     res = c.parallelize(data).aggregate(
         lambda a, b: a + b, lambda a, x: a + x, 0).collect()
     assert res == [sum(data)]
+
+
+@pytest.fixture()
+def dctx():
+    """Context with the device join forced on (CPU XLA in tests)."""
+    import tuplex_tpu
+
+    return tuplex_tpu.Context({"tuplex.partitionSize": "256KB",
+                               "tuplex.tpu.deviceJoin": "true"})
+
+
+def test_device_join_inner(dctx):
+    left = dctx.parallelize([(1, "a"), (2, "b"), (3, "c"), (2, "bb")],
+                            columns=["id", "lv"])
+    right = dctx.parallelize([(1, "x"), (2, "y"), (4, "z")],
+                             columns=["id", "rv"])
+    got = sorted(left.join(right, "id", "id").collect())
+    assert got == sorted([("a", 1, "x"), ("b", 2, "y"), ("bb", 2, "y")])
+
+
+def test_device_join_left_with_strings(dctx):
+    left = dctx.parallelize([("aa", 1), ("qq", 2), ("aa", 3)],
+                            columns=["k", "v"])
+    right = dctx.parallelize([("aa", "X"), ("zz", "Y")], columns=["k", "w"])
+    got = sorted(left.leftJoin(right, "k", "k").collect())
+    assert got == sorted([(1, "aa", "X"), (3, "aa", "X"), (2, "qq", None)])
+
+
+def test_device_join_duplicate_build_keys(dctx):
+    left = dctx.parallelize([(1, "l1"), (2, "l2")], columns=["id", "lv"])
+    right = dctx.parallelize([(1, "r1"), (1, "r2"), (1, "r3")],
+                             columns=["id", "rv"])
+    got = sorted(left.join(right, "id", "id").collect())
+    assert got == sorted([("l1", 1, "r1"), ("l1", 1, "r2"), ("l1", 1, "r3")])
+
+
+def test_device_join_option_keys(dctx, tmp_path):
+    # canonical None signatures must hold on the device path too
+    p = tmp_path / "l.csv"
+    p.write_text("k,v\nx,1\nNA,2\ny,3\nNA,4\n")
+    left = dctx.csv(str(p), null_values=["NA"])
+    right = dctx.parallelize([(None, "none"), ("x", "ex")],
+                             columns=["k", "w"])
+    got = sorted(left.join(right, "k", "k").collect())
+    assert got == [(1, "x", "ex"), (2, None, "none"), (4, None, "none")]
+
+
+def test_device_join_large(dctx):
+    n = 5000
+    left = dctx.parallelize([(i % 700, i) for i in range(n)],
+                            columns=["k", "v"])
+    right = dctx.parallelize([(i, i * 10) for i in range(500)],
+                             columns=["k", "w"])
+    got = left.join(right, "k", "k").collect()
+    want = [(i, i % 700, (i % 700) * 10) for i in range(n) if i % 700 < 500]
+    assert sorted(got) == sorted(want)
+
+
+def test_multihost_mesh_join():
+    # broadcast join over the 8-device CPU mesh: probe rows row-sharded,
+    # build side replicated (SURVEY §2.10.4)
+    import tuplex_tpu
+
+    c = tuplex_tpu.Context({"tuplex.backend": "multihost"})
+    n = 4000
+    left = c.parallelize([(i % 97, float(i)) for i in range(n)],
+                         columns=["k", "v"])
+    right = c.parallelize([(i, f"g{i}") for i in range(80)],
+                          columns=["k", "g"])
+    got = left.join(right, "k", "k").collect()
+    want = [(float(i), i % 97, f"g{i % 97}") for i in range(n)
+            if i % 97 < 80]
+    assert sorted(got) == sorted(want)
+
+
+def test_hybrid_join_boxed_probe_rows(ctx):
+    # dirty probe rows (mixed types -> boxed) python-probe the build table
+    # while normal rows stay vectorized; output order is positional
+    left = ctx.parallelize([(1, "a"), ("x", "weird"), (2, "b")],
+                          columns=["k", "lv"])
+    right = ctx.parallelize([(1, "r1"), (2, "r2")], columns=["k", "rv"])
+    got = left.join(right, "k", "k").collect()
+    assert got == [("a", 1, "r1"), ("b", 2, "r2")]
+    # boxed probe key that MATCHES via python equality would need same-type;
+    # left join keeps unmatched boxed row with None fill
+    got2 = left.leftJoin(right, "k", "k").collect()
+    assert got2 == [("a", 1, "r1"), ("weird", "x", None), ("b", 2, "r2")]
+
+
+def test_hybrid_join_boxed_build_rows(dctx):
+    # boxed BUILD row with a conforming key: normal probe rows must still
+    # find it (signature side-table), output boxes through fallback slots
+    right = dctx.parallelize([(1, "r1"), (2, (1, 2)), (3, "r3")],
+                             columns=["k", "rv"])  # (1,2) boxes the row
+    left = dctx.parallelize([(2, "probe2"), (3, "probe3")],
+                            columns=["k", "lv"])
+    got = sorted(left.join(right, "k", "k").collect())
+    assert got == [("probe2", 2, (1, 2)), ("probe3", 3, "r3")]
+
+
+def test_hybrid_device_join_real_fallback_build_row(tmp_path):
+    # over-long CSV cell boxes its build row; normal probe rows must still
+    # match it via the boxed-key signature side table, ON the device path
+    import tuplex_tpu
+    from tuplex_tpu.exec import joinexec as J
+
+    rp = tmp_path / "right.csv"
+    rp.write_text("k,rv\n1,r1\n2," + "L" * 60 + "\n3,r3\n")
+    lp = tmp_path / "left.csv"
+    lp.write_text("k,lv\n2,a\n3,b\n9,c\n")
+    c = tuplex_tpu.Context({"tuplex.tpu.deviceJoin": "true",
+                            "tuplex.tpu.maxStrBytes": "16"})
+    calls = {"probe": 0}
+    orig = J._DeviceProbe._match_positions
+
+    def mp(self, sig):
+        calls["probe"] += 1
+        return orig(self, sig)
+
+    J._DeviceProbe._match_positions = mp
+    try:
+        got = sorted(c.csv(str(lp)).leftJoin(
+            c.csv(str(rp)), "k", "k").collect())
+    finally:
+        J._DeviceProbe._match_positions = orig
+    assert got == [("a", 2, "L" * 60), ("b", 3, "r3"), ("c", 9, None)]
+    assert calls["probe"] >= 1

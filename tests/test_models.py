@@ -89,3 +89,48 @@ def test_nyc311_pipeline(ctx, tmp_path):
     got = nyc311.build_pipeline(ctx, path).collect()
     want = nyc311.run_reference_python(path)
     assert got == want
+
+
+def test_flights_pipeline_device_join(tmp_path):
+    # VERDICT r1 next#5: flights runs its three joins ON DEVICE
+    import tuplex_tpu
+    from tuplex_tpu.exec import joinexec as J
+    from tuplex_tpu.models import flights
+
+    perf = str(tmp_path / "flights.csv")
+    carrier = str(tmp_path / "carrier.csv")
+    airport = str(tmp_path / "airports.txt")
+    flights.generate_perf_csv(perf, 200, seed=5)
+    flights.generate_carrier_csv(carrier)
+    flights.generate_airport_db(airport)
+
+    ctx = tuplex_tpu.Context({"tuplex.partitionSize": "256KB",
+                              "tuplex.tpu.deviceJoin": "true"})
+    calls = {"probe": 0}
+    orig = J._DeviceProbe._match_positions
+
+    def counting(self, sig):
+        calls["probe"] += 1
+        return orig(self, sig)
+
+    J._DeviceProbe._match_positions = counting
+    try:
+        got = flights.build_pipeline(ctx, perf, carrier, airport).collect()
+    finally:
+        J._DeviceProbe._match_positions = orig
+    want = flights.run_reference_python(perf, carrier, airport)
+    assert len(got) == len(want)
+    assert calls["probe"] >= 3, calls  # all three joins probed on device
+
+    def key(r):
+        i = flights.OUTPUT_COLS.index
+        return (r[i("CarrierCode")], r[i("FlightNumber")], r[i("Year")],
+                r[i("Month")], r[i("Day")], r[i("CrsDepTime")])
+
+    for g, w in zip(sorted(got, key=key), sorted(want, key=key)):
+        for ci, (a, b) in enumerate(zip(g, w)):
+            if isinstance(a, float) and isinstance(b, float):
+                assert abs(a - b) <= 1e-12 * max(1.0, abs(b)), \
+                    (flights.OUTPUT_COLS[ci], a, b)
+            else:
+                assert a == b, (flights.OUTPUT_COLS[ci], a, b)

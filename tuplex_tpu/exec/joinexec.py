@@ -17,7 +17,7 @@ streams. Keys that can't live in the native table go to a python-dict backup
 from __future__ import annotations
 
 import time
-from typing import Optional
+from typing import Any, Optional
 
 import numpy as np
 
@@ -56,8 +56,13 @@ class JoinExecutor:
             rparts = res.partitions
             excs.extend(res.exceptions)
 
-        # one path for ALL partitions so every output shares one schema
-        vec = _VectorBuild.try_build(op, rparts or [], self.backend)
+        # one path for ALL partitions so every output shares one schema;
+        # device probe when a mesh/accelerator is present (or forced)
+        vec = None
+        if self._device_join_enabled():
+            vec = _DeviceProbe.try_build(op, rparts or [], self.backend)
+        if vec is None:
+            vec = _VectorBuild.try_build(op, rparts or [], self.backend)
         if vec is not None and not all(
                 vec.can_probe(part) for part in left_partitions):
             vec = None
@@ -66,7 +71,7 @@ class JoinExecutor:
         for part in left_partitions:
             self.backend.mm.touch(part)
             if vec is not None:
-                outp = vec.probe(part)
+                outp = vec.probe(part, excs)
                 assert outp is not None
             else:
                 if build is None:
@@ -81,24 +86,31 @@ class JoinExecutor:
         return StageResult(out_parts, excs, m)
 
     # ------------------------------------------------------------------
+    def _device_join_enabled(self) -> bool:
+        """Device probe policy: 'auto' uses the device when the backend has
+        a mesh or the platform is a real accelerator; CPU-local defaults to
+        the host numpy probe (np.searchsorted is already C-speed there)."""
+        mode = self.backend.options.get_str("tuplex.tpu.deviceJoin", "auto")
+        if mode in ("true", "1", "yes"):
+            return True
+        if mode in ("false", "0", "no"):
+            return False
+        if getattr(self.backend, "mesh", None) is not None:
+            return True
+        try:
+            from ..runtime.jaxcfg import jax
+
+            return jax.devices()[0].platform != "cpu"
+        except Exception:
+            return False
+
+    # ------------------------------------------------------------------
     def _build_table(self, op, rparts: list[C.Partition]) -> dict:
         """Hash table over the build side — rebuilt per execution (stale
         caches across actions would probe against old data)."""
-        build: dict = {}
         for rp in rparts:
             self.backend.mm.touch(rp)
-            rk = rp.schema.columns.index(op.right_column)
-            single = len(rp.schema.columns) == 1
-            for vals in C.partition_to_pylist(rp):
-                row_vals = (vals,) if single else vals
-                try:
-                    if not isinstance(row_vals, tuple) or \
-                            rk >= len(row_vals):
-                        continue
-                    build.setdefault(row_vals[rk], []).append(row_vals)
-                except TypeError:
-                    pass  # unhashable build key: unreachable by probe
-        return build
+        return _build_pydict(op, rparts)
 
     def _probe_partition(self, op, lpart: C.Partition,
                          rparts: list[C.Partition], build: dict,
@@ -138,6 +150,24 @@ class JoinExecutor:
                                start_index=lpart.start_index)
         return C.build_partition(values, schema,
                                  start_index=lpart.start_index)
+
+
+def _build_pydict(op, rparts: list[C.Partition]) -> dict:
+    """python-dict build table over ALL rows (normal + boxed) — the backup
+    side of the hybrid table and the row-wise path's table."""
+    build: dict = {}
+    for rp in rparts:
+        rk = rp.schema.columns.index(op.right_column)
+        single = len(rp.schema.columns) == 1
+        for vals in C.partition_to_pylist(rp):
+            row_vals = (vals,) if single else vals
+            try:
+                if not isinstance(row_vals, tuple) or rk >= len(row_vals):
+                    continue
+                build.setdefault(row_vals[rk], []).append(row_vals)
+            except TypeError:
+                pass  # unhashable build key: unreachable by probe
+    return build
 
 
 def _hashable(v) -> bool:
@@ -218,20 +248,21 @@ def _gather_leaves(part: C.Partition, idx: np.ndarray, valid_rows=None
 
 
 class _VectorBuild:
-    """Vectorized broadcast-join build: unique build keys + CSR row groups.
+    """Vectorized broadcast-join build: unique build keys + CSR row groups,
+    with HYBRID handling of boxed rows (reference: HybridHashTable.h:46-60 —
+    compiled keys in the native table, incompatible rows in a python backup).
 
-    The fast path of the reference's per-task hashtable probe
-    (LocalBackend.cc:213 + HashJoinStage), done with np.unique over key
-    signatures and numpy gathers — no per-row python on the hot path.
-    Applies when both sides are fully normal-case; anything boxed falls back
-    to the row-wise hybrid path.
-    """
+    Normal-case rows on both sides match via canonical byte signatures
+    (np.unique + searchsorted — no per-row python on the hot path). Boxed
+    probe rows python-probe the full dict; boxed BUILD rows with conforming
+    keys get signatures so normal probe rows still find them (their output
+    rows box through the partition fallback slots). Cross-type boxed build
+    keys reject the vectorized path entirely — python `==` semantics there
+    need the row-wise dict."""
 
     @classmethod
     def try_build(cls, op, rparts: list[C.Partition], backend):
         if not rparts:
-            return None
-        if any(p.fallback for p in rparts):
             return None
         for p in rparts:
             backend.mm.touch(p)
@@ -239,11 +270,33 @@ class _VectorBuild:
         if big is None or big.num_rows == 0:
             return None  # empty build: row-wise path handles it
         rk = big.schema.columns.index(op.right_column)
+        rt = big.schema.types[rk]
+        n_cols = len(big.schema.columns)
+        # boxed build rows -> backup side
+        boxed_rows: list[tuple] = []
+        normal_mask_all = np.ones(big.num_rows, np.bool_)
+        off = 0
+        for rp in rparts:
+            single = len(rp.schema.columns) == 1
+            for i, v in rp.fallback.items():
+                row_vals = (v,) if single and not (
+                    isinstance(v, tuple) and len(v) == n_cols) else v
+                if not isinstance(row_vals, tuple) or \
+                        len(row_vals) != n_cols:
+                    return None      # arity-weird boxed rows: row-wise path
+                if not T.python_value_conforms(row_vals[rk], rt):
+                    return None      # cross-type key: python == semantics
+                boxed_rows.append(tuple(row_vals))
+                normal_mask_all[off + i] = False
+            off += rp.num_rows
+        normal_idx = np.nonzero(normal_mask_all)[0]
+        if len(normal_idx) == 0:
+            return None   # all-boxed build: nothing to sign; row-wise path
         sig = _key_signatures(big, rk)
         if sig is None:
             return None
-        view = np.ascontiguousarray(sig).view(
-            [("v", np.void, sig.shape[1])]).ravel()
+        sub = np.ascontiguousarray(sig[normal_idx])
+        view = sub.view([("v", np.void, sig.shape[1])]).ravel()
         uniq, inverse = np.unique(view, return_inverse=True)
         order = np.argsort(inverse, kind="stable")
         counts = np.bincount(inverse, minlength=len(uniq))
@@ -252,18 +305,59 @@ class _VectorBuild:
         self.op = op
         self.big = big
         self.rk = rk
+        self.rparts = rparts
         self.uniq_view = uniq
-        self.order = order
+        self.order = normal_idx[order]        # global big-row indices
         self.counts = counts
         self.offsets = offsets
         self.key_width = sig.shape[1]
+        self.boxed_rows = boxed_rows
+        self.boxed_sigs = None
+        self._pydict: Optional[dict] = None
+        if boxed_rows and not self._encode_boxed_sigs(rt):
+            return None              # can't sign boxed keys: stay exact
         return self
+
+    def _encode_boxed_sigs(self, rt) -> bool:
+        """Signatures for boxed build keys in the SAME byte layout as the
+        normal-case key column (width-padded); keys too long for the layout
+        are unreachable by normal probe rows and sign as all-0xFF sentinels
+        (never equal to a canonical signature's zero padding)."""
+        kschema = T.row_of(["k"], [rt])
+        kpart = C.build_partition([r[self.rk] for r in self.boxed_rows],
+                                  kschema)
+        if kpart.fallback:
+            return False
+        too_long = np.zeros(kpart.num_rows, np.bool_)
+        for path, leaf in kpart.leaves.items():
+            if isinstance(leaf, C.StrLeaf):
+                big_path = str(self.rk) + path[1:]
+                big_leaf = self.big.leaves.get(big_path)
+                if not isinstance(big_leaf, C.StrLeaf):
+                    return False
+                w = big_leaf.width
+                too_long |= leaf.lengths > w
+                if leaf.width < w:
+                    leaf.bytes = C.pad_to(leaf.bytes, w, axis=1)
+                elif leaf.width > w:
+                    leaf.bytes = np.ascontiguousarray(leaf.bytes[:, :w])
+        sigs = C.key_signature_matrix(kpart, [0], reject_nan=True)
+        if sigs is None or sigs.shape[1] != self.key_width:
+            return False
+        sigs = np.where(too_long[:, None], np.uint8(0xFF), sigs)
+        self.boxed_sigs = sigs
+        return True
+
+    def _full_pydict(self) -> dict:
+        if self._pydict is None:
+            self._pydict = _build_pydict(self.op, self.rparts)
+        return self._pydict
 
     def can_probe(self, lpart: C.Partition) -> bool:
         """Cheap qualification; ALL partitions must pass or the whole join
         uses the row-wise path (mixed paths would mix output schemas)."""
         op = self.op
-        if lpart.fallback or op.left_column not in lpart.schema.columns:
+        if op.left_column not in lpart.schema.columns:
             return False
         lk = lpart.schema.columns.index(op.left_column)
         lt = lpart.schema.types[lk]
@@ -275,47 +369,121 @@ class _VectorBuild:
         # than padding — harmonize only covers one dataset's partitions
         return sig is not None and sig.shape[1] == self.key_width
 
-    def probe(self, lpart: C.Partition) -> Optional[C.Partition]:
+    def probe(self, lpart: C.Partition, excs: list
+              ) -> Optional[C.Partition]:
         op = self.op
         ls = lpart.schema
         lk = ls.columns.index(op.left_column)
         sig = _key_signatures(lpart, lk)
         if sig is None or sig.shape[1] != self.key_width:
             return None
-        return self._probe_sig(lpart, sig)
+        return self._probe_sig(lpart, sig, excs)
 
-    def _probe_sig(self, lpart: C.Partition, sig: np.ndarray
-                   ) -> Optional[C.Partition]:
-        op = self.op
-        ls = lpart.schema
-        lk = ls.columns.index(op.left_column)
-        n = lpart.num_rows
+    def _match_positions(self, sig: np.ndarray):
+        """(pos_clipped [N], matched [N]) — lower-bound probe into the sorted
+        unique build signatures. Host numpy; _DeviceProbe overrides with the
+        on-device binary search."""
         view = np.ascontiguousarray(sig).view(
             [("v", np.void, sig.shape[1])]).ravel()
         pos = np.searchsorted(self.uniq_view, view)
         pos_c = np.clip(pos, 0, len(self.uniq_view) - 1)
         matched = (pos < len(self.uniq_view)) & \
             (self.uniq_view[pos_c] == view)
-        cnt = np.where(matched, self.counts[pos_c], 0)
+        return pos_c, matched
+
+    def _gather(self, part: C.Partition, idx: np.ndarray, valid_rows=None
+                ) -> Optional[dict]:
+        """Leaf gather for the match expansion; _DeviceProbe overrides with
+        jitted device gathers."""
+        return _gather_leaves(part, idx, valid_rows)
+
+    def _probe_sig(self, lpart: C.Partition, sig: np.ndarray, excs: list
+                   ) -> Optional[C.Partition]:
+        op = self.op
+        ls = lpart.schema
+        lk = ls.columns.index(op.left_column)
+        n = lpart.num_rows
+        fb = lpart.fallback
+        is_fb = np.zeros(n, np.bool_)
+        if fb:
+            is_fb[list(fb.keys())] = True
+        pos_c, matched = self._match_positions(sig)
+        matched = matched & ~is_fb   # boxed slots carry placeholder bytes
+        cnt = np.where(matched, self.counts[pos_c], 0).astype(np.int64)
+
+        # boxed-build matches for normal probe rows, and python probes for
+        # boxed probe rows — each lands as a boxed OUTPUT row in its slot
+        extra_rows: dict[int, list] = {}
+        bcnt = np.zeros(n, np.int64)
+        ncols_r = len(self.big.schema.columns)
+        if self.boxed_sigs is not None and len(self.boxed_sigs):
+            # loop over the (small) boxed side: a broadcast [N, B, W] compare
+            # would transiently allocate N*B*W bytes on large probes
+            cand = np.zeros((n, len(self.boxed_sigs)), np.bool_)
+            for bi in range(len(self.boxed_sigs)):
+                cand[:, bi] = (sig == self.boxed_sigs[bi][None, :]).all(-1)
+            cand &= ~is_fb[:, None]
+            rows_with_b = np.nonzero(cand.any(1))[0]
+            for i, row in zip(rows_with_b.tolist(),
+                              C.decode_rows(lpart, rows_with_b)):
+                row_vals = tuple(row.values)
+                key = row_vals[lk]
+                lvals = [x for j, x in enumerate(row_vals) if j != lk]
+                outs = []
+                for bi in np.nonzero(cand[i])[0].tolist():
+                    mrow = self.boxed_rows[bi]
+                    rvals = [x for j, x in enumerate(mrow) if j != self.rk]
+                    outs.append(tuple(lvals + [key] + rvals))
+                extra_rows[i] = outs
+            bcnt[rows_with_b] = cand[rows_with_b].sum(1)
+        if fb:
+            pydict = self._full_pydict()
+            for i, v in fb.items():
+                row_vals = v if isinstance(v, tuple) else (v,)
+                try:
+                    key = row_vals[lk]
+                    lvals = [x for j, x in enumerate(row_vals) if j != lk]
+                    matches = pydict.get(key, []) if _hashable(key) else []
+                except Exception as e:
+                    excs.append(ExceptionRecord(op.id, type(e).__name__, v))
+                    continue
+                outs = []
+                for mrow in matches:
+                    rvals = [x for j, x in enumerate(mrow) if j != self.rk]
+                    outs.append(tuple(lvals + [key] + rvals))
+                if not outs and op.how == "left":
+                    outs.append(tuple(lvals) + (key,) +
+                                (None,) * (ncols_r - 1))
+                if outs:
+                    extra_rows[i] = outs
+                bcnt[i] = len(outs)
+
+        total = cnt + bcnt
+        filler = np.zeros(n, np.bool_)
         if op.how == "left":
-            out_per_row = np.maximum(cnt, 1)
-        else:
-            out_per_row = cnt
+            filler = (total == 0) & ~is_fb
+        out_per_row = np.where(filler, 1, total)
         m = int(out_per_row.sum())
-        left_idx = np.repeat(np.arange(n), out_per_row)
-        # build-row index per output row: offsets[code] + intra-group rank
-        row_starts = np.concatenate([[0], np.cumsum(out_per_row)])[:-1]
-        intra = np.arange(m) - np.repeat(row_starts, out_per_row)
-        code = self.offsets[np.repeat(pos_c, out_per_row)]
-        has_match = np.repeat(matched, out_per_row)
+        starts = np.concatenate([[0], np.cumsum(out_per_row)])[:-1]
+
+        # ---- vectorized portion: signature matches (+ left-join fillers) --
+        vec_take = np.where(filler, 1, cnt)
+        m_vec = int(vec_take.sum())
+        left_idx = np.repeat(np.arange(n), vec_take)
+        row_starts = np.concatenate([[0], np.cumsum(vec_take)])[:-1]
+        intra = np.arange(m_vec) - np.repeat(row_starts, vec_take)
+        code = self.offsets[np.repeat(pos_c, vec_take)]
+        has_match = np.repeat(matched, vec_take)
         build_rows = np.where(
             has_match, self.order[np.clip(code + intra, 0,
                                           max(len(self.order) - 1, 0))], 0)
+        # output slot of each vectorized row: row start + intra-group rank
+        vec_slots = np.repeat(starts, vec_take) + intra
         # gather left (minus key), key, right (minus key)
-        lgather = _gather_leaves(lpart, left_idx)
-        rgather = _gather_leaves(self.big, build_rows,
-                                 valid_rows=has_match
-                                 if op.how == "left" else None)
+        lgather = self._gather(lpart, left_idx)
+        rgather = self._gather(self.big, build_rows,
+                               valid_rows=has_match
+                               if op.how == "left" else None)
         if lgather is None or rgather is None:
             return None
         rs = self.big.schema
@@ -350,5 +518,222 @@ class _VectorBuild:
             out_cols.append(op._decorate(c, 1))
             put(t, rgather, i, make_opt=(op.how == "left"))
         schema = T.row_of(out_cols, out_types)
-        return C.Partition(schema=schema, num_rows=m, leaves=leaves,
-                           start_index=lpart.start_index)
+        vec_part = C.Partition(schema=schema, num_rows=m_vec, leaves=leaves,
+                               start_index=lpart.start_index)
+        if not extra_rows:
+            return vec_part
+        # ---- splice boxed outputs into their slots ------------------------
+        outp = C.gather_partition(vec_part, vec_slots,
+                                  np.arange(m_vec, dtype=np.int64), m)
+        outp.start_index = lpart.start_index
+        mask = np.zeros(m, np.bool_)
+        mask[vec_slots] = True
+        fallback_out: dict[int, Any] = {}
+        for i, outs in extra_rows.items():
+            base = int(starts[i]) + (int(cnt[i]) if not is_fb[i] else 0)
+            for j, t in enumerate(outs):
+                fallback_out[base + j] = t
+        outp.normal_mask = mask
+        outp.fallback = fallback_out
+        return outp
+
+
+# ===========================================================================
+# device-side probe + gather (SURVEY §2.10.4: device-sharded broadcast join)
+# ===========================================================================
+
+def _pack_sig_words(sig: np.ndarray) -> np.ndarray:
+    """[N, W] uint8 canonical signatures -> [N, nw] uint64 words whose
+    word-sequence lexicographic order equals the byte lexicographic order
+    (big-endian packing), so the device can binary-search them."""
+    n, w = sig.shape
+    nw = max(1, -(-w // 8))
+    if w < nw * 8:
+        sig = np.concatenate(
+            [sig, np.zeros((n, nw * 8 - w), np.uint8)], axis=1)
+    return np.ascontiguousarray(sig).view(">u8").astype(np.uint64)
+
+
+def _build_probe_fn(u: int, nw: int, mesh=None):
+    """Jittable lower-bound binary search of [B, nw] probe words in the
+    sorted [u, nw] build words. On a mesh the probe rows shard over the data
+    axis while the build side replicates on every device — the broadcast
+    hash join of the reference (PhysicalPlan.cc:145-178: no shuffle, build
+    side fully materialized everywhere)."""
+    from ..runtime.jaxcfg import jax, jnp
+
+    steps = max(1, u).bit_length() + 1
+
+    def lower_bound(words, build_words):
+        b = words.shape[0]
+        lo = jnp.zeros(b, jnp.int32)
+        hi = jnp.full(b, u, jnp.int32)
+        for _ in range(steps):
+            done = lo >= hi
+            mid = (lo + hi) // 2
+            mw = build_words[jnp.clip(mid, 0, max(u - 1, 0))]   # [b, nw]
+            diff = mw != words
+            anyd = jnp.any(diff, axis=1)
+            first = jnp.argmax(diff, axis=1)
+            aw = jnp.take_along_axis(mw, first[:, None], 1)[:, 0]
+            bw = jnp.take_along_axis(words, first[:, None], 1)[:, 0]
+            less = anyd & (aw < bw)
+            lo = jnp.where(~done & less, mid + 1, lo)
+            hi = jnp.where(~done & ~less, mid, hi)
+        pos = jnp.clip(lo, 0, max(u - 1, 0))
+        cand = build_words[pos]
+        matched = (lo < u) & jnp.all(cand == words, axis=1)
+        return pos.astype(jnp.int64), matched
+
+    if mesh is None:
+        return jax.jit(lower_bound)
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.mesh import DATA_AXIS
+
+    fn = shard_map(lower_bound, mesh=mesh,
+                   in_specs=(P(DATA_AXIS), P()),
+                   out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+                   check_vma=False)
+    return jax.jit(fn)
+
+
+def _leaf_flat_arrays(part: C.Partition, prefix: str) -> Optional[dict]:
+    """Flatten a partition's leaves into a dict of arrays for the device
+    gather; None if any leaf kind can't ride the device."""
+    out: dict[str, np.ndarray] = {}
+    for path, leaf in part.leaves.items():
+        if isinstance(leaf, C.NumericLeaf):
+            out[f"{prefix}{path}#d"] = leaf.data
+            if leaf.valid is not None:
+                out[f"{prefix}{path}#v"] = leaf.valid
+        elif isinstance(leaf, C.StrLeaf):
+            out[f"{prefix}{path}#b"] = leaf.bytes
+            out[f"{prefix}{path}#l"] = leaf.lengths
+            if leaf.valid is not None:
+                out[f"{prefix}{path}#v"] = leaf.valid
+        elif isinstance(leaf, C.NullLeaf):
+            pass                      # rebuilt host-side from m
+        else:
+            return None
+    return out
+
+
+def _build_gather_fn(lkeys: tuple, rkeys: tuple, left_join: bool):
+    """Jittable match-expansion gather: output row i takes left row
+    left_idx[i] and build row build_rows[i]; for left joins the unmatched
+    rows' right side is invalidated on device."""
+    from ..runtime.jaxcfg import jax, jnp
+
+    def gather(left_arrays, build_arrays, left_idx, build_rows, has_match):
+        out = {}
+        for k in lkeys:
+            out[k] = left_arrays[k][left_idx]
+        for k in rkeys:
+            g = build_arrays[k][build_rows]
+            if left_join:
+                if k.endswith("#v"):
+                    g = g & has_match
+                elif k.endswith("#d"):
+                    shape = (has_match.shape[0],) + (1,) * (g.ndim - 1)
+                    g = jnp.where(has_match.reshape(shape), g, 0)
+            out[k] = g
+        return out
+
+    return jax.jit(gather)
+
+
+class _DeviceProbe(_VectorBuild):
+    """Broadcast join with the probe + gathers ON DEVICE (single chip or
+    mesh). The build side stays host-factorized (np.unique — it is the small
+    side by the reference's own cost model) and ships to the device once;
+    probe partitions search it with a vectorized binary search and expand
+    matches with device gathers. Reference: PipelineBuilder.h
+    innerJoinDict/leftJoinDict fused probes; HashJoinStage.cc:473."""
+
+    @classmethod
+    def try_build(cls, op, rparts, backend):
+        self = super().try_build(op, rparts, backend)
+        if self is None:
+            return None
+        if _leaf_flat_arrays(self.big, "r.") is None:
+            return None
+        u = len(self.uniq_view)
+        sig_bytes = self.uniq_view.view(np.uint8).reshape(u, -1)
+        self._build_words = _pack_sig_words(sig_bytes)
+        self._nw = self._build_words.shape[1]
+        self._mesh = getattr(backend, "mesh", None)
+        self.backend = backend
+        return self
+
+    def _match_positions(self, sig: np.ndarray):
+        from ..runtime.jaxcfg import jax
+        import numpy as _np
+
+        u = len(self.uniq_view)
+        words = _pack_sig_words(sig)
+        n = words.shape[0]
+        b = C.bucket_size(n)
+        n_dev = len(self._mesh.devices.flat) if self._mesh is not None else 1
+        b = -(-b // n_dev) * n_dev
+        if b > n:
+            words = _np.concatenate(
+                [words, _np.zeros((b - n, self._nw), _np.uint64)])
+        fn = self.backend.jit_cache.get_or_build(
+            ("joinprobe", u, self._nw, id(self._mesh)),
+            lambda: _build_probe_fn(u, self._nw, self._mesh))
+        pos, matched = fn(words, self._build_words)
+        pos = _np.asarray(jax.device_get(pos))[:n]
+        matched = _np.asarray(jax.device_get(matched))[:n]
+        return pos, matched
+
+    def _gather(self, part: C.Partition, idx: np.ndarray, valid_rows=None
+                ) -> Optional[dict]:
+        from ..runtime.jaxcfg import jax
+        import numpy as _np
+
+        m = len(idx)
+        if m == 0:
+            return _gather_leaves(part, idx, valid_rows)
+        side = "r." if part is self.big else "l."
+        arrays = _leaf_flat_arrays(part, side)
+        if arrays is None:
+            return _gather_leaves(part, idx, valid_rows)
+        mb = C.bucket_size(m)
+        idx_p = _np.zeros(mb, _np.int64)
+        idx_p[:m] = idx
+        hm = _np.zeros(mb, _np.bool_)
+        hm[:m] = valid_rows if valid_rows is not None else True
+        keys = tuple(sorted(arrays))
+        left_join = valid_rows is not None
+        fn = self.backend.jit_cache.get_or_build(
+            ("joingather", side, keys, left_join),
+            lambda: _build_gather_fn(
+                keys if side == "l." else (), 
+                keys if side == "r." else (), left_join))
+        if side == "l.":
+            outs = fn(arrays, {}, idx_p, idx_p, hm)
+        else:
+            outs = fn({}, arrays, idx_p, idx_p, hm)
+        outs = jax.device_get(outs)
+        # rebuild leaves, sliced back to the true match count
+        gathered: dict[str, C.Leaf] = {}
+        for path, leaf in part.leaves.items():
+            if isinstance(leaf, C.NumericLeaf):
+                data = _np.asarray(outs[f"{side}{path}#d"])[:m]
+                valid = _np.asarray(outs[f"{side}{path}#v"])[:m] \
+                    if leaf.valid is not None else None
+                if left_join and valid is None:
+                    valid = hm[:m].copy()
+                gathered[path] = C.NumericLeaf(data, valid)
+            elif isinstance(leaf, C.StrLeaf):
+                b_ = _np.asarray(outs[f"{side}{path}#b"])[:m]
+                ln = _np.asarray(outs[f"{side}{path}#l"])[:m]
+                valid = _np.asarray(outs[f"{side}{path}#v"])[:m] \
+                    if leaf.valid is not None else None
+                if left_join and valid is None:
+                    valid = hm[:m].copy()
+                gathered[path] = C.StrLeaf(b_, ln, valid)
+            elif isinstance(leaf, C.NullLeaf):
+                gathered[path] = C.NullLeaf(m)
+        return gathered
