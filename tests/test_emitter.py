@@ -83,7 +83,8 @@ def run_interp(f, values, columns=None):
 
 
 _INTERNAL_CODES = {"NORMALCASEVIOLATION", "BADPARSE_STRING_INPUT",
-                   "NULLERROR", "GENERALCASEVIOLATION", "PYTHON_FALLBACK"}
+                   "NULLERROR", "GENERALCASEVIOLATION", "PYTHON_FALLBACK",
+                   "LOOPCAPEXCEEDED"}
 
 
 def check(f, values, columns=None):
@@ -391,3 +392,176 @@ def test_format_percent_escape():
     check(lambda x: "100%% of %d" % x, [42, -1])
     check(lambda x: "%d%%" % x, [7])
     check(lambda x: "%s%%%s" % (x, x), ["a", "bc"])
+
+
+# --- loops / comprehensions (reference: BlockGeneratorVisitor NFor:5212,
+# NWhile:5608, NListComprehension:3278; UnrollLoopsVisitor.cc) -------------
+
+def test_for_range_accumulate():
+    def f(x):
+        s = 0
+        for i in range(5):
+            s = s + i * x
+        return s
+    check(f, [1, 2, -3, 0])
+
+
+def test_for_over_const_tuple_and_string():
+    def f(x):
+        n = 0
+        for c in "abc":
+            if c == "b":
+                n = n + x
+        return n
+    check(f, [5, -1])
+
+    def g(x):
+        s = 0
+        for v in (2, 4, 6):
+            s = s + v + x
+        return s
+    check(g, [1, 10])
+
+
+def test_for_break_continue():
+    def f(x):
+        s = 0
+        for i in range(10):
+            if i == x:
+                break
+            if i % 2 == 0:
+                continue
+            s = s + i
+        return s
+    check(f, [0, 3, 5, 9, 100])
+
+
+def test_for_else():
+    def f(x):
+        for i in range(4):
+            if i == x:
+                break
+        else:
+            return -1
+        return i
+    check(f, [0, 2, 3, 7])
+
+
+def test_for_tuple_unpack_zip_enumerate():
+    def f(x):
+        s = 0
+        for i, v in enumerate((10, 20, 30)):
+            s = s + i * v + x
+        return s
+    check(f, [0, 1])
+
+    def g(x):
+        s = 0
+        for a, b in zip((1, 2), (30, 40)):
+            s = s + a * b
+        return s + x
+    check(g, [0, 5])
+
+
+def test_while_const_bound():
+    def f(x):
+        i = 0
+        s = 0
+        while i < 6:
+            s = s + x
+            i = i + 1
+        return s
+    check(f, [1, 3, -2])
+
+
+def test_while_data_dependent():
+    # collatz-ish step count, bounded: all values finish well under the cap
+    def f(x):
+        n = x
+        steps = 0
+        while n > 1:
+            n = n // 2
+            steps = steps + 1
+        return steps
+    check(f, [1, 2, 7, 63, 1000])
+
+
+def test_while_cap_routes_to_interpreter():
+    # 2**40 needs 40 halvings > cap 24: the row must STILL be exact via the
+    # interpreter fallback (LOOPCAPEXCEEDED err routes it)
+    def f(x):
+        n = x
+        steps = 0
+        while n > 1:
+            n = n // 2
+            steps = steps + 1
+        return steps
+    check(f, [8, 2 ** 40, 3])
+
+
+def test_list_comprehension_sum():
+    check(lambda x: sum([i * i for i in range(6)]) + x, [0, 2])
+    check(lambda x: sum([x * i for i in (1, 2, 3)]), [4, -1])
+
+
+def test_generator_exp_min_max_any_all():
+    check(lambda x: max(i * x for i in (1, 2, 3)), [2, -2])
+    check(lambda x: min([x + i for i in range(3)]), [10, -5])
+    check(lambda x: any(x == i for i in range(4)), [2, 9])
+    check(lambda x: all(x > i for i in (0, 1, 2)), [3, 2])
+
+
+def test_comprehension_const_filter():
+    check(lambda x: sum([i for i in range(10) if i % 2 == 0]) + x, [0, 1])
+
+
+def test_loop_over_string_chars():
+    def f(s):
+        n = 0
+        for c in "0123456789":
+            n = n + s.count(c)
+        return n
+    check(f, ["a1b22c333", "", "no digits"])
+
+
+def test_while_true_break_else_not_taken():
+    # review r2: else must NOT run for rows that exited via break
+    def f(x):
+        n = x
+        while True:
+            n = n // 2
+            if n <= 1:
+                break
+        else:
+            return -1
+        return n
+    check(f, [8, 5, 1, 100])
+
+
+def test_while_false_runs_else():
+    def f(x):
+        while False:
+            x = x + 100
+        else:
+            x = x + 1
+        return x
+    check(f, [1, 7])
+
+
+def test_enumerate_start_keyword_exact():
+    # review r2: enumerate(start=) keyword silently compiled with start=0;
+    # now the UDF is NotCompilable -> whole op interprets (exact either way)
+    def f(x):
+        s = 0
+        for i, v in enumerate((10, 20, 30), start=1):
+            s = s + i * v + x
+        return s
+    with pytest.raises(NotCompilable):
+        run_compiled(f, [0, 1])
+
+    def g(x):
+        s = 0
+        for i, v in enumerate((10, 20, 30), 1):   # positional: compiles
+            s = s + i * v + x
+        return s
+    check(g, [0, 1])

@@ -36,6 +36,13 @@ from ..runtime.jaxcfg import jnp
 from ..utils.reflection import UDFSource, get_udf_source
 from .values import CV, _MISSING, const_cv, dtype_for, materialize, null_cv, tuple_cv
 
+# loop bounds: for-loops fully unroll (static trip counts only); while-loops
+# unroll to the cap with per-row exit masks — rows still looping at the cap
+# raise LOOPCAPEXCEEDED and resolve exactly on the interpreter (reference:
+# UnrollLoopsVisitor.cc caps at compile time too)
+_FOR_UNROLL_CAP = 256
+_WHILE_UNROLL_CAP = 24
+
 
 class EmitCtx:
     """Per-stage trace state: batch size, error lattice, active mask."""
@@ -99,11 +106,31 @@ class Frame:
         self.mask = None          # branch predicate ([B] bool) or None == all
         self.ret_val: Optional[CV] = None
         self.ret_mask = jnp.zeros(self.ctx.b, dtype=bool)
+        # vectorized loop state: one dict per enclosing loop; masks stay
+        # None until a row actually breaks/continues/exits so constant
+        # propagation survives fully-unrolled loops
+        self.loops: list[dict] = []
 
     # -- masks ---------------------------------------------------------------
     def active(self):
         a = self.ctx.active & ~self.ret_mask
-        return a if self.mask is None else a & self.mask
+        if self.mask is not None:
+            a = a & self.mask
+        for lp in self.loops:
+            for k in ("brk", "cont", "done"):
+                if lp[k] is not None:
+                    a = a & ~lp[k]
+        return a
+
+    def _assign_pred(self):
+        """Predicate under which assignments merge with the old value: branch
+        mask plus 'row already left this loop iteration/loop' exclusions."""
+        m = self.mask
+        for lp in self.loops:
+            for k in ("brk", "cont", "done"):
+                if lp[k] is not None:
+                    m = ~lp[k] if m is None else m & ~lp[k]
+        return m
 
     def raise_where(self, cond, code: ExceptionCode):
         hit = self.active() & cond & (self.ctx.err == 0)
@@ -181,8 +208,9 @@ class Frame:
     def _assign_target(self, tgt: ast.expr, val: CV) -> None:
         if isinstance(tgt, ast.Name):
             old = self.env.get(tgt.id)
-            if self.mask is not None and old is not None:
-                val = merge_cv(self, self.mask, val, old)
+            pred = self._assign_pred()
+            if pred is not None and old is not None:
+                val = merge_cv(self, pred, val, old)
             self.env[tgt.id] = val
         elif isinstance(tgt, (ast.Tuple, ast.List)):
             if val.elts is None:
@@ -223,6 +251,202 @@ class Frame:
     def exec_Expr(self, node: ast.Expr) -> None:
         # evaluate for side effects (errors); discard value
         self.eval(node.value)
+
+    # -- loops (reference: BlockGeneratorVisitor.cc:5212 NFor, :5608 NWhile,
+    # UnrollLoopsVisitor.cc, IteratorContextProxy.cc zip/enumerate) ---------
+    def exec_For(self, node: ast.For) -> None:
+        items = self._static_iter_items(node.iter)
+        if items is None:
+            raise NotCompilable("for over non-static iterable")
+        lp = {"brk": None, "cont": None, "done": None}
+        self.loops.append(lp)
+        try:
+            for item in items:
+                self._assign_target(node.target, item)
+                self.exec_block(node.body)
+                lp["cont"] = None        # continue only skips ONE iteration
+            brk = lp["brk"]
+        finally:
+            self.loops.pop()
+        if node.orelse:
+            # python for-else: runs unless the loop broke
+            outer = self.mask
+            if brk is not None:
+                self.mask = ~brk if outer is None else outer & ~brk
+            try:
+                self.exec_block(node.orelse)
+            finally:
+                self.mask = outer
+
+    def exec_While(self, node: ast.While) -> None:
+        """Bounded unrolling with per-row exit masks: rows whose condition
+        still holds after the cap raise LOOPCAPEXCEEDED and resolve on the
+        interpreter — semantics stay exact, long-looping rows just go slow
+        (reference: TypeAnnotator loop-stability + NWhile codegen)."""
+        cap = _WHILE_UNROLL_CAP
+        lp = {"brk": None, "cont": None, "done": None}
+        self.loops.append(lp)
+
+        def eval_cond():
+            """'all' (const-True: every row continues), 'stop' (const-False:
+            every active row exits), or a truthy array. Rows observed exiting
+            via a false condition accumulate into lp['done'] — they power
+            while-else and drop out of active()."""
+            cond = self.eval(node.test)
+            if cond.is_const:
+                if bool(cond.const):
+                    return "all"
+                exiting = self.active()
+                lp["done"] = exiting if lp["done"] is None \
+                    else lp["done"] | exiting
+                return "stop"
+            tr = self.truthy(cond)
+            exiting = self.active() & ~tr
+            lp["done"] = exiting if lp["done"] is None \
+                else lp["done"] | exiting
+            return tr
+
+        try:
+            for _ in range(cap):
+                state = eval_cond()
+                if isinstance(state, str) and state == "stop":
+                    break
+                self.exec_block(node.body)
+                lp["cont"] = None
+            else:
+                # cap reached: rows still looping cannot finish on device
+                state = eval_cond()
+                if not (isinstance(state, str) and state == "stop"):
+                    still = jnp.ones(self.ctx.b, dtype=bool) \
+                        if isinstance(state, str) else state
+                    self.raise_where(still, ExceptionCode.LOOPCAPEXCEEDED)
+            done = lp["done"]
+        finally:
+            self.loops.pop()
+        if node.orelse and done is not None:
+            # while-else: ONLY rows that exited via a false condition (a
+            # break skips it; const-False exits were folded into `done`)
+            outer = self.mask
+            self.mask = done if outer is None else outer & done
+            try:
+                self.exec_block(node.orelse)
+            finally:
+                self.mask = outer
+
+    def exec_Break(self, node: ast.Break) -> None:
+        if not self.loops:
+            raise NotCompilable("break outside loop")
+        lp = self.loops[-1]
+        live = self.active()
+        lp["brk"] = live if lp["brk"] is None else lp["brk"] | live
+
+    def exec_Continue(self, node: ast.Continue) -> None:
+        if not self.loops:
+            raise NotCompilable("continue outside loop")
+        lp = self.loops[-1]
+        live = self.active()
+        lp["cont"] = live if lp["cont"] is None else lp["cont"] | live
+
+    def _static_iter_items(self, node: ast.expr) -> Optional[list[CV]]:
+        """The iterable's elements as CVs when the LENGTH is trace-static:
+        const str/tuple/list/range, tuple CVs, zip/enumerate/reversed over
+        those. Data-dependent lengths can't unroll -> None."""
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and not node.keywords \
+                and node.func.id not in self.env \
+                and node.func.id not in self.em.globals:
+            # keyword forms (enumerate(start=), zip(strict=)) fall through
+            # to eval_Call, which rejects keywords -> interpreter
+            fname = node.func.id
+            if fname == "range":
+                args = [self.eval(a) for a in node.args]
+                if not all(a.is_const and isinstance(a.const, int)
+                           for a in args) or not 1 <= len(args) <= 3:
+                    return None
+                r = range(*[a.const for a in args])
+                if len(r) > _FOR_UNROLL_CAP:
+                    raise NotCompilable(
+                        f"range({len(r)}) exceeds unroll cap")
+                return [const_cv(i) for i in r]
+            if fname == "zip":
+                subs = [self._static_iter_items(a) for a in node.args]
+                if any(s is None for s in subs) or not subs:
+                    return None
+                return [tuple_cv(list(t)) for t in zip(*subs)]
+            if fname == "enumerate":
+                if len(node.args) not in (1, 2):
+                    return None
+                sub = self._static_iter_items(node.args[0])
+                if sub is None:
+                    return None
+                start = 0
+                if len(node.args) == 2:
+                    s = self.eval(node.args[1])
+                    if not (s.is_const and isinstance(s.const, int)):
+                        return None
+                    start = s.const
+                return [tuple_cv([const_cv(i + start), e])
+                        for i, e in enumerate(sub)]
+            if fname == "reversed":
+                sub = self._static_iter_items(node.args[0]) \
+                    if len(node.args) == 1 else None
+                return None if sub is None else list(reversed(sub))
+        try:
+            v = self.eval(node)
+        except NotCompilable:
+            return None
+        return self._cv_iter_items(v)
+
+    def _cv_iter_items(self, v: CV) -> Optional[list[CV]]:
+        if v.is_const:
+            c = v.const
+            if isinstance(c, (str, tuple, list, range)):
+                if len(c) > _FOR_UNROLL_CAP:
+                    raise NotCompilable("iterable exceeds unroll cap")
+                return [const_cv(x) for x in c]
+            return None
+        if v.elts is not None and v.valid is None:
+            return list(v.elts)
+        return None
+
+    # -- comprehensions (reference: BlockGeneratorVisitor.cc:3278
+    # NListComprehension) ---------------------------------------------------
+    def eval_ListComp(self, node: ast.ListComp) -> CV:
+        return self._comprehension(node)
+
+    def eval_GeneratorExp(self, node: ast.GeneratorExp) -> CV:
+        return self._comprehension(node)
+
+    def _comprehension(self, node) -> CV:
+        if len(node.generators) != 1:
+            raise NotCompilable("nested comprehension")
+        gen = node.generators[0]
+        if getattr(gen, "is_async", 0):
+            raise NotCompilable("async comprehension")
+        items = self._static_iter_items(gen.iter)
+        if items is None:
+            raise NotCompilable("comprehension over non-static iterable")
+        saved = dict(self.env)
+        outs: list[CV] = []
+        try:
+            for item in items:
+                self._assign_target(gen.target, item)
+                keep = True
+                for cond_node in gen.ifs:
+                    cond = self.eval(cond_node)
+                    if not cond.is_const:
+                        # data-dependent filter => data-dependent ARITY:
+                        # no static shape exists for the result
+                        raise NotCompilable(
+                            "comprehension filter must be trace-constant")
+                    if not bool(cond.const):
+                        keep = False
+                        break
+                if keep:
+                    outs.append(self.eval(node.elt))
+        finally:
+            self.env = saved   # py3 comprehension scope: target doesn't leak
+        return tuple_cv(outs)
 
     def exec_Pass(self, node: ast.Pass) -> None:
         pass
@@ -331,9 +555,31 @@ class Frame:
 
     def eval_Compare(self, node: ast.Compare) -> CV:
         left = self.eval(node.left)
+        comps = [self.eval(c) for c in node.comparators]
+        if left.is_const and all(c.is_const for c in comps):
+            # const-fold (unrolled loop counters etc.); raising or exotic
+            # compares fall through to the vectorized error-lattice path
+            import operator as _o
+
+            table = {ast.Eq: _o.eq, ast.NotEq: _o.ne, ast.Lt: _o.lt,
+                     ast.LtE: _o.le, ast.Gt: _o.gt, ast.GtE: _o.ge}
+            vals = [left.const] + [c.const for c in comps]
+            try:
+                ok: Optional[bool] = True
+                for op, a, b in zip(node.ops, vals, vals[1:]):
+                    f = table.get(type(op))
+                    if f is None:
+                        ok = None
+                        break
+                    if not f(a, b):
+                        ok = False
+                        break
+                if ok is not None:
+                    return const_cv(bool(ok))
+            except Exception:
+                pass
         acc = None
-        for op, comp in zip(node.ops, node.comparators):
-            right = self.eval(comp)
+        for op, right in zip(node.ops, [*comps]):
             res = self._compare(op, left, right)
             acc = res if acc is None else acc & res
             left = right
@@ -1092,6 +1338,37 @@ class Frame:
             return CV(t=T.F64, data=r / (10.0 ** nd))
         return CV(t=T.I64, data=r.astype(jnp.int64))
 
+    def _builtin_sum(self, args: list[CV]) -> CV:
+        if len(args) not in (1, 2):
+            raise NotCompilable("sum() arity")
+        items = self._cv_iter_items(args[0])
+        if items is None:
+            raise NotCompilable("sum over non-static iterable")
+        acc: CV = args[1] if len(args) == 2 else const_cv(0)
+        for it in items:
+            acc = self._binop(ast.Add(), acc, it)
+        return acc
+
+    def _builtin_any(self, args: list[CV]) -> CV:
+        return self._any_all(args, any_mode=True)
+
+    def _builtin_all(self, args: list[CV]) -> CV:
+        return self._any_all(args, any_mode=False)
+
+    def _any_all(self, args: list[CV], any_mode: bool) -> CV:
+        if len(args) != 1:
+            raise NotCompilable("any/all arity")
+        items = self._cv_iter_items(args[0])
+        if items is None:
+            raise NotCompilable("any/all over non-static iterable")
+        if not items:
+            return const_cv(bool(not any_mode))
+        acc = self.truthy(items[0])
+        for it in items[1:]:
+            tr = self.truthy(it)
+            acc = (acc | tr) if any_mode else (acc & tr)
+        return CV(t=T.BOOL, data=acc)
+
     def _builtin_min(self, args: list[CV]) -> CV:
         return self._minmax(args, jnp.minimum)
 
@@ -1100,7 +1377,10 @@ class Frame:
 
     def _minmax(self, args: list[CV], fn) -> CV:
         if len(args) == 1:
-            raise NotCompilable("min/max over iterable")
+            items = self._cv_iter_items(args[0])
+            if not items:
+                raise NotCompilable("min/max over non-static iterable")
+            args = items
         vs = [self._require_numeric(a, "min/max") for a in args]
         out_t = vs[0].base
         for v in vs[1:]:

@@ -33,6 +33,7 @@ class ExceptionCode(enum.IntEnum):
     BADPARSE_STRING_INPUT = 101
     NULLERROR = 102            # unexpected None on a non-Option path
     GENERALCASEVIOLATION = 103
+    LOOPCAPEXCEEDED = 104      # while-loop unroll cap hit: interpreter row
     PYTHON_FALLBACK = 110      # UDF not compilable: row routed to interpreter
     UNKNOWN = 120
 
