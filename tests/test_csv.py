@@ -196,3 +196,44 @@ def test_malformed_rows_merge_in_order(ctx, csvdir):
     # bad rows (2 and 4) box through the fallback path; their first cell
     # still parses as the normal-case i64 via the interpreter
     assert got == [1, 2, 3, 4, 5]
+
+
+def test_nulls_in_sample_are_normal_case(ctx, tmp_path):
+    # nulls observed in the sample speculate the column to Option[i64]: they
+    # decode on the FAST path, no violation at all
+    p = tmp_path / "g.csv"
+    rows = [("" if i % 13 == 0 else str(i)) + ",k" for i in range(2000)]
+    p.write_text("n,t\n" + "\n".join(rows) + "\n")
+    ds = ctx.csv(str(p)).map(lambda x: 0 if x["n"] is None else x["n"] * 2)
+    assert ds.collect() == [0 if i % 13 == 0 else i * 2
+                            for i in range(2000)]
+
+
+def test_general_case_tier_string_widening(ctx, tmp_path):
+    # VERDICT r1 next#4: mixed int/str column below the junk threshold:
+    # normal=i64 (majority), general=str. Violating rows must resolve on the
+    # COMPILED general tier — zero per-row python.
+    import tuplex_tpu.exec.local as LB
+
+    p = tmp_path / "m.csv"
+    rows = ["x" + str(i) if i % 11 == 0 else str(i) for i in range(2000)]
+    p.write_text("v\n" + "\n".join(rows) + "\n")
+
+    interp_rows = {"n": 0}
+    orig = LB.C.decode_rows
+
+    def counting(part, indices):
+        out = orig(part, indices)
+        interp_rows["n"] += len(out)
+        return out
+
+    LB.C.decode_rows = counting
+    try:
+        got = ctx.csv(str(p)).map(lambda x: len(str(x["v"]))).collect()
+    finally:
+        LB.C.decode_rows = orig
+    want = [len(("x" + str(i)) if i % 11 == 0 else str(i))
+            for i in range(2000)]
+    assert got == want
+    # all ~182 violating rows resolved on the compiled general tier
+    assert interp_rows["n"] == 0, interp_rows

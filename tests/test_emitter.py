@@ -565,3 +565,21 @@ def test_enumerate_start_keyword_exact():
             s = s + i * v + x
         return s
     check(g, [0, 1])
+
+
+def test_user_defined_sum_wins_over_builtin():
+    # review r3: python resolves globals before builtins — a user helper
+    # named sum must be inlined, not shadowed by the compiled sum()
+    def sum(xs):   # noqa: A001 — deliberate shadowing
+        return 99
+
+    def f(x):
+        return sum((x, 1))
+
+    check(f, [1, 2, 3])
+
+
+def test_sum_of_strings_matches_python_typeerror():
+    # python: sum(..., "") raises TypeError; route to interpreter for parity
+    with pytest.raises(NotCompilable):
+        run_compiled(lambda s: sum((s, s), ""), ["ab", "cd"])

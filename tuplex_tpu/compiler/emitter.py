@@ -164,6 +164,12 @@ class Frame:
         state = [self.ctx.err, self.ctx.active, self.ret_mask]
         if self.mask is not None:
             state.append(self.mask)
+        loop_slots = []   # (loop dict, key) per materialized loop mask
+        for lp in self.loops:
+            for k in ("brk", "cont", "done"):
+                if lp[k] is not None:
+                    loop_slots.append((lp, k))
+                    state.append(lp[k])
         n_cv = len(leaves)
         leaves.extend(state)
         if not leaves:
@@ -174,10 +180,13 @@ class Frame:
             self.env[name] = cv_rebuild(cv, it)
         if rv is not None:
             self.ret_val = cv_rebuild(rv, it)
-        rest = out[n_cv:]
-        self.ctx.err, self.ctx.active, self.ret_mask = rest[0], rest[1], rest[2]
+        rest = iter(out[n_cv:])
+        self.ctx.err, self.ctx.active, self.ret_mask = \
+            next(rest), next(rest), next(rest)
         if self.mask is not None:
-            self.mask = rest[3]
+            self.mask = next(rest)
+        for lp, k in loop_slots:
+            lp[k] = next(rest)
 
     def exec(self, node: ast.stmt) -> None:
         m = getattr(self, "exec_" + type(node).__name__, None)
@@ -669,15 +678,20 @@ class Frame:
             raise NotCompilable("computed call target")
         name = node.func.id
         args = [self.eval(a) for a in node.args]
-        builtin = getattr(self, "_builtin_" + name, None)
-        if builtin is not None:
-            return builtin(args)
+        # python name resolution order: locals, then globals, THEN builtins —
+        # a user-defined sum/len/etc. must win over our builtin emitters
+        if name in self.env:
+            raise NotCompilable(f"call to local value {name}")
         if name in self.em.globals:
             g = self.em.globals[name]
             if callable(g):
                 if g.__module__ in ("math",):
                     return self._module_fn(g, args)
                 return self.em.inline_call(g, args)
+            raise NotCompilable(f"call to non-callable global {name}")
+        builtin = getattr(self, "_builtin_" + name, None)
+        if builtin is not None:
+            return builtin(args)
         raise NotCompilable(f"call to {name}")
 
     def eval_JoinedStr(self, node: ast.JoinedStr) -> CV:
@@ -1345,6 +1359,10 @@ class Frame:
         if items is None:
             raise NotCompilable("sum over non-static iterable")
         acc: CV = args[1] if len(args) == 2 else const_cv(0)
+        if acc.base is T.STR or (acc.is_const and isinstance(acc.const, str)):
+            # python forbids sum() over strings (TypeError): the interpreter
+            # path reproduces the exact error
+            raise NotCompilable("sum() can't sum strings")
         for it in items:
             acc = self._binop(ast.Add(), acc, it)
         return acc
@@ -1361,8 +1379,10 @@ class Frame:
         items = self._cv_iter_items(args[0])
         if items is None:
             raise NotCompilable("any/all over non-static iterable")
-        if not items:
-            return const_cv(bool(not any_mode))
+        if all(it.is_const for it in items):
+            # const-fold so while/comprehension conditions stay trace-static
+            vals = [it.const for it in items]
+            return const_cv(any(vals) if any_mode else all(vals))
         acc = self.truthy(items[0])
         for it in items[1:]:
             tr = self.truthy(it)

@@ -137,7 +137,7 @@ class LocalBackend:
         t0 = time.perf_counter()
         mm_snap = self.mm.metrics_snapshot()
         metrics: dict[str, Any] = {"fast_path_s": 0.0, "slow_path_s": 0.0,
-                                   "compile_s": 0.0}
+                                   "general_path_s": 0.0, "compile_s": 0.0}
         parts_it = iter(partitions)
         first_part = next(parts_it, None)
         device_fn = None
@@ -184,6 +184,7 @@ class LocalBackend:
             self.mm.register(outp)
             metrics["fast_path_s"] += m.get("fast_path_s", 0.0)
             metrics["slow_path_s"] += m.get("slow_path_s", 0.0)
+            metrics["general_path_s"] += m.get("general_path_s", 0.0)
             exceptions.extend(excs)
             if limit >= 0 and emitted_total + outp.num_rows > limit:
                 outp = _truncate_partition(outp, limit - emitted_total)
@@ -279,11 +280,18 @@ class LocalBackend:
             metrics["fast_path_s"] = dispatch_s
             fallback_idx.update(range(n))
 
+        # ---- compiled general-case tier (ResolveTask resolve_f analog) ----
+        resolved: dict[int, Row] = {}
+        if fallback_idx and pending_outs is not None \
+                and not self.interpret_only:
+            t0 = time.perf_counter()
+            self._general_case_pass(stage, part, fallback_idx, resolved)
+            metrics["general_path_s"] = time.perf_counter() - t0
+
         # ---- interpreter path (ResolveTask analog) ------------------------
         # one compiled closure chain per stage + bulk row decode: no per-row
         # op dispatch (reference: PythonPipelineBuilder.cc)
         t0 = time.perf_counter()
-        resolved: dict[int, Row] = {}
         exceptions: list[ExceptionRecord] = []
         if fallback_idx:
             pipeline = stage.python_pipeline(part.user_columns)
@@ -299,6 +307,80 @@ class LocalBackend:
 
         outp = self._merge(stage, part, compiled_ok, out_arrays, resolved)
         return outp, exceptions, metrics
+
+    # ------------------------------------------------------------------
+    def _general_case_pass(self, stage: TransformStage, part: C.Partition,
+                           fallback_idx: set, resolved: dict) -> None:
+        """Compiled middle tier: re-run normal-case-violating rows through
+        the stage fn traced under the GENERAL-CASE schema (Option/supertype
+        widened decode). Rows it completes fold back like resolved python
+        rows — but their compute stayed vectorized; only rows that STILL err
+        reach the per-row interpreter (reference: StageBuilder.cc:1145
+        generateResolveCodePath, ResolveTask.h resolve_f-before-interpreter).
+        """
+        import jax
+
+        gkey = "general/" + stage.key() + "/" + part.schema.name
+        if gkey in self._not_compilable:
+            return
+        # input-boxed rows can't ride the columnar general path
+        cand = sorted(i for i in fallback_idx if i not in part.fallback)
+        if not cand:
+            return
+        try:
+            gfn = self.jit_cache.get_or_build(
+                ("stagefn", gkey),
+                lambda: self._jit_stage_fn(
+                    stage.build_device_fn(part.schema, general=True)))
+        except NotCompilable:
+            self._not_compilable.add(gkey)
+            return
+        idx = np.asarray(cand, dtype=np.int64)
+        k = len(idx)
+        sub = C.gather_partition(part, np.arange(k, dtype=np.int64), idx, k)
+        sub.fallback = {}
+        sub.normal_mask = None
+        batch = C.stage_partition(sub, self.bucket_mode)
+        cache_key = ("stagefn", gkey)
+        spec = batch.spec()
+        first_call = not self.jit_cache.was_traced(cache_key, spec)
+        try:
+            outs = gfn(batch.arrays)
+            self.jit_cache.note_traced(cache_key, spec)
+        except Exception as e:
+            if not first_call:
+                raise
+            from ..utils.logging import get_logger
+
+            get_logger("exec").warning(
+                "general-case trace failed (%s: %s); rows stay on the "
+                "interpreter", type(e).__name__, e)
+            self._not_compilable.add(gkey)
+            return
+        outs = jax.device_get(outs)
+        err = np.asarray(outs.pop("#err"))[:k]
+        keep = np.asarray(outs.pop("#keep"))[:k]
+        ok = err == 0
+        if not ok.any():
+            return
+        out_arrays = {kk: np.asarray(v) for kk, v in outs.items()}
+        from ..plan.physical import runtime_output_columns
+
+        out_cols = runtime_output_columns(part.schema, stage.ops)
+        outp = C.partition_from_result_arrays(out_arrays, k,
+                                              columns=out_cols)
+        vals = C.partition_to_pylist(outp)
+        cols = outp.user_columns
+        single = len(outp.schema.types) == 1
+        for j in range(k):
+            if not ok[j]:
+                continue
+            i = int(idx[j])
+            fallback_idx.discard(i)
+            if keep[j]:
+                v = vals[j]
+                resolved[i] = Row((v,), cols) if single else Row(v, cols)
+            # else: filtered out on the general path — row emits nothing
 
     # ------------------------------------------------------------------
     def _merge(self, stage: TransformStage, part: C.Partition,

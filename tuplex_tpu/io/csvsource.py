@@ -107,8 +107,13 @@ def detect_header(rows: list[list[str]], null_values: Sequence[str]) -> bool:
 
 def infer_column_types(rows: list[list[str]], k: int,
                        null_values: Sequence[str], threshold: float,
-                       ) -> list[T.Type]:
+                       ) -> tuple[list[T.Type], list[T.Type]]:
+    """(normal_types, general_types) per column — the normal case speculates
+    the majority type at the threshold; the general case is the supertype of
+    every sampled cell (reference: FileInputOperator.cc:228-232 keeps BOTH
+    row types; the general one feeds the compiled resolve path)."""
     types = []
+    general_types = []
     for ci in range(k):
         cells = [r[ci] for r in rows if len(r) == k]
         vals: list[Any] = []
@@ -124,11 +129,19 @@ def infer_column_types(rows: list[list[str]], k: int,
                 vals.append(c.lower() == "true")
             else:
                 vals.append(c)
-        nc, _, _ = T.normal_case_type(vals, threshold)
+        nc, gc, _ = T.normal_case_type(vals, threshold)
         if nc is T.UNKNOWN or nc is T.PYOBJECT:
             nc = T.STR
+        if gc is T.UNKNOWN:
+            gc = nc
+        # any mix the supertype can't name as a primitive decodes as the raw
+        # string — the cells ARE strings, downstream UDFs parse them
+        gb = gc.without_option() if gc.is_optional() else gc
+        if gb not in (T.I64, T.F64, T.BOOL, T.STR, T.NULL):
+            gc = T.option(T.STR) if gc.is_optional() else T.STR
         types.append(nc)
-    return types
+        general_types.append(gc)
+    return types, general_types
 
 
 class CSVStatistic:
@@ -169,12 +182,13 @@ class CSVStatistic:
             self.columns = [f"_{i}" for i in range(k)]
         threshold = options.get_float("tuplex.normalcaseThreshold", 0.9)
         max_rows = options.get_int("tuplex.csv.maxDetectionRows", 1000)
-        self.types = infer_column_types(body[:max_rows], k,
-                                        self.null_values, threshold)
+        self.types, self.general_types = infer_column_types(
+            body[:max_rows], k, self.null_values, threshold)
         if type_hints:
             for key, t in type_hints.items():
                 idx = key if isinstance(key, int) else self.columns.index(key)
                 self.types[idx] = t
+                self.general_types[idx] = t   # a hint overrides speculation
         self.sample_rows = body[:max_rows]
 
 
@@ -538,7 +552,9 @@ def make_csv_operator(options, pattern: str, columns=None, header=None,
                         null_values=null_values, columns=columns,
                         type_hints=type_hints)
     src = CSVSourceOperator(options, pattern, stat, files)
-    return L.DecodeOperator(src, _decoded_schema(stat), stat.null_values)
+    return L.DecodeOperator(src, _decoded_schema(stat), stat.null_values,
+                            general=T.row_of(stat.columns,
+                                             stat.general_types))
 
 
 def _decoded_schema(stat: CSVStatistic) -> T.RowType:

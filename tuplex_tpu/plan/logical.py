@@ -353,10 +353,17 @@ class DecodeOperator(LogicalOperator):
     general-case row type preserves un-specialized columns."""
 
     def __init__(self, parent: LogicalOperator, declared: T.RowType,
-                 null_values: Sequence[str]):
+                 null_values: Sequence[str],
+                 general: "Optional[T.RowType]" = None):
         super().__init__([parent])
         self.declared = declared
         self.null_values = tuple(null_values)
+        # general-case row type (supertype of the sample): the compiled
+        # middle tier decodes under THESE types so normal-case violations
+        # stay vectorized (reference: StageBuilder.cc:1145
+        # generateResolveCodePath over the general-case schema)
+        self.general = general if general is not None and \
+            general.name != declared.name else None
 
     def schema(self) -> T.RowType:
         return self.declared

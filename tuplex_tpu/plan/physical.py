@@ -74,15 +74,21 @@ class TransformStage:
         return h.hexdigest()[:16]
 
     # ------------------------------------------------------------------
-    def build_device_fn(self, input_schema: Optional[T.RowType] = None
-                        ) -> Callable:
+    def build_device_fn(self, input_schema: Optional[T.RowType] = None,
+                        general: bool = False) -> Callable:
         """The fused fast-path function: staged arrays -> output arrays +
         '#err' + '#keep'. Raises NotCompilable if any fused UDF can't compile
         (the backend then interprets every row).
 
         `input_schema` overrides the planned schema with the RUNTIME schema
         of the actual partitions (post-breaker/segment stages and projection-
-        pruned sources differ from sample speculation)."""
+        pruned sources differ from sample speculation).
+
+        `general=True` builds the COMPILED middle tier: the fused decode
+        types columns under the general-case (supertype) schema so normal-
+        case violations stay vectorized before any per-row python
+        (reference: StageBuilder.cc:1145 generateResolveCodePath;
+        ResolveTask.h:31-98 tries resolve_f before the interpreter)."""
         schema = input_schema if input_schema is not None else self.input_schema
         ops = [op for op in self.ops
                if not isinstance(op, (L.ResolveOperator, L.IgnoreOperator,
@@ -91,6 +97,10 @@ class TransformStage:
 
         if self.force_interpret:
             raise NotCompilable("stage segment forced to interpreter")
+        if general and not any(
+                isinstance(op, L.DecodeOperator) and op.general is not None
+                for op in ops):
+            raise NotCompilable("stage has no general-case decode")
 
         def fn(arrays: dict):
             b = arrays["#rowvalid"].shape[0]
@@ -101,7 +111,8 @@ class TransformStage:
 
             names = user_columns(schema)
             for op in ops:
-                row, keep, names = _emit_op(ctx, op, row, keep, names)
+                row, keep, names = _emit_op(ctx, op, row, keep, names,
+                                            general=general)
                 row, keep = _fusion_barrier(ctx, row, keep)
             outs, out_t = result_arrays(row, b)
             outs = dict(outs)
@@ -171,7 +182,7 @@ def runtime_output_columns(input_schema: T.RowType,
 
 
 def _emit_op(ctx: EmitCtx, op: L.LogicalOperator, row: CV, keep,
-             names: Optional[tuple]):
+             names: Optional[tuple], general: bool = False):
     em = Emitter(ctx, getattr(op, "udf", None).globals
                  if getattr(op, "udf", None) else {})
     frame = Frame(em, {})
@@ -229,11 +240,12 @@ def _emit_op(ctx: EmitCtx, op: L.LogicalOperator, row: CV, keep,
             return tuple_cv(row.elts, names=nm, valid=row.valid), keep, nm
         return row, keep, nm
     if isinstance(op, L.DecodeOperator):
-        return _emit_decode(ctx, frame, op, row, keep)
+        return _emit_decode(ctx, frame, op, row, keep, general=general)
     raise NotCompilable(f"operator {type(op).__name__} not fusable")
 
 
-def _emit_decode(ctx: EmitCtx, frame, op, row: CV, keep):
+def _emit_decode(ctx: EmitCtx, frame, op, row: CV, keep,
+                 general: bool = False):
     """Vectorized normal-case cell decode (reference:
     CSVParseRowGenerator.cc codegen'd parse; here: parse kernels + err codes).
     Parse failures raise BADPARSE_STRING_INPUT; unexpected nulls NULLERROR —
@@ -244,6 +256,8 @@ def _emit_decode(ctx: EmitCtx, frame, op, row: CV, keep):
 
     cells = row.elts if row.elts is not None else (row,)
     decl = op.declared
+    if general and op.general is not None:
+        decl = op.general
     elts = []
     for cv, t in zip(cells, decl.types):
         base = t.without_option() if t.is_optional() else t
@@ -411,7 +425,12 @@ def _apply_projection(stage: TransformStage) -> None:
         if isinstance(op, L.DecodeOperator) and op.parent is src:
             keep_idx = [src.stat.columns.index(c) for c in req]
             declared = T.row_of(req, [op.declared.types[i] for i in keep_idx])
-            pruned = L.DecodeOperator(src, declared, op.null_values)
+            general = None
+            if op.general is not None:
+                general = T.row_of(req,
+                                   [op.general.types[i] for i in keep_idx])
+            pruned = L.DecodeOperator(src, declared, op.null_values,
+                                      general=general)
             new_ops.append(pruned)
         elif isinstance(op, L.SelectColumnsOperator) and \
                 any(isinstance(c, int) for c in op.selected):
@@ -473,6 +492,8 @@ def _op_identity(op: L.LogicalOperator) -> str:
             h.update(repr(getattr(op, attr)).encode())
     if hasattr(op, "declared"):
         h.update(op.declared.name.encode())
+    if getattr(op, "general", None) is not None:
+        h.update(op.general.name.encode())
     return h.hexdigest()[:20]
 
 
