@@ -373,3 +373,88 @@ def test_hybrid_device_join_real_fallback_build_row(tmp_path):
         J._DeviceProbe._match_positions = orig
     assert got == [("a", 2, "L" * 60), ("b", 3, "r3"), ("c", 9, None)]
     assert calls["probe"] >= 1
+
+
+def test_scan_fold_conditional_accumulation(ctx):
+    # VERDICT r1 next#8: a NON-pattern aggregate UDF (conditional
+    # accumulation) must run on device via the scan fold
+    import tuplex_tpu.plan.aggregates as A
+
+    built = {"n": 0}
+    orig = A.ScanFold.try_build.__func__
+
+    def counting(cls, op, schema):
+        r = orig(cls, op, schema)
+        if r is not None:
+            built["n"] += 1
+        return r
+
+    A.ScanFold.try_build = classmethod(counting)
+    try:
+        data = [(float(i % 50) / 100, float(i % 7), i % 2 == 0)
+                for i in range(5000)]
+        res = (ctx.parallelize(data, columns=["disc", "price", "flag"])
+               .aggregate(lambda a, b: a + b,
+                          lambda a, x: a + x["price"] * x["disc"]
+                          if x["flag"] else a, 0.0)
+               .collect())
+    finally:
+        A.ScanFold.try_build = classmethod(orig)
+    want = sum(p * d for d, p, f in data if f)
+    assert abs(res[0] - want) < 1e-9 * max(1.0, abs(want))
+    assert built["n"] == 1
+
+
+def test_scan_fold_tuple_acc_with_branch(ctx):
+    data = list(range(1, 2001))
+    res = ctx.parallelize(data).aggregate(
+        lambda a, b: (a[0] + b[0], a[1] + b[1]),
+        lambda a, x: (a[0] + x, a[1] + 1) if x % 3 == 0 else a,
+        (0, 0)).collect()
+    want = (sum(x for x in data if x % 3 == 0),
+            sum(1 for x in data if x % 3 == 0))
+    assert res == [want]
+
+
+def test_scan_fold_with_dirty_rows(ctx):
+    # boxed rows fold via the interpreter and combine with the device partial
+    data = [1, 2, "x", 4, 5]
+    ds = ctx.parallelize(data).aggregate(
+        lambda a, b: a + b,
+        lambda a, x: a + x if x > 2 else a, 0)
+    got = ds.collect()
+    # "x" raises TypeError (str > int) and is counted; rest folds
+    assert got == [4 + 5]
+    assert ds.exception_counts() == {"TypeError": 1}
+
+
+def test_scan_fold_int_to_float_widening(ctx):
+    # accumulator type widens int -> float across iterations (fixpoint)
+    res = ctx.parallelize([1, 2, 3, 4]).aggregate(
+        lambda a, b: a + b, lambda a, x: a + x / 2, 0).collect()
+    assert res == [5.0]
+
+
+def test_scan_fold_nonzero_initial_counts_once(tmp_path):
+    # review r4: the initial value must seed exactly ONCE across partitions
+    # and widen int->float with it (not be silently replaced by zero)
+    import tuplex_tpu
+
+    c = tuplex_tpu.Context({"tuplex.partitionSize": "4KB"})  # many partitions
+    data = list(range(1, 1001))
+    res = c.parallelize(data).aggregate(
+        lambda a, b: a + b, lambda a, x: a + x / 2, 100).collect()
+    assert res == [100 + sum(data) / 2]
+
+
+def test_scan_fold_optional_acc_stays_on_interpreter(ctx):
+    # review r4: a None-able accumulator can't ride the scan carry yet —
+    # exactness requires the interpreter (None + x raises TypeError)
+    data = [1, -5, 2]
+    ds = ctx.parallelize(data).aggregate(
+        lambda a, b: a + b,
+        lambda a, x: None if x < 0 else a + x, 0)
+    got = ds.collect()
+    # python: after -5 acc=None; then None+2 raises -> row 2 recorded, acc None
+    assert got == [None]
+    assert ds.exception_counts() == {"TypeError": 1}

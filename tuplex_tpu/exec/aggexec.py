@@ -123,7 +123,14 @@ class AggregateExecutor:
                 return [], excs
             return [C.build_partition(values, out_schema)], excs
 
-        # whole-dataset aggregate
+        # whole-dataset aggregate: pattern folds vectorize; everything else
+        # tries the compiled sequential scan fold before per-row python
+        scan = None
+        if spec is None and ps is not None and not getattr(
+                self.backend, "interpret_only", False):
+            scan = A.ScanFold.try_build(op, ps)
+        if scan is not None:
+            return self._scan_aggregate(op, scan, partitions, excs)
         acc_holder = {"acc": op.initial, "started": False}
 
         def merge_partial(partial):
@@ -163,6 +170,52 @@ class AggregateExecutor:
             final = py_acc
         schema = op.schema()
         return [C.build_partition([final], schema)], excs
+
+    # ------------------------------------------------------------------
+    def _scan_aggregate(self, op, scan, partitions, excs):
+        """Arbitrary aggregate UDF on device: lax.scan fold per partition
+        with the accumulator CHAINED partition-to-partition (the initial
+        value seeds exactly once, matching the interpreter tier); rows the
+        scan flags bad fold onto the running value via the interpreter
+        (reference: per-task agg_agg_f, AggregateFunctions.cc:16-178)."""
+        import jax
+        import numpy as np
+
+        acc_val = op.initial
+
+        def fold_py(part, indices):
+            nonlocal acc_val
+            g = {(): acc_val}
+            self._python_fold(op, part, indices, g, [], excs, into_key=())
+            acc_val = g[()]
+
+        for part in partitions:
+            self.backend.mm.touch(part)
+            outs = None
+            if part.n_normal() > 0:
+                try:
+                    fn = self.backend.jit_cache.get_or_build(
+                        ("scanfold", op.id, part.schema.name),
+                        lambda: jax.jit(scan.build_fn()))
+                    batch = C.stage_partition(part, self.backend.bucket_mode)
+                    acc_in = scan.encode_acc(acc_val)
+                    outs = jax.device_get(fn(batch.arrays, acc_in))
+                except Exception as e:
+                    from ..utils.logging import get_logger
+
+                    get_logger("exec").warning(
+                        "scan fold failed (%s: %s); partition folds on the "
+                        "interpreter", type(e).__name__, e)
+            if outs is None:
+                fold_py(part, range(part.num_rows))
+                continue
+            *acc_leaves, bads = outs
+            acc_val = scan.decode_acc(acc_leaves)
+            bad_idx = np.nonzero(np.asarray(bads)[:part.num_rows])[0]
+            if len(bad_idx):
+                fold_py(part, bad_idx.tolist())
+        schema = op.schema()
+        return [C.build_partition([acc_val], schema)], excs
 
     # ------------------------------------------------------------------
     def _python_fold(self, op, part, indices, groups, kidx, excs,

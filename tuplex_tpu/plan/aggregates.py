@@ -231,3 +231,201 @@ def recognize_fold(udf) -> Optional[FoldSpec]:
     if m is None:
         return None
     return FoldSpec([m[0]], [m[1]], row_p, acc_p, udf.globals, True)
+
+
+# ---------------------------------------------------------------------------
+# general aggregate-UDF compilation: sequential device fold via lax.scan
+# (reference: AggregateFunctions.cc:16-178 codegens agg_agg_f for ANY
+# aggregate UDF — the per-task fold is sequential there too; parallelism
+# comes from combining per-task partials, which we keep via combine())
+# ---------------------------------------------------------------------------
+
+def _acc_value_cv(t: T.Type, v):
+    """[1]-batch CV for an accumulator python value under type t."""
+    from ..compiler.values import CV, dtype_for, tuple_cv
+    from ..core.errors import NotCompilable
+    from ..runtime.jaxcfg import jnp
+
+    if t.is_optional():
+        # a None-able accumulator needs validity threaded through the scan
+        # carry; until then the interpreter keeps exact semantics
+        raise NotCompilable("Option accumulator not device-foldable")
+    base = t
+    if isinstance(base, T.TupleType):
+        if not isinstance(v, tuple) or len(v) != len(base.elements):
+            raise NotCompilable("aggregate initial/type mismatch")
+        return tuple_cv([_acc_value_cv(e, vv)
+                         for e, vv in zip(base.elements, v)])
+    if base in (T.I64, T.F64, T.BOOL):
+        return CV(t=base, data=jnp.full(1, v, dtype=dtype_for(base)))
+    raise NotCompilable(f"aggregate accumulator type {t} not device-foldable")
+
+
+def _zero_of(t: T.Type):
+    base = t.without_option() if t.is_optional() else t
+    if isinstance(base, T.TupleType):
+        return tuple(_zero_of(e) for e in base.elements)
+    if base is T.BOOL:
+        return False
+    if base is T.F64:
+        return 0.0
+    return 0
+
+
+def _coerce_cv(cv, t: T.Type):
+    """Cast a traced CV to the stable accumulator type (numeric widening
+    only); structure mismatches are NotCompilable."""
+    from ..compiler.values import CV, dtype_for, materialize, tuple_cv
+    from ..core.errors import NotCompilable
+
+    if cv.is_const:
+        cv = materialize(cv, 1)
+    base = t.without_option() if t.is_optional() else t
+    if isinstance(base, T.TupleType):
+        if cv.elts is None or len(cv.elts) != len(base.elements):
+            raise NotCompilable("aggregate result arity changed")
+        return tuple_cv([_coerce_cv(e, et)
+                         for e, et in zip(cv.elts, base.elements)])
+    if base in (T.I64, T.F64, T.BOOL) and cv.data is not None:
+        return CV(t=base, data=cv.data.astype(dtype_for(base)))
+    raise NotCompilable(f"aggregate result type {cv.t} != {t}")
+
+
+def _dummy_row_arrays(schema: T.RowType):
+    """[1]-batch zero arrays for a row schema (type-fixpoint tracing)."""
+    import numpy as np
+
+    from ..runtime import columns as C
+    from ..runtime.jaxcfg import jnp
+
+    arrays = {"#rowvalid": jnp.ones(1, dtype=bool)}
+    for ci, ct in enumerate(schema.types):
+        for path, lt in C.flatten_type(ct, str(ci)):
+            base = lt.without_option() if lt.is_optional() else lt
+            opt = lt.is_optional()
+            if path.endswith("#opt"):
+                arrays[path] = jnp.ones(1, dtype=bool)
+                continue
+            if base is T.STR:
+                arrays[path + "#bytes"] = jnp.zeros((1, 8), dtype=jnp.uint8)
+                arrays[path + "#len"] = jnp.zeros(1, dtype=jnp.int32)
+            elif base is T.BOOL:
+                arrays[path] = jnp.zeros(1, dtype=bool)
+            elif base is T.F64:
+                arrays[path] = jnp.zeros(1, dtype=jnp.float64)
+            else:
+                arrays[path] = jnp.zeros(1, dtype=jnp.int64)
+            if opt and not path.endswith("#opt"):
+                arrays[path + "#valid"] = jnp.ones(1, dtype=bool)
+    return arrays
+
+
+class ScanFold:
+    """Compiled general aggregate: one lax.scan over the batch whose body is
+    the emitter-traced aggregate(acc, row) UDF. Rows that err (or are boxed)
+    keep the accumulator unchanged and report in the bad mask — the host
+    folds them on the interpreter, preserving exact semantics."""
+
+    def __init__(self, op, row_schema: T.RowType, acc_t: T.Type):
+        self.op = op
+        self.row_schema = row_schema
+        self.acc_t = acc_t
+
+    @classmethod
+    def try_build(cls, op, row_schema: T.RowType) -> "Optional[ScanFold]":
+        from ..compiler.emitter import EmitCtx, Emitter
+        from ..compiler.stagefn import input_row_cv
+        from ..core.errors import NotCompilable
+
+        udf = op.aggregate_udf
+        if udf.tree is None or len(udf.params) != 2:
+            return None
+        acc_t = T.infer_type(op.initial)
+        try:
+            arrays = _dummy_row_arrays(row_schema)
+            for _ in range(3):
+                ctx = EmitCtx(1, arrays["#rowvalid"])
+                em = Emitter(ctx, udf.globals)
+                try:
+                    acc_cv = _acc_value_cv(acc_t, op.initial)
+                except (NotCompilable, TypeError, ValueError):
+                    acc_cv = _acc_value_cv(acc_t, _zero_of(acc_t))
+                row_cv = input_row_cv(arrays, row_schema)
+                res = em.eval_udf(udf, [acc_cv, row_cv])
+                res_t = res.t if not res.is_const else T.infer_type(res.const)
+                if res_t.name == acc_t.name:
+                    return cls(op, row_schema, acc_t)
+                acc_t = T.super_type(acc_t, res_t)
+                _acc_value_cv(acc_t, _zero_of(acc_t))  # still foldable?
+        except NotCompilable:
+            return None
+        except Exception:
+            return None
+        return None   # accumulator type never stabilized
+
+    def build_fn(self):
+        """jit-able: (arrays[B], acc_leaves_in) -> (acc_leaf_0[1], ...,
+        bad[B]). The accumulator CHAINS across calls — the caller seeds the
+        first partition with op.initial and every later one with the running
+        value, so the initial counts exactly once (matching the pattern and
+        interpreter tiers)."""
+        from ..compiler.emitter import EmitCtx, Emitter
+        from ..compiler.stagefn import input_row_cv
+        from ..compiler.values import cv_arrays, cv_rebuild
+        from ..runtime.jaxcfg import jnp, lax
+
+        op = self.op
+        schema = self.row_schema
+        acc_t = self.acc_t
+        template = _acc_value_cv(acc_t, _zero_of(acc_t))
+
+        def fn(arrays, acc_in):
+            def step(carry, x):
+                arrays1 = {k: v[None] for k, v in x.items()}
+                ctx = EmitCtx(1, arrays1["#rowvalid"])
+                em = Emitter(ctx, op.aggregate_udf.globals)
+                acc_cv = cv_rebuild(template, iter(carry))
+                row_cv = input_row_cv(arrays1, schema)
+                res = em.eval_udf(op.aggregate_udf, [acc_cv, row_cv])
+                res = _coerce_cv(res, acc_t)
+                new_leaves: list = []
+                cv_arrays(res, new_leaves)
+                bad = (ctx.err[0] != 0) | ~x["#rowvalid"]
+                out = tuple(jnp.where(bad, old, new)
+                            for old, new in zip(carry, new_leaves))
+                return out, bad
+
+            final, bads = lax.scan(step, tuple(acc_in), arrays)
+            return final + (bads,)
+
+        return fn
+
+    def encode_acc(self, value) -> tuple:
+        """python accumulator value -> carry leaves (seeding a scan)."""
+        from ..compiler.values import cv_arrays
+
+        cv = _acc_value_cv(self.acc_t, value)
+        leaves: list = []
+        cv_arrays(cv, leaves)
+        return tuple(leaves)
+
+    def decode_acc(self, leaves) -> Any:
+        """Final accumulator leaves -> python value."""
+        import numpy as np
+
+        from ..compiler.values import cv_rebuild
+
+        template = _acc_value_cv(self.acc_t, _zero_of(self.acc_t))
+        cv = cv_rebuild(template, iter([np.asarray(x) for x in leaves]))
+
+        def unbox(c):
+            if c.elts is not None:
+                return tuple(unbox(e) for e in c.elts)
+            v = np.asarray(c.data)[0]
+            if c.t is T.BOOL:
+                return bool(v)
+            if c.t is T.F64:
+                return float(v)
+            return int(v)
+
+        return unbox(cv)
