@@ -583,3 +583,77 @@ def test_sum_of_strings_matches_python_typeerror():
     # python: sum(..., "") raises TypeError; route to interpreter for parity
     with pytest.raises(NotCompilable):
         run_compiled(lambda s: sum((s, s), ""), ["ab", "cd"])
+
+
+# --- compiled regex (reference: FunctionRegistry.h:71-205 re.search) -------
+
+def test_re_search_groups():
+    import re
+
+    def f(s):
+        m = re.search(r"^(\d+)-(\w+)$", s)
+        if m is None:
+            return "none"
+        return m.group(2) + ":" + m.group(1)
+
+    check(f, ["12-abc", "7-x", "nope", "-abc", "12-", "999-zz9"])
+
+
+def test_re_search_logs_pattern():
+    import re
+
+    from tuplex_tpu.models import logs as LG
+    import random
+
+    rng = random.Random(3)
+    lines = [LG.gen_logline(rng) for _ in range(60)]
+
+    def f(s):
+        d = LG.ParseWithRegex(s)
+        return (d["ip"], d["date"], d["method"], d["endpoint"],
+                d["protocol"], d["response_code"], d["content_size"])
+
+    check(f, lines)
+
+
+def test_re_match_implicit_anchor():
+    import re
+
+    def f(s):
+        m = re.match(r"(\w+) (\d+)", s)
+        return -1 if m is None else int(m.group(2))
+
+    check(f, ["ab 42", "x 7 tail", "nope", " 5"])
+
+
+def test_re_negated_class_and_dollar_newline():
+    import re
+
+    # review r5: [^x] semantics + $ matching before a trailing newline
+    def f(s):
+        m = re.search(r'^"([^"]*)" (\d+)$', s)
+        return -1 if m is None else int(m.group(2)) + len(m.group(1))
+
+    check(f, ['"abc" 12', '"a b" 7', '"x" 5\n', 'no', '"" 3'])
+
+    def g(s):
+        m = re.search(r"^[^0]\d$", s)
+        return m is not None
+
+    check(g, ["12", "05", "99", "5", "x7"])
+
+
+def test_re_non_ascii_rows_fall_back():
+    import re
+
+    def f(s):
+        m = re.search(r"^(.)-", s)
+        return "none" if m is None else m.group(1)
+
+    check(f, ["a-b", "é-x", "日-q", "xy"])
+
+
+def test_module_qualified_capwords_still_compiles():
+    import string
+
+    check(lambda s: string.capwords(s), ["hello world", "FOO bar", ""])

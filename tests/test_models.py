@@ -134,3 +134,31 @@ def test_flights_pipeline_device_join(tmp_path):
                     (flights.OUTPUT_COLS[ci], a, b)
             else:
                 assert a == b, (flights.OUTPUT_COLS[ci], a, b)
+
+
+def test_logs_regex_pipeline_compiles_on_device(ctx, tmp_path):
+    # VERDICT r1 next#7: the logs benchmark regex runs ON DEVICE now
+    import tuplex_tpu.exec.local as LB
+    from tuplex_tpu.models import logs
+
+    path = str(tmp_path / "access.log")
+    logs.generate_log(path, 400, seed=23)
+
+    interp_rows = {"n": 0}
+    orig = LB.C.decode_rows
+
+    def counting(part, indices):
+        out = orig(part, indices)
+        interp_rows["n"] += len(out)
+        return out
+
+    LB.C.decode_rows = counting
+    try:
+        ds = logs.build_pipeline(ctx.text(path), mode="regex")
+        got = ds.collect()
+    finally:
+        LB.C.decode_rows = orig
+    want = logs.run_reference_python(path, mode="regex")
+    assert got == want
+    # only the ~3% ambiguous/malformed lines may touch the interpreter
+    assert interp_rows["n"] < 40, interp_rows

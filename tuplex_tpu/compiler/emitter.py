@@ -664,6 +664,14 @@ class Frame:
                 recv = self.eval(node.func.value)
             except NotCompilable:
                 recv = None
+            if recv is not None and recv.kind == "match":
+                args = [self.eval(a) for a in node.args]
+                return self._match_method(recv, node.func.attr, args)
+            if recv is not None and recv.is_const and \
+                    getattr(recv.const, "__name__", None) == "re" and \
+                    node.func.attr in ("search", "match"):
+                args = [self.eval(a) for a in node.args]
+                return self._re_search(node.func.attr, args)
             if recv is not None and recv.base is T.STR:
                 args = [self.eval(a) for a in node.args]
                 return self._str_method(recv, node.func.attr, args)
@@ -672,6 +680,18 @@ class Frame:
                 fn = getattr(recv.const, node.func.attr, None)
                 if fn is not None:
                     args = [self.eval(a) for a in node.args]
+                    import types as _types
+
+                    if isinstance(fn, _types.FunctionType) and \
+                            getattr(fn, "__module__", "") != "math":
+                        # module-qualified user helper: inline like a bare
+                        # name (ClosureEnvironment semantics); stdlib
+                        # functions our registry covers (string.capwords)
+                        # fall through to their device kernels
+                        try:
+                            return self.em.inline_call(fn, args)
+                        except NotCompilable:
+                            pass
                     return self._module_fn(fn, args)
             raise NotCompilable(f"method {node.func.attr}")
         if not isinstance(node.func, ast.Name):
@@ -693,6 +713,60 @@ class Frame:
         if builtin is not None:
             return builtin(args)
         raise NotCompilable(f"call to {name}")
+
+    def _re_search(self, fname: str, args: list[CV]) -> CV:
+        """Compiled re.search/re.match over a string column (reference:
+        FunctionRegistry.h:71-205 codegens re.search; here the pattern
+        compiles to whole-column kernel steps — ops/regex.py). Rows whose
+        match needs deeper backtracking than the compiled engine explores
+        raise PYTHON_FALLBACK and resolve exactly on the interpreter."""
+        from ..ops.regex import compile_regex
+
+        if len(args) != 2:
+            raise NotCompilable("re.search arity")
+        pat, s = args
+        if not (pat.is_const and isinstance(pat.const, str)):
+            raise NotCompilable("dynamic regex pattern")
+        pattern = pat.const
+        if fname == "match" and not pattern.startswith("^"):
+            pattern = "^" + pattern   # re.match anchors implicitly
+        rx = compile_regex(pattern)   # NotCompilable outside the subset
+        if s.base is not T.STR:
+            raise NotCompilable("re.search over non-string")
+        if s.valid is not None:
+            # python: re.search(p, None) raises TypeError
+            self.raise_where(~s.valid, ExceptionCode.TYPEERROR)
+        if any(ord(c) > 127 for c in pattern):
+            raise NotCompilable("non-ASCII regex pattern")
+        # byte-space matching diverges from codepoint semantics on
+        # multibyte rows: route them to the interpreter
+        s = materialize(s, self.ctx.b)
+        self._ascii_guard(s.sbytes, s.slen)
+        sb, sl = s.sbytes, s.slen
+        matched, suspect, gs, ge = rx.match(sb, sl)
+        self.raise_where(suspect & ~matched, ExceptionCode.PYTHON_FALLBACK)
+        elts = []
+        for g in range(rx.n_groups + 1):
+            bb, bl = S.slice_(sb, sl, gs[g], ge[g])
+            elts.append(CV(t=T.STR, sbytes=bb, slen=bl))
+        return CV(t=T.option(T.tuple_of(*[T.STR] * (rx.n_groups + 1))),
+                  elts=tuple(elts), valid=matched, kind="match")
+
+    def _match_method(self, m: CV, attr: str, args: list[CV]) -> CV:
+        if attr != "group":
+            raise NotCompilable(f"match.{attr}")
+        if len(args) == 0:
+            idx = 0
+        elif len(args) == 1 and args[0].is_const and \
+                isinstance(args[0].const, int):
+            idx = args[0].const
+        else:
+            raise NotCompilable("match.group with non-constant index")
+        if not 0 <= idx < len(m.elts):
+            raise NotCompilable(f"no such regex group {idx}")
+        # match is None -> .group raises AttributeError (python semantics)
+        self.raise_where(~m.valid, ExceptionCode.ATTRIBUTEERROR)
+        return m.elts[idx]
 
     def eval_JoinedStr(self, node: ast.JoinedStr) -> CV:
         parts: list[CV] = []

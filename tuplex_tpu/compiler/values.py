@@ -36,6 +36,7 @@ class CV:
     elts: Optional[tuple] = None          # tuple elements (CVs)
     names: Optional[tuple] = None         # field names for row-tuples
     const: Any = _MISSING       # compile-time constant
+    kind: Optional[str] = None  # special object marker ("match" = re result)
 
     # -- predicates ----------------------------------------------------------
     @property
