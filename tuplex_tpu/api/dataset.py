@@ -131,16 +131,34 @@ class DataSet:
                 print(repr(r))
 
     def tocsv(self, path: str, **kwargs) -> None:
-        from ..io.csvsink import write_csv
+        """Stream results to CSV from columnar buffers — normal-case rows
+        never box into python tuples (reference: buildWithCSVRowWriter,
+        PipelineBuilder.h:238; round 1 collected the whole dataset first)."""
+        from ..io.csvsink import write_partitions_csv
 
-        rows = self.collect()
-        write_csv(path, rows, self.columns)
+        partitions = self._execute_partitions(limit=-1)
+        write_partitions_csv(path, partitions, self.columns,
+                             backend=self._context.backend,
+                             **kwargs)
+        self._finish_file_job(partitions)
 
     def toorc(self, path: str, **kwargs) -> None:
-        from ..io.orcsource import write_orc
+        from ..io.orcsource import write_partitions_orc
 
-        rows = self.collect()
-        write_orc(path, rows, self.columns)
+        partitions = self._execute_partitions(limit=-1)
+        write_partitions_orc(path, partitions, self.columns,
+                             backend=self._context.backend)
+        self._finish_file_job(partitions)
+
+    def _finish_file_job(self, partitions) -> None:
+        import time as _time
+
+        counts: dict[str, int] = {}
+        for rec in self._last_exceptions:
+            counts[rec.exc_name] = counts.get(rec.exc_name, 0) + 1
+        self._context.recorder.job_done(
+            sum(p.num_rows for p in partitions),
+            _time.perf_counter() - self._t_job, counts)
 
     def exception_counts(self) -> dict[str, int]:
         """Counts of unresolved exceptions from the LAST action on this
@@ -151,12 +169,14 @@ class DataSet:
         return counts
 
     # ------------------------------------------------------------------
-    def _execute(self, limit: int):
+    def _execute_partitions(self, limit: int) -> list:
+        """Run the plan and return the OUTPUT PARTITIONS (columnar). The
+        sinks (tocsv/toorc) stream from these without boxing."""
         import time as _time
 
         from ..utils.signals import capture_sigint, check_interrupted
 
-        t_job = _time.perf_counter()
+        self._t_job = _time.perf_counter()
         sink = L.TakeOperator(self._op, limit) if limit >= 0 else self._op
         stages = plan_stages(sink, self._context.options_store)
         backend = self._context.backend
@@ -188,18 +208,25 @@ class DataSet:
         finally:
             # interrupted jobs must not leave stale per-action state
             self._last_exceptions = all_exceptions
+        return partitions or []
+
+    def _execute(self, limit: int):
+        import time as _time
+
         from ..runtime.columns import partition_to_pylist
 
+        partitions = self._execute_partitions(limit)
         out = []
-        for p in partitions or []:
+        for p in partitions:
             self._context.backend.touch_partition(p)
             out.extend(partition_to_pylist(p))
         if limit >= 0:
             out = out[:limit]
         counts = {}
-        for rec in all_exceptions:
+        for rec in self._last_exceptions:
             counts[rec.exc_name] = counts.get(rec.exc_name, 0) + 1
-        recorder.job_done(len(out), _time.perf_counter() - t_job, counts)
+        self._context.recorder.job_done(
+            len(out), _time.perf_counter() - self._t_job, counts)
         return out
 
 

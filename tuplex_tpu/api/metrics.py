@@ -1,5 +1,6 @@
 """Job metrics (reference: core/include/JobMetrics.h:23-70 — compile/sample
-times, fast/slow path wall time; exposed via python/tuplex/metrics.py)."""
+times, fast/slow path wall time, per-row ns; exposed via
+python/tuplex/metrics.py and logged per stage at LocalBackend.cc:932-949)."""
 
 from __future__ import annotations
 
@@ -11,6 +12,7 @@ class Metrics:
     def record_stage(self, m: dict) -> None:
         self.stages.append(dict(m))
 
+    # -- totals (JobMetrics getters) ----------------------------------------
     @property
     def totalExceptionCount(self) -> int:
         return sum(int(m.get("exception_rows", 0)) for m in self.stages)
@@ -21,15 +23,52 @@ class Metrics:
     def slowPathWallTime(self) -> float:
         return sum(float(m.get("slow_path_s", 0.0)) for m in self.stages)
 
+    def generalPathWallTime(self) -> float:
+        """Compiled general-case (resolve) tier wall time."""
+        return sum(float(m.get("general_path_s", 0.0)) for m in self.stages)
+
     def totalWallTime(self) -> float:
         return sum(float(m.get("wall_s", 0.0)) for m in self.stages)
 
+    def totalRowsOut(self) -> int:
+        return sum(int(m.get("rows_out", 0)) for m in self.stages)
+
+    def swapOutCount(self) -> int:
+        return sum(int(m.get("swap_out", 0)) for m in self.stages)
+
+    def swapInCount(self) -> int:
+        return sum(int(m.get("swap_in", 0)) for m in self.stages)
+
+    def swappedBytes(self) -> int:
+        return sum(int(m.get("swapped_bytes", 0)) for m in self.stages)
+
+    # -- per-stage breakdown (JobMetrics.h ns/row discipline) ---------------
+    def stage_breakdown(self) -> list[dict]:
+        out = []
+        for i, m in enumerate(self.stages):
+            rows = int(m.get("rows_out", 0))
+            wall = float(m.get("wall_s", 0.0))
+            out.append({
+                "stage": i,
+                "wall_s": wall,
+                "fast_path_s": float(m.get("fast_path_s", 0.0)),
+                "general_path_s": float(m.get("general_path_s", 0.0)),
+                "slow_path_s": float(m.get("slow_path_s", 0.0)),
+                "rows_out": rows,
+                "ns_per_row": (wall / rows * 1e9) if rows else 0.0,
+                "exception_rows": int(m.get("exception_rows", 0)),
+            })
+        return out
+
     def as_dict(self) -> dict:
         return {
-            "stages": list(self.stages),
+            "stages": self.stage_breakdown(),
             "fast_path_s": self.fastPathWallTime(),
+            "general_path_s": self.generalPathWallTime(),
             "slow_path_s": self.slowPathWallTime(),
             "wall_s": self.totalWallTime(),
+            "rows_out": self.totalRowsOut(),
+            "exception_rows": self.totalExceptionCount,
         }
 
     def as_json(self) -> str:

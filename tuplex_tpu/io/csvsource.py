@@ -275,7 +275,7 @@ class CSVSourceOperator(L.LogicalOperator):
             parse_opts = pacsv.ParseOptions(
                 delimiter=stat.delimiter,
                 invalid_row_handler=on_invalid)
-            with pacsv.open_csv(path, read_options=read_opts,
+            with pacsv.open_csv(_csv_input(path), read_options=read_opts,
                                 parse_options=parse_opts,
                                 convert_options=conv_opts) as reader:
                 for batch in reader:
@@ -326,7 +326,7 @@ class CSVSourceOperator(L.LogicalOperator):
         out_columns = list(projection) if projection else stat.columns
         raw_schema = T.row_of(out_columns,
                               [T.option(T.STR)] * len(out_columns))
-        table = pacsv.read_csv(path, read_options=read_opts,
+        table = pacsv.read_csv(_csv_input(path), read_options=read_opts,
                                parse_options=parse_opts,
                                convert_options=conv_opts)
 
@@ -440,6 +440,14 @@ def _spliced_partitions(table, scanned: list, raw_schema: T.RowType,
             gp.fallback = fb
             yield gp
         start += m
+
+
+def _csv_input(path: str):
+    """Path for local files, a file-like from the VFS for remote URIs —
+    pyarrow.csv accepts both."""
+    if VirtualFileSystem._scheme(path) == "file":
+        return path
+    return VirtualFileSystem.open_read(path)
 
 
 def _csv_rows_per_partition(context, table) -> int:

@@ -178,3 +178,52 @@ def write_orc(path: str, rows: list, columns: Optional[Sequence[str]] = None
         name = columns[0] if columns else "_0"
         table = pa.table({name: rows})
     paorc.write_table(table, path)
+
+
+def write_partitions_orc(path: str, partitions: list,
+                         columns: Optional[Sequence[str]] = None,
+                         backend=None) -> None:
+    """Stream partitions to ORC from columnar buffers (no boxing for
+    normal-case rows); boxed/nested partitions fall back to write_orc."""
+    import os
+
+    import pyarrow as pa
+    import pyarrow.orc as paorc
+
+    from ..runtime import columns as C
+    from .csvsink import _leaf_to_arrow
+
+    if path.endswith("/") or os.path.isdir(path):
+        os.makedirs(path, exist_ok=True)
+        path = os.path.join(path, "part0.orc")
+    tables = []
+    boxed_rows: list = []
+    names = None
+    for part in partitions:
+        if backend is not None:
+            backend.mm.touch(part)
+        if part.num_rows == 0:
+            continue
+        cols = columns or part.user_columns or \
+            [f"_{i}" for i in range(len(part.schema.types))]
+        names = names or [str(c) for c in cols]
+        arrays = None
+        if not part.fallback:
+            arrays = [_leaf_to_arrow(part, ci, ct)
+                      for ci, ct in enumerate(part.schema.types)]
+            if any(a is None for a in arrays):
+                arrays = None
+        if arrays is None:
+            boxed_rows.extend(C.partition_to_pylist(part))
+            continue
+        tables.append(pa.table(dict(zip(names, arrays))))
+    if boxed_rows or not tables:
+        rows = []
+        for part in partitions:
+            if backend is not None:
+                backend.mm.touch(part)   # earlier touches may have spilled it
+            rows.extend(C.partition_to_pylist(part))
+        write_orc(path, rows, columns)
+        return
+    paorc.write_table(pa.concat_tables(tables, promote_options="default"),
+                      path)

@@ -1,20 +1,26 @@
 """Virtual filesystem with URI-scheme dispatch.
 
 Re-designs the reference's VirtualFileSystem (reference:
-io/include/VirtualFileSystem.h — posix + S3 impls selected by URI prefix).
-S3/GCS backends are gated on their SDKs being importable; local posix always
-works. Zero-egress environments simply never exercise the remote schemes.
+io/include/VirtualFileSystem.h + io/src/S3FileSystemImpl.cc — posix + S3
+impls selected by URI prefix). Remote backends register per scheme:
+S3 (boto3) and GCS (google-cloud-storage) construct lazily when their SDK
+imports; tests (and zero-egress environments) can register any object that
+implements the small backend protocol — see MemoryObjectStore.
 """
 
 from __future__ import annotations
 
+
 import glob as _glob
+import io as _io
 import os
 import shutil
 from typing import Optional
 
 
 class VirtualFileSystem:
+    _backends: dict[str, object] = {}
+
     @staticmethod
     def _scheme(uri: str) -> str:
         if "://" in uri:
@@ -25,6 +31,28 @@ class VirtualFileSystem:
     def _strip(uri: str) -> str:
         return uri.split("://", 1)[1] if "://" in uri else uri
 
+    # -- backend registry ----------------------------------------------------
+    @classmethod
+    def register_backend(cls, scheme: str, backend) -> None:
+        """Install (or override) the backend for a URI scheme. Backends
+        implement: ls(pattern)->list[str], open_read(uri)->file-like,
+        open_write(uri)->file-like, file_size(uri)->int, rm(uri)->None."""
+        cls._backends[scheme] = backend
+
+    @classmethod
+    def _remote(cls, scheme: str):
+        b = cls._backends.get(scheme)
+        if b is None:
+            b = _default_backend(scheme)
+            if b is None:
+                raise ValueError(
+                    f"{scheme}:// needs its cloud SDK (boto3 / "
+                    f"google-cloud-storage), which is not importable here; "
+                    f"register_backend() a custom store or stage files "
+                    f"locally")
+            cls._backends[scheme] = b
+        return b
+
     # ------------------------------------------------------------------
     @classmethod
     def ls(cls, pattern: str) -> list[str]:
@@ -34,9 +62,7 @@ class VirtualFileSystem:
             if os.path.isdir(p):
                 return sorted(os.path.join(p, f) for f in os.listdir(p))
             return sorted(_glob.glob(p))
-        if scheme in ("s3", "gs"):
-            return cls._remote(scheme).ls(pattern)
-        raise ValueError(f"unsupported scheme {scheme!r}")
+        return cls._remote(scheme).ls(pattern)
 
     @classmethod
     def glob_input(cls, pattern: str) -> list[str]:
@@ -67,30 +93,221 @@ class VirtualFileSystem:
         if cls._scheme(src) == "file" and cls._scheme(dst) == "file":
             shutil.copy(cls._strip(src), cls._strip(dst))
             return
-        raise ValueError("remote cp not available in this environment")
+        with cls.open_read(src) as r, cls.open_write(dst) as w:
+            shutil.copyfileobj(r, w)
 
     @classmethod
     def rm(cls, pattern: str) -> None:
-        for p in cls.ls(pattern):
-            if os.path.isdir(p):
-                shutil.rmtree(p)
-            else:
-                os.remove(p)
+        scheme = cls._scheme(pattern)
+        if scheme == "file":
+            for p in cls.ls(pattern):
+                if os.path.isdir(p):
+                    shutil.rmtree(p)
+                else:
+                    os.remove(p)
+            return
+        be = cls._remote(scheme)
+        for uri in be.ls(pattern):
+            be.rm(uri)
 
     @classmethod
     def open_read(cls, uri: str, mode: str = "rb"):
-        if cls._scheme(uri) == "file":
+        scheme = cls._scheme(uri)
+        if scheme == "file":
             return open(cls._strip(uri), mode)
-        raise ValueError(f"unsupported scheme for open: {uri}")
+        return cls._remote(scheme).open_read(uri)
+
+    @classmethod
+    def open_write(cls, uri: str, mode: str = "wb"):
+        scheme = cls._scheme(uri)
+        if scheme == "file":
+            parent = os.path.dirname(cls._strip(uri))
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            return open(cls._strip(uri), mode)
+        return cls._remote(scheme).open_write(uri)
 
     @classmethod
     def file_size(cls, uri: str) -> int:
-        if cls._scheme(uri) == "file":
+        scheme = cls._scheme(uri)
+        if scheme == "file":
             return os.path.getsize(cls._strip(uri))
-        raise ValueError(f"unsupported scheme: {uri}")
+        return cls._remote(scheme).file_size(uri)
 
-    @staticmethod
-    def _remote(scheme: str):
-        raise ValueError(
-            f"{scheme}:// requires a cloud SDK not present in this "
-            f"environment (zero-egress); stage files locally instead")
+
+# ---------------------------------------------------------------------------
+# remote backends
+# ---------------------------------------------------------------------------
+
+def _split_bucket_key(uri: str) -> tuple[str, str, str]:
+    scheme, rest = uri.split("://", 1)
+    bucket, _, key = rest.partition("/")
+    return scheme, bucket, key
+
+
+class S3Backend:
+    """boto3-backed object store (reference: io/src/S3FileSystemImpl.cc).
+    Constructed only when boto3 imports; network behavior is the SDK's."""
+
+    def __init__(self, client=None):
+        if client is None:
+            import boto3  # gated: raises ImportError without the SDK
+
+            client = boto3.client("s3")
+        self.client = client
+
+    def ls(self, pattern: str) -> list[str]:
+        scheme, bucket, key = _split_bucket_key(pattern)
+        prefix = key.split("*", 1)[0].split("?", 1)[0]
+        out = []
+        paginator = self.client.get_paginator("list_objects_v2")
+        for page in paginator.paginate(Bucket=bucket, Prefix=prefix):
+            for obj in page.get("Contents", []):
+                uri = f"{scheme}://{bucket}/{obj['Key']}"
+                if _uri_matches(uri, pattern):
+                    out.append(uri)
+        return sorted(out)
+
+    def open_read(self, uri: str):
+        _, bucket, key = _split_bucket_key(uri)
+        body = self.client.get_object(Bucket=bucket, Key=key)["Body"]
+        return _io.BytesIO(body.read())
+
+    def open_write(self, uri: str):
+        _, bucket, key = _split_bucket_key(uri)
+        return _ObjectWriteBuffer(
+            lambda data: self.client.put_object(Bucket=bucket, Key=key,
+                                                Body=data))
+
+    def file_size(self, uri: str) -> int:
+        _, bucket, key = _split_bucket_key(uri)
+        return self.client.head_object(Bucket=bucket,
+                                       Key=key)["ContentLength"]
+
+    def rm(self, uri: str) -> None:
+        _, bucket, key = _split_bucket_key(uri)
+        self.client.delete_object(Bucket=bucket, Key=key)
+
+
+class GCSBackend:
+    """google-cloud-storage-backed object store."""
+
+    def __init__(self, client=None):
+        if client is None:
+            from google.cloud import storage  # gated on the SDK
+
+            client = storage.Client()
+        self.client = client
+
+    def _blob(self, uri: str):
+        _, bucket, key = _split_bucket_key(uri)
+        return self.client.bucket(bucket).blob(key)
+
+    def ls(self, pattern: str) -> list[str]:
+        scheme, bucket, key = _split_bucket_key(pattern)
+        prefix = key.split("*", 1)[0].split("?", 1)[0]
+        out = []
+        for blob in self.client.list_blobs(bucket, prefix=prefix):
+            uri = f"{scheme}://{bucket}/{blob.name}"
+            if _uri_matches(uri, pattern):
+                out.append(uri)
+        return sorted(out)
+
+    def open_read(self, uri: str):
+        return _io.BytesIO(self._blob(uri).download_as_bytes())
+
+    def open_write(self, uri: str):
+        blob = self._blob(uri)
+        return _ObjectWriteBuffer(lambda data: blob.upload_from_string(data))
+
+    def file_size(self, uri: str) -> int:
+        blob = self._blob(uri)
+        blob.reload()
+        return int(blob.size)
+
+    def rm(self, uri: str) -> None:
+        self._blob(uri).delete()
+
+
+class MemoryObjectStore:
+    """In-memory fake object store implementing the backend protocol — the
+    test double for the remote schemes (reference tests their S3 impl only
+    against real AWS; a local fake keeps this path CI-testable)."""
+
+    def __init__(self):
+        self.objects: dict[str, bytes] = {}
+
+    def put(self, uri: str, data: bytes) -> None:
+        self.objects[uri] = data
+
+    def ls(self, pattern: str) -> list[str]:
+        if "*" not in pattern and "?" not in pattern:
+            if pattern in self.objects:
+                return [pattern]
+            prefix = pattern.rstrip("/") + "/"
+            return sorted(u for u in self.objects if u.startswith(prefix))
+        return sorted(u for u in self.objects if _uri_matches(u, pattern))
+
+    def open_read(self, uri: str):
+        if uri not in self.objects:
+            raise FileNotFoundError(uri)
+        return _io.BytesIO(self.objects[uri])
+
+    def open_write(self, uri: str):
+        return _ObjectWriteBuffer(lambda data: self.put(uri, data))
+
+    def file_size(self, uri: str) -> int:
+        return len(self.objects[uri])
+
+    def rm(self, uri: str) -> None:
+        self.objects.pop(uri, None)
+
+
+class _ObjectWriteBuffer(_io.BytesIO):
+    """Buffers writes and uploads the whole object on close (object stores
+    have no append)."""
+
+    def __init__(self, upload):
+        super().__init__()
+        self._upload = upload
+
+    def close(self):
+        if not self.closed:
+            self._upload(self.getvalue())
+        super().close()
+
+
+def _uri_matches(uri: str, pattern: str) -> bool:
+    if "*" not in pattern and "?" not in pattern:
+        return uri == pattern or uri.startswith(pattern.rstrip("/") + "/")
+    # glob semantics matching the local path: '*'/'?' do NOT cross '/'
+    # ('**' does) — fnmatch's '*' would silently pull in nested keys
+    import re as _re
+
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == "*":
+            if pattern[i:i + 2] == "**":
+                out.append(".*")
+                i += 2
+                continue
+            out.append("[^/]*")
+        elif c == "?":
+            out.append("[^/]")
+        else:
+            out.append(_re.escape(c))
+        i += 1
+    return _re.fullmatch("".join(out), uri) is not None
+
+
+def _default_backend(scheme: str):
+    try:
+        if scheme == "s3":
+            return S3Backend()
+        if scheme == "gs":
+            return GCSBackend()
+    except ImportError:
+        return None
+    return None

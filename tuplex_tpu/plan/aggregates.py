@@ -178,7 +178,9 @@ def recognize_fold(udf) -> Optional[FoldSpec]:
         params = [a.arg for a in tree.args.args]
     elif isinstance(tree, ast.FunctionDef):
         stmts = [s for s in tree.body
-                 if not isinstance(s, (ast.Expr,))]  # skip docstrings
+                 if not (isinstance(s, ast.Expr)
+                         and isinstance(s.value, ast.Constant)
+                         and isinstance(s.value.value, str))]  # docstrings
         if len(stmts) != 1 or not isinstance(stmts[0], ast.Return):
             return None
         body = stmts[0].value

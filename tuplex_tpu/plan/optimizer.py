@@ -149,6 +149,68 @@ def required_source_columns(source_columns: tuple[str, ...],
     return [c for c in source_columns if c in required]
 
 
+def split_filter_conjunctions(ops: list) -> list:
+    """FilterBreakdownVisitor analog (reference: FilterBreakdownVisitor.cc;
+    LogicalPlan.cc emitPartialFilters): a filter whose body is `a and b`
+    splits into SEQUENTIAL filters — order between the clauses is preserved
+    (short-circuit intact relative to each other), but each clause can now
+    hop over unrelated operators independently during pushdown."""
+    out: list = []
+    for i, op in enumerate(ops):
+        nxt = ops[i + 1] if i + 1 < len(ops) else None
+        if isinstance(op, L.FilterOperator) and not isinstance(
+                nxt, (L.ResolveOperator, L.IgnoreOperator)):
+            parts = _split_filter(op)
+            if parts is not None:
+                out.extend(parts)
+                continue
+        out.append(op)
+    return out
+
+
+def _split_filter(op) -> Optional[list]:
+    from ..utils.reflection import UDFSource
+
+    udf = op.udf
+    tree = udf.tree
+    if udf.source == "" or len(udf.params) != 1:
+        return None
+    if isinstance(tree, ast.Lambda):
+        body = tree.body
+    elif isinstance(tree, ast.FunctionDef):
+        # strip DOCSTRINGS only — a bare-call Expr has side effects that a
+        # split would silently drop
+        stmts = [s for s in tree.body
+                 if not (isinstance(s, ast.Expr)
+                         and isinstance(s.value, ast.Constant)
+                         and isinstance(s.value.value, str))]
+        if len(stmts) != 1 or not isinstance(stmts[0], ast.Return):
+            return None
+        body = stmts[0].value
+    else:
+        return None
+    if not isinstance(body, ast.BoolOp) or not isinstance(body.op, ast.And):
+        return None
+    # walrus bindings can flow between clauses: splitting unbinds them
+    if any(isinstance(n, ast.NamedExpr) for n in ast.walk(body)):
+        return None
+    p = udf.params[0]
+    filters: list = []
+    for k, clause in enumerate(body.values):
+        try:
+            src = f"lambda {p}: ({ast.unparse(clause)})"
+            fn = eval(compile(src, f"<filter-split-{udf.name}>", "eval"),
+                      dict(udf.globals))
+            sub_tree = ast.parse(src, mode="eval").body
+            fop = L.FilterOperator(op.parent, fn)
+        except Exception:
+            return None
+        fop.udf = UDFSource(fn, src, sub_tree, dict(udf.globals),
+                            f"{udf.name}#and{k}")
+        filters.append(fop)
+    return filters
+
+
 def filter_pushdown(ops: list) -> list:
     """Move filters ahead of operators whose outputs they don't read
     (reference: LogicalPlan.cc optimizeFilters — pushing filters toward the

@@ -384,10 +384,16 @@ def plan_stages(sink: L.LogicalOperator, options=None):
     # change the reference's tuplex.optimizer.filterPushdown makes)
     if options is None or options.get_bool(
             "tuplex.optimizer.filterPushdown", True):
-        from .optimizer import filter_pushdown
+        from .optimizer import filter_pushdown, split_filter_conjunctions
 
         for st in stages:
             if isinstance(st, TransformStage):
+                # conjunction breakdown first so each clause pushes down
+                # independently (reference: FilterBreakdownVisitor.cc +
+                # LogicalPlan.cc emitPartialFilters)
+                if options is None or options.get_bool(
+                        "tuplex.optimizer.filterBreakdown", True):
+                    st.ops = split_filter_conjunctions(st.ops)
                 st.ops = filter_pushdown(st.ops)
     # projection pushdown into file sources (reference: csv.selectionPushdown)
     for st in stages:
