@@ -458,3 +458,86 @@ def test_scan_fold_optional_acc_stays_on_interpreter(ctx):
     # python: after -5 acc=None; then None+2 raises -> row 2 recorded, acc None
     assert got == [None]
     assert ds.exception_counts() == {"TypeError": 1}
+
+
+def test_scan_fold_by_key_conditional(ctx):
+    # arbitrary aggregateByKey UDF (conditional accumulation) on device via
+    # the segmented scan fold
+    import tuplex_tpu.exec.aggexec as AE
+
+    calls = {"n": 0}
+    orig = AE.AggregateExecutor._scan_fold_bykey
+
+    def counting(self, *a, **kw):
+        r = orig(self, *a, **kw)
+        if r:
+            calls["n"] += 1
+        return r
+
+    AE.AggregateExecutor._scan_fold_bykey = counting
+    try:
+        data = [(i % 7, float(i), i % 3 == 0) for i in range(4000)]
+        ds = (ctx.parallelize(data, columns=["k", "v", "flag"])
+              .aggregateByKey(lambda a, b: a + b,
+                              lambda a, x: a + x["v"] if x["flag"] else a,
+                              0.0, ["k"]))
+        got = dict(ds.collect())
+    finally:
+        AE.AggregateExecutor._scan_fold_bykey = orig
+    want: dict = {}
+    for k, v, f in data:
+        if f:
+            want[k] = want.get(k, 0.0) + v
+        else:
+            want.setdefault(k, 0.0)
+    assert {k: round(v, 3) for k, v in got.items()} == \
+        {k: round(v, 3) for k, v in want.items()}
+    assert calls["n"] >= 1
+
+
+def test_scan_fold_by_key_cross_partition_chaining(tmp_path):
+    import tuplex_tpu
+
+    c = tuplex_tpu.Context({"tuplex.partitionSize": "4KB"})
+    data = [(i % 3, i) for i in range(3000)]
+    ds = c.parallelize(data, columns=["k", "v"]).aggregateByKey(
+        lambda a, b: a + b,
+        lambda a, x: a + x["v"] if x["v"] % 2 == 0 else a, 100, ["k"])
+    got = dict(ds.collect())
+    want: dict = {}
+    for k, v in data:
+        acc = want.get(k, 100)
+        want[k] = acc + v if v % 2 == 0 else acc
+    assert got == want
+
+
+def test_scan_fold_by_key_no_ghost_groups(ctx):
+    # review r7: a key whose every row errors must not emit (k, initial)
+    data = [(1, 2), (1, 4), (2, 0), (2, 0)]   # key 2: all rows divide by 0
+    ds = (ctx.parallelize(data, columns=["k", "v"])
+          .aggregateByKey(lambda a, b: a + b,
+                          lambda a, x: a + 10 // x["v"] if x["v"] != 99
+                          else a, 0, ["k"]))
+    got = dict(ds.collect())
+    assert got == {1: 7}, got
+    assert ds.exception_counts() == {"ZeroDivisionError": 2}
+
+
+def test_scan_fold_by_key_float_drift_falls_back(ctx):
+    # review r7: an interpreter-resolved float acc must not silently
+    # truncate into an int carry on the next partition
+    import tuplex_tpu
+
+    c = tuplex_tpu.Context({"tuplex.partitionSize": "4KB"})
+    # 3.5 is a boxed row (float in an i64-speculated column): it folds via
+    # the interpreter and turns key 0's accumulator into a FLOAT; later
+    # partitions must reject the drifted carry and stay exact
+    data = [(0, 3.5)] + [(0, i) for i in range(2000)] + \
+           [(1, i) for i in range(2000)]
+    ds = c.parallelize(data, columns=["k", "v"]).aggregateByKey(
+        lambda a, b: a + b, lambda a, x: a + x["v"] * 2, 0, ["k"])
+    got = dict(ds.collect())
+    want0 = 7.0 + 2 * sum(range(2000))
+    want1 = 2 * sum(range(2000))
+    assert got == {0: want0, 1: want1}, (got, {0: want0, 1: want1})
+    assert isinstance(got[0], float) and isinstance(got[1], int)

@@ -107,10 +107,17 @@ class AggregateExecutor:
         if by_key:
             kidx = [ps.columns.index(c) for c in op.key_columns] if ps else []
             groups: dict = {}
+            scan_k = None
+            if spec is None and ps is not None and not getattr(
+                    self.backend, "interpret_only", False):
+                scan_k = A.ScanFold.try_build(op, ps)
             for part in partitions:
                 self.backend.mm.touch(part)
                 device_ok = spec is not None and self._device_fold_bykey(
                     op, spec, part, kidx, groups, excs)
+                if not device_ok and scan_k is not None:
+                    device_ok = self._scan_fold_bykey(op, scan_k, part, kidx,
+                                                      groups, excs)
                 if not device_ok:
                     self._python_fold(op, part, range(part.num_rows),
                                       groups, kidx, excs)
@@ -216,6 +223,60 @@ class AggregateExecutor:
                 fold_py(part, bad_idx.tolist())
         schema = op.schema()
         return [C.build_partition([acc_val], schema)], excs
+
+    # ------------------------------------------------------------------
+    def _scan_fold_bykey(self, op, scan, part, kidx, groups, excs) -> bool:
+        """Arbitrary aggregateByKey UDF on device: segmented lax.scan fold —
+        per-key accumulator slots seeded from the running `groups` table so
+        cross-partition chaining (and the once-per-key initial) stays exact;
+        rows the scan flags bad fold via the interpreter afterward."""
+        import jax
+
+        real = _real_mask(part)
+        codes, uniq_rows = _factorize_keys(part, kidx, real)
+        if codes is None or len(uniq_rows) == 0:
+            return False
+        n = part.num_rows
+        nseg = len(uniq_rows)
+        nseg_b = C.bucket_size(nseg)
+        keys = []
+        for row in C.decode_rows(part, uniq_rows.tolist()):
+            keys.append(tuple(row.values[j] for j in kidx))
+        try:
+            seg_init = A._scanfold_encode_segments(
+                scan, [groups.get(k, op.initial) for k in keys], nseg_b)
+        except Exception:
+            return False   # an existing acc no longer conforms: python path
+        try:
+            fn = self.backend.jit_cache.get_or_build(
+                ("scanfoldseg", op.id, part.schema.name),
+                lambda: jax.jit(A._seg_build_fn(scan)))
+            batch = C.stage_partition(part, self.backend.bucket_mode)
+            b = batch.arrays["#rowvalid"].shape[0]
+            codes_b = np.full(b, nseg_b, dtype=np.int32)
+            codes_b[:n][real] = codes
+            outs = jax.device_get(fn(batch.arrays, codes_b, seg_init))
+        except Exception as e:
+            from ..utils.logging import get_logger
+
+            get_logger("exec").warning(
+                "segmented scan fold failed (%s: %s); partition folds on "
+                "the interpreter", type(e).__name__, e)
+            return False
+        *leaves, bads = outs
+        bads_n = np.asarray(bads)[:n]
+        # ghost-group guard (matches the mesh fold's counts check): a key
+        # whose rows ALL errored must not emit an initial-only output row
+        ok_codes = codes_b[:n][~bads_n]
+        seg_ok = np.bincount(ok_codes, minlength=nseg_b + 1)
+        vals = A._scanfold_decode_segments(scan, leaves, nseg)
+        for si, k in enumerate(keys):
+            if seg_ok[si] or k in groups:
+                groups[k] = vals[si]
+        bad_idx = np.nonzero(bads_n)[0].tolist()
+        if bad_idx:
+            self._python_fold(op, part, bad_idx, groups, kidx, excs)
+        return True
 
     # ------------------------------------------------------------------
     def _python_fold(self, op, part, indices, groups, kidx, excs,

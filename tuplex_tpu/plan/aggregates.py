@@ -23,6 +23,8 @@ from __future__ import annotations
 import ast
 from typing import Any, Callable, Optional, Sequence
 
+import numpy as np
+
 from ..core import typesys as T
 from ..core.errors import TuplexException
 from ..core.row import Row
@@ -259,8 +261,66 @@ def _acc_value_cv(t: T.Type, v):
         return tuple_cv([_acc_value_cv(e, vv)
                          for e, vv in zip(base.elements, v)])
     if base in (T.I64, T.F64, T.BOOL):
+        _check_acc_scalar(base, v)
         return CV(t=base, data=jnp.full(1, v, dtype=dtype_for(base)))
     raise NotCompilable(f"aggregate accumulator type {t} not device-foldable")
+
+
+def _check_acc_scalar(base: T.Type, v) -> None:
+    """Strict value/type conformance: jnp.full would TRUNCATE a float into
+    an int carry silently — a drifted accumulator must fall back to the
+    interpreter instead (review r7)."""
+    from ..core.errors import NotCompilable
+
+    if base is T.BOOL:
+        ok = isinstance(v, bool)
+    elif base is T.I64:
+        ok = isinstance(v, int) and not isinstance(v, bool)
+    else:   # F64 accepts int or float (exact widening)
+        ok = isinstance(v, (int, float)) and not isinstance(v, bool)
+    if not ok:
+        raise NotCompilable(f"accumulator value {v!r} does not conform "
+                            f"to {base}")
+
+
+def _acc_leaf_types(t: T.Type) -> list:
+    """Flattened leaf base types in cv_arrays order."""
+    base = t.without_option() if t.is_optional() else t
+    if isinstance(base, T.TupleType):
+        out: list = []
+        for e in base.elements:
+            out.extend(_acc_leaf_types(e))
+        return out
+    return [base]
+
+
+def _flatten_acc(v, t: T.Type) -> list:
+    """Python accumulator value -> validated scalar list (leaf order)."""
+    from ..core.errors import NotCompilable
+
+    base = t.without_option() if t.is_optional() else t
+    if isinstance(base, T.TupleType):
+        if not isinstance(v, tuple) or len(v) != len(base.elements):
+            raise NotCompilable("accumulator arity mismatch")
+        out: list = []
+        for e, vv in zip(base.elements, v):
+            out.extend(_flatten_acc(vv, e))
+        return out
+    _check_acc_scalar(base, v)
+    return [v]
+
+
+def _unflatten_acc(scalars: list, t: T.Type, pos: list) -> Any:
+    base = t.without_option() if t.is_optional() else t
+    if isinstance(base, T.TupleType):
+        return tuple(_unflatten_acc(scalars, e, pos) for e in base.elements)
+    v = scalars[pos[0]]
+    pos[0] += 1
+    if base is T.BOOL:
+        return bool(v)
+    if base is T.F64:
+        return float(v)
+    return int(v)
 
 
 def _zero_of(t: T.Type):
@@ -365,34 +425,38 @@ class ScanFold:
             return None
         return None   # accumulator type never stabilized
 
+    def _trace_row(self, carry_leaves, row_arrays):
+        """Shared one-row trace for both scan variants: rebuild the acc CV
+        from carry leaves, run the UDF on the [1]-lifted row, coerce the
+        result. Returns (new_leaves, bad_scalar)."""
+        from ..compiler.emitter import EmitCtx, Emitter
+        from ..compiler.stagefn import input_row_cv
+        from ..compiler.values import cv_arrays, cv_rebuild
+
+        template = _acc_value_cv(self.acc_t, _zero_of(self.acc_t))
+        arrays1 = {k: v[None] for k, v in row_arrays.items()}
+        ctx = EmitCtx(1, arrays1["#rowvalid"])
+        em = Emitter(ctx, self.op.aggregate_udf.globals)
+        acc_cv = cv_rebuild(template, iter(carry_leaves))
+        row_cv = input_row_cv(arrays1, self.row_schema)
+        res = em.eval_udf(self.op.aggregate_udf, [acc_cv, row_cv])
+        res = _coerce_cv(res, self.acc_t)
+        new_leaves: list = []
+        cv_arrays(res, new_leaves)
+        bad = (ctx.err[0] != 0) | ~row_arrays["#rowvalid"]
+        return new_leaves, bad
+
     def build_fn(self):
         """jit-able: (arrays[B], acc_leaves_in) -> (acc_leaf_0[1], ...,
         bad[B]). The accumulator CHAINS across calls — the caller seeds the
         first partition with op.initial and every later one with the running
         value, so the initial counts exactly once (matching the pattern and
         interpreter tiers)."""
-        from ..compiler.emitter import EmitCtx, Emitter
-        from ..compiler.stagefn import input_row_cv
-        from ..compiler.values import cv_arrays, cv_rebuild
         from ..runtime.jaxcfg import jnp, lax
-
-        op = self.op
-        schema = self.row_schema
-        acc_t = self.acc_t
-        template = _acc_value_cv(acc_t, _zero_of(acc_t))
 
         def fn(arrays, acc_in):
             def step(carry, x):
-                arrays1 = {k: v[None] for k, v in x.items()}
-                ctx = EmitCtx(1, arrays1["#rowvalid"])
-                em = Emitter(ctx, op.aggregate_udf.globals)
-                acc_cv = cv_rebuild(template, iter(carry))
-                row_cv = input_row_cv(arrays1, schema)
-                res = em.eval_udf(op.aggregate_udf, [acc_cv, row_cv])
-                res = _coerce_cv(res, acc_t)
-                new_leaves: list = []
-                cv_arrays(res, new_leaves)
-                bad = (ctx.err[0] != 0) | ~x["#rowvalid"]
+                new_leaves, bad = self._trace_row(carry, x)
                 out = tuple(jnp.where(bad, old, new)
                             for old, new in zip(carry, new_leaves))
                 return out, bad
@@ -431,3 +495,59 @@ class ScanFold:
             return int(v)
 
         return unbox(cv)
+
+
+# -- segmented scan fold (aggregateByKey with arbitrary UDFs) ---------------
+
+def _seg_build_fn(scan: "ScanFold"):
+    """(arrays[B], codes[B], seg_init leaves [nseg_b]) ->
+    (seg leaves..., bad[B]). Rows whose code falls outside [0, nseg) (boxed /
+    padding) are bad and leave the table untouched."""
+    from ..runtime.jaxcfg import jnp, lax
+
+    def fn(arrays, codes, seg_init):
+        nseg_b = seg_init[0].shape[0]
+
+        def step(carry, x):
+            code = x["code"]
+            cc = jnp.clip(code, 0, nseg_b - 1)
+            cur = tuple(c[cc][None] for c in carry)
+            new_leaves, bad = scan._trace_row(cur, x["a"])
+            bad = bad | (code < 0) | (code >= nseg_b)
+            out = tuple(
+                c.at[cc].set(jnp.where(bad, c[cc], nl[0]))
+                for c, nl in zip(carry, new_leaves))
+            return out, bad
+
+        final, bads = lax.scan(step, tuple(seg_init),
+                               {"a": arrays, "code": codes})
+        return final + (bads,)
+
+    return fn
+
+
+_ACC_NP_DTYPES = {T.BOOL: np.bool_, T.I64: np.int64, T.F64: np.float64}
+
+
+def _scanfold_encode_segments(scan: "ScanFold", values: list, nseg_b: int):
+    """One accumulator python value per segment -> stacked carry leaves,
+    zero-padded to nseg_b segments (pow2 bucket bounds retraces). Pure
+    numpy — one host array per leaf, no per-segment device dispatches."""
+    leaf_ts = _acc_leaf_types(scan.acc_t)
+    flat = [_flatten_acc(v, scan.acc_t) for v in values]   # validates types
+    cols = []
+    for li, lt in enumerate(leaf_ts):
+        col = np.zeros(nseg_b, dtype=_ACC_NP_DTYPES[lt])
+        col[:len(values)] = [fv[li] for fv in flat]
+        cols.append(col)
+    return tuple(cols)
+
+
+def _scanfold_decode_segments(scan: "ScanFold", leaves, nseg: int) -> list:
+    """Final segment table -> one python accumulator value per segment."""
+    cols = [np.asarray(x)[:nseg].tolist() for x in leaves]
+    out = []
+    for si in range(nseg):
+        pos = [0]
+        out.append(_unflatten_acc([c[si] for c in cols], scan.acc_t, pos))
+    return out
