@@ -162,3 +162,56 @@ def test_logs_regex_pipeline_compiles_on_device(ctx, tmp_path):
     assert got == want
     # only the ~3% ambiguous/malformed lines may touch the interpreter
     assert interp_rows["n"] < 40, interp_rows
+
+
+def test_tpch_q19(ctx, tmp_path):
+    part = str(tmp_path / "part.csv")
+    li = str(tmp_path / "lineitem19.csv")
+    tpch.generate_q19_csvs(part, li, n_parts=300, n_items=3000, seed=19)
+    got = tpch.q19(ctx, part, li).collect()[0]
+    want = tpch.q19_python(tpch.gen_part_rows(300, 19),
+                           tpch.gen_lineitem19_rows(3000, 300, 23))
+    assert abs(got - want) < 1e-6 * max(1.0, abs(want)), (got, want)
+
+
+def test_history_live_server(tmp_path):
+    import urllib.request
+
+    import tuplex_tpu
+
+    c = tuplex_tpu.Context({"tuplex.webui": "true",
+                            "tuplex.logDir": str(tmp_path)})
+    try:
+        c.parallelize([1, 2, 3]).map(lambda x: x + 1).collect()
+        url = c.uiWebURL()
+        body = urllib.request.urlopen(url, timeout=5).read().decode()
+        assert "tuplex_tpu job history" in body
+        assert 'http-equiv="refresh"' in body   # live view auto-refreshes
+    finally:
+        c.close()
+
+
+def test_failure_log_retry_and_degrade(ctx):
+    # a poisoned device path must degrade to the interpreter, not kill the
+    # job; both attempts land in the backend failure log
+    import tuplex_tpu.exec.local as LB
+
+    calls = {"n": 0}
+    orig = LB.LocalBackend._collect_partition
+
+    def poisoned(self, stage, part, outs, dispatch_s):
+        if outs is not None:
+            calls["n"] += 1
+            raise RuntimeError("injected device failure")
+        return orig(self, stage, part, outs, dispatch_s)
+
+    LB.LocalBackend._collect_partition = poisoned
+    try:
+        got = ctx.parallelize([1, 2, 3]).map(lambda x: x * 2).collect()
+    finally:
+        LB.LocalBackend._collect_partition = orig
+    assert got == [2, 4, 6]
+    assert calls["n"] == 2   # first + retry
+    fl = ctx.backend.failure_log
+    assert len(fl) == 2 and fl[0]["action"] == "retry" \
+        and fl[1]["action"] == "interpreter"

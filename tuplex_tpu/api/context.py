@@ -29,11 +29,29 @@ class Context:
         self.metrics = Metrics()
         from ..history import JobRecorder
 
+        webui = self.options_store.get_bool("tuplex.webui", False)
         self.recorder = JobRecorder(
             self.options_store.get_str("tuplex.logDir", "."),
-            enabled=self.options_store.get_bool("tuplex.webui.enable"),
+            enabled=webui or
+            self.options_store.get_bool("tuplex.webui.enable"),
             exception_display_limit=self.options_store.get_int(
                 "tuplex.webui.exceptionDisplayLimit", 5))
+        self._webui_server = None
+        self._webui_url = None
+        if webui:
+            # live dashboard autostart (reference: ensure_webui spawning
+            # mongod + gunicorn; here one stdlib http thread)
+            from ..history.recorder import start_server
+
+            try:
+                self._webui_server, self._webui_url = start_server(
+                    self.options_store.get_str("tuplex.logDir", "."),
+                    port=self.options_store.get_int("tuplex.webui.port", 0))
+            except OSError as e:
+                from ..utils.logging import get_logger
+
+                get_logger("webui").warning("webui autostart failed: %s", e)
+                self._webui_url = ""   # uiWebURL: nothing is serving
         if self.options_store.get_bool("tuplex.redirectToPythonLogging"):
             from ..utils.logging import redirect_to_python_logging
 
@@ -131,9 +149,28 @@ class Context:
         VirtualFileSystem.rm(pattern)
 
     def uiWebURL(self) -> str:
+        if self._webui_url is not None:
+            return self._webui_url   # "" when autostart failed: not serving
         host = self.options_store.get_str("tuplex.webui.url", "localhost")
         port = self.options_store.get_str("tuplex.webui.port", "5000")
         return f"http://{host}:{port}"
+
+    def close(self) -> None:
+        """Release context resources (the autostarted webui server's socket
+        and thread). Safe to call repeatedly."""
+        if self._webui_server is not None:
+            try:
+                self._webui_server.shutdown()
+                self._webui_server.server_close()
+            except Exception:
+                pass
+            self._webui_server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def _infer_row_schema(sample: list, columns, threshold: float) -> T.RowType:

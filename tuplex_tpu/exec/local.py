@@ -96,6 +96,9 @@ class LocalBackend:
         self.mm = MemoryManager(
             options.get_size("tuplex.executorMemory", 1 << 30),
             options.get_str("tuplex.scratchDir", "/tmp/tuplex_tpu"))
+        # task-level fault tolerance record (reference analog: the Lambda
+        # backend's failure_log, AWSLambdaBackend.cc:410-474)
+        self.failure_log: list[dict] = []
 
     def touch_partition(self, part) -> None:
         self.mm.touch(part)
@@ -136,6 +139,7 @@ class LocalBackend:
 
         t0 = time.perf_counter()
         mm_snap = self.mm.metrics_snapshot()
+        fl_snap = len(self.failure_log)
         metrics: dict[str, Any] = {"fast_path_s": 0.0, "slow_path_s": 0.0,
                                    "general_path_s": 0.0, "compile_s": 0.0}
         parts_it = iter(partitions)
@@ -177,10 +181,49 @@ class LocalBackend:
             if limit >= 0 and emitted_total >= limit:
                 return  # limit met: drop already-dispatched work unprocessed
             # registering a previous output may have spilled this partition
-            # in the dispatch->collect gap; touch swaps it back in
-            self.mm.touch(part)
-            outp, excs, m = self._collect_partition(stage, part, outs,
-                                                    dispatch_s)
+            # in the dispatch->collect gap; touch swaps it back in and the
+            # pin keeps it resident against concurrent prefetch mm calls
+            self.mm.pin(part)
+            try:
+                try:
+                    outp, excs, m = self._collect_partition(stage, part,
+                                                            outs, dispatch_s)
+                except Exception as e:
+                    if outs is None:
+                        raise   # interpreter failure is deterministic
+                    # device-task failure: retry the dispatch once, then run
+                    # the partition entirely on the interpreter — a failing
+                    # DEVICE task degrades, never kills the job (reference:
+                    # failure_log, AWSLambdaBackend.cc:410-474)
+                    from ..utils.logging import get_logger
+
+                    self.failure_log.append({
+                        "stage": skey[:16], "start_index": part.start_index,
+                        "rows": part.num_rows, "attempt": 1,
+                        "error": f"{type(e).__name__}: {e}",
+                        "action": "retry"})
+                    get_logger("exec").warning(
+                        "partition task failed (%s: %s); retrying once",
+                        type(e).__name__, e)
+                    try:
+                        _, outs2, d2 = self._dispatch_partition(
+                            part, device_fn, skey)
+                        outp, excs, m = self._collect_partition(
+                            stage, part, outs2, d2)
+                    except Exception as e2:
+                        self.failure_log.append({
+                            "stage": skey[:16],
+                            "start_index": part.start_index,
+                            "rows": part.num_rows, "attempt": 2,
+                            "error": f"{type(e2).__name__}: {e2}",
+                            "action": "interpreter"})
+                        get_logger("exec").warning(
+                            "retry failed (%s: %s); partition runs on the "
+                            "interpreter", type(e2).__name__, e2)
+                        outp, excs, m = self._collect_partition(
+                            stage, part, None, 0.0)
+            finally:
+                self.mm.unpin(part)
             self.mm.register(outp)
             metrics["fast_path_s"] += m.get("fast_path_s", 0.0)
             metrics["slow_path_s"] += m.get("slow_path_s", 0.0)
@@ -196,7 +239,11 @@ class LocalBackend:
                 yield first_part
             yield from parts_it
 
-        for part in parts_stream():
+        prefetch = max(0, self.options.get_int(
+            "tuplex.tpu.sourcePrefetch", 2))
+        stream = _prefetch_iter(parts_stream(), prefetch) if prefetch \
+            else parts_stream()
+        for part in stream:
             check_interrupted()
             if limit >= 0 and emitted_total >= limit:
                 break
@@ -213,6 +260,7 @@ class LocalBackend:
         metrics["wall_s"] = time.perf_counter() - t0
         metrics["rows_out"] = emitted_total
         metrics["exception_rows"] = len(exceptions)
+        metrics["task_failures"] = len(self.failure_log) - fl_snap
         metrics.update(self.mm.metrics_delta(mm_snap))
         return StageResult(out_parts, exceptions, metrics)
 
@@ -445,6 +493,50 @@ class LocalBackend:
             outp.normal_mask = normal_mask
             outp.fallback = fallback
         return outp
+
+
+def _prefetch_iter(it, depth: int):
+    """Producer-thread wrapper: source loading (Arrow read/decode) overlaps
+    with device compute + merge (reference: Executor.h WorkQueue IO overlap;
+    the interleaveIO analog). Bounded queue so memory stays capped."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    _END = object()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def produce():
+        try:
+            for item in it:
+                if not put(item):
+                    return   # consumer stopped early (take-limit)
+            put(_END)
+        except BaseException as e:  # surface source errors on the consumer
+            put(e)
+
+    t = threading.Thread(target=produce, daemon=True,
+                         name="tuplex-source-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()   # unblock the producer if we exited early
 
 
 def _schema_from_rows(rows: list[Row]) -> Optional[T.RowType]:

@@ -58,6 +58,16 @@ class JobRecorder:
 
 def render_report(log_dir: str = ".", out_path: Optional[str] = None) -> str:
     """Static HTML dashboard over the history file (webui analog)."""
+    out_path = out_path or os.path.join(log_dir or ".",
+                                        "tuplex_history.html")
+    with open(out_path, "w") as fp:
+        fp.write(_render_doc(log_dir, live=False))
+    return out_path
+
+
+def _render_doc(log_dir: str, live: bool) -> str:
+    """Dashboard document; `live` adds the auto-refresh tag (served pages
+    only — the on-disk report stays a static archival artifact)."""
     src = os.path.join(log_dir or ".", "tuplex_history.jsonl")
     recs = []
     if os.path.exists(src):
@@ -91,7 +101,9 @@ def render_report(log_dir: str = ".", out_path: Optional[str] = None) -> str:
                     f"<tr class=exc><td colspan=7>↳ "
                     f"{html.escape(s)}</td></tr>")
 
+    refresh = '<meta http-equiv="refresh" content="2">' if live else ""
     doc = f"""<!doctype html><meta charset="utf-8">
+{refresh}
 <title>tuplex_tpu history</title>
 <style>
  body {{ font: 14px system-ui, sans-serif; margin: 2rem; color: #1a1a1a; }}
@@ -109,26 +121,15 @@ def render_report(log_dir: str = ".", out_path: Optional[str] = None) -> str:
 <th>fast-path s</th><th>slow-path s</th><th>exceptions</th></tr>
 {''.join(rows_html)}
 </table>"""
-    out_path = out_path or os.path.join(log_dir or ".",
-                                        "tuplex_history.html")
-    with open(out_path, "w") as fp:
-        fp.write(doc)
-    return out_path
+    return doc
 
 
-def serve(log_dir: str = ".", port: int = 5000,
-          host: str = "127.0.0.1"):
-    """Serve ONLY the rendered dashboard via stdlib http.server (blocking).
-
-    Binds loopback by default and never exposes the filesystem — every GET
-    re-renders and returns the dashboard document."""
+def _make_server(log_dir: str, port: int, host: str):
     import http.server
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802
-            out = render_report(log_dir)
-            with open(out, "rb") as fp:
-                body = fp.read()
+            body = _render_doc(log_dir, live=True).encode("utf-8")
             self.send_response(200)
             self.send_header("Content-Type", "text/html; charset=utf-8")
             self.send_header("Content-Length", str(len(body)))
@@ -138,5 +139,30 @@ def serve(log_dir: str = ".", port: int = 5000,
         def log_message(self, *a):  # quiet
             pass
 
-    with http.server.HTTPServer((host, port), Handler) as srv:
+    return http.server.HTTPServer((host, port), Handler)
+
+
+def serve(log_dir: str = ".", port: int = 5000,
+          host: str = "127.0.0.1"):
+    """Serve ONLY the rendered dashboard via stdlib http.server (blocking).
+
+    Binds loopback by default and never exposes the filesystem — every GET
+    re-renders and returns the dashboard document (auto-refreshing, so an
+    open browser tab shows live job progress — the reference's Flask/
+    SocketIO/Mongo webui collapsed to the stdlib)."""
+    with _make_server(log_dir, port, host) as srv:
         srv.serve_forever()
+
+
+def start_server(log_dir: str = ".", port: int = 5000,
+                 host: str = "127.0.0.1"):
+    """Background-thread variant (reference: ensure_webui autostart).
+    Returns (server, url); call server.shutdown() to stop. port=0 picks a
+    free port."""
+    import threading
+
+    srv = _make_server(log_dir, port, host)
+    t = threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="tuplex-history-server")
+    t.start()
+    return srv, f"http://{host}:{srv.server_address[1]}/"

@@ -105,6 +105,7 @@ class MemoryManager:
         self._inmem = 0
         self._lock = threading.Lock()
         self._dead: list[int] = []  # filled by weakref callbacks, no lock
+        self._pinned: set[int] = set()  # never evicted (in active use)
         self.swap_out_count = 0
         self.swap_in_count = 0
         self.swapped_bytes = 0
@@ -138,6 +139,19 @@ class MemoryManager:
             if getattr(part, "_spilled", None) is not None:
                 self._swap_in_locked(part)
 
+    def pin(self, part: C.Partition) -> None:
+        """Exclude from eviction while another thread may touch/register
+        (prefetch makes mm calls concurrent: touch-then-use isn't atomic
+        across threads). Always pair with unpin."""
+        with self._lock:
+            self._pinned.add(id(part))
+            if getattr(part, "_spilled", None) is not None:
+                self._swap_in_locked(part)
+
+    def unpin(self, part: C.Partition) -> None:
+        with self._lock:
+            self._pinned.discard(id(part))
+
     def _reap_locked(self) -> None:
         while self._dead:
             key = self._dead.pop()
@@ -155,7 +169,7 @@ class MemoryManager:
         for pid, entry in list(self._entries.items()):
             if self._inmem <= self.budget:
                 break
-            if pid == exclude:
+            if pid == exclude or pid in self._pinned:
                 continue
             part = entry.ref()
             if part is None or entry.nbytes == 0 or \
