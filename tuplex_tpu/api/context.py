@@ -124,6 +124,15 @@ class Context:
         return DataSet(self, make_orc_operator(self.options_store, pattern,
                                                columns=columns))
 
+    def tuplexfile(self, path: str) -> "DataSet":
+        """Read a dataset written by DataSet.totuplex — the engine's native
+        binary partition format; columnar leaves reload without sniffing or
+        decoding (reference: FileFormat::OUTFMT_TUPLEX)."""
+        from ..io.tuplexfmt import make_tuplex_operator
+        from .dataset import DataSet
+
+        return DataSet(self, make_tuplex_operator(self.options_store, path))
+
     def options(self) -> dict:
         return self.options_store.as_dict()
 
@@ -165,6 +174,7 @@ class Context:
             except Exception:
                 pass
             self._webui_server = None
+            self._webui_url = ""   # nothing serving anymore
 
     def __del__(self):
         try:

@@ -150,6 +150,17 @@ class DataSet:
                              backend=self._context.backend)
         self._finish_file_job(partitions)
 
+    def totuplex(self, path: str) -> None:
+        """Write the engine's native binary partition format (reference:
+        FileFormat::OUTFMT_TUPLEX, LocalBackend.cc:1597) — reload with
+        Context.tuplexfile(path), no sniffing or decode on the way back."""
+        from ..io.tuplexfmt import write_partitions_tuplex
+
+        partitions = self._execute_partitions(limit=-1)
+        write_partitions_tuplex(path, partitions,
+                                backend=self._context.backend)
+        self._finish_file_job(partitions)
+
     def _finish_file_job(self, partitions) -> None:
         import time as _time
 

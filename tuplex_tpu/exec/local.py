@@ -260,7 +260,9 @@ class LocalBackend:
         metrics["wall_s"] = time.perf_counter() - t0
         metrics["rows_out"] = emitted_total
         metrics["exception_rows"] = len(exceptions)
-        metrics["task_failures"] = len(self.failure_log) - fl_snap
+        # one failed task may log retry AND degrade entries: count tasks
+        metrics["task_failures"] = sum(
+            1 for e in self.failure_log[fl_snap:] if e.get("attempt") == 1)
         metrics.update(self.mm.metrics_delta(mm_snap))
         return StageResult(out_parts, exceptions, metrics)
 
