@@ -193,3 +193,19 @@ def test_tuplex_format_overwrite_atomic(ctx, tmp_path):
     assert ctx.tuplexfile(out).collect() == [(9, "z")]
     # old nonce files removed
     assert not (set(os.listdir(out)) & first_files - {"tuplex_manifest.pkl"})
+
+
+def test_tuplex_format_stale_reader_clean_error(ctx, tmp_path):
+    # review r9: a reader opened before an overwrite raises a clean
+    # TuplexException, not a raw FileNotFoundError
+    import pytest
+
+    from tuplex_tpu.core.errors import TuplexException
+
+    out = str(tmp_path / "ds.tpx")
+    ctx.parallelize([(1, "a")], columns=["n", "s"]).totuplex(out)
+    stale = ctx.tuplexfile(out)
+    stale.collect()   # prime (and cache the sample)
+    ctx.parallelize([(2, "b")], columns=["n", "s"]).totuplex(out)
+    with pytest.raises(TuplexException, match="overwritten"):
+        stale.collect()

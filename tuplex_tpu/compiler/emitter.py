@@ -136,6 +136,15 @@ class Frame:
         hit = self.active() & cond & (self.ctx.err == 0)
         self.ctx.err = jnp.where(hit, jnp.int32(int(code)), self.ctx.err)
         self.ctx.active = self.ctx.active & ~hit
+        # cut the error lattice's producer chain HERE: lambda UDFs and the
+        # fused decode have no statement boundaries, so without this the
+        # final #err kLoop fusion re-pulls (and per-element RECOMPUTES)
+        # every [B, W] intermediate that fed any error condition — measured
+        # ~0.5s of a 1.5s zillow batch on XLA-CPU
+        from ..runtime.jaxcfg import lax
+
+        self.ctx.err, self.ctx.active = lax.optimization_barrier(
+            (self.ctx.err, self.ctx.active))
 
     # ===================================================================
     # statements

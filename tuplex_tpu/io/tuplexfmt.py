@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import os
 import pickle
-from typing import Optional, Sequence
 
 import numpy as np
 
@@ -57,9 +56,14 @@ def write_partitions_tuplex(path: str, partitions: list,
     with open(tmp, "wb") as fp:
         pickle.dump(manifest, fp)
     os.replace(tmp, os.path.join(path, _MANIFEST))
+    # single-writer semantics (like the reference's output formats):
+    # concurrent writers to one dataset directory are unsupported. Readers
+    # opened BEFORE an overwrite raise a clean TuplexException on next read.
     keep = {e["file"] for e in manifest} | {_MANIFEST}
     for f in os.listdir(path):
-        if f not in keep and f.startswith("part-"):
+        stale_part = f.startswith("part-")
+        stale_tmp = f.startswith("." + _MANIFEST)   # interrupted writes
+        if f not in keep and (stale_part or stale_tmp):
             try:
                 os.unlink(os.path.join(path, f))
             except OSError:
@@ -79,11 +83,14 @@ class TuplexFileSourceOperator(L.LogicalOperator):
         if not self.manifest:
             raise TuplexException(f"empty tuplex dataset at {path!r}")
         self._schema = self.manifest[0]["schema"]
+        self._sample: "list[Row] | None" = None
 
     def schema(self) -> T.RowType:
         return self._schema
 
     def sample(self) -> list[Row]:
+        if self._sample is not None:
+            return list(self._sample)
         part = self._load([self.manifest[0]])[0]
         k = min(256, part.num_rows)
         # slice BEFORE boxing: large partitions must not pay full-partition
@@ -94,8 +101,9 @@ class TuplexFileSourceOperator(L.LogicalOperator):
             else part.normal_mask[:k]
         sub.fallback = {i: v for i, v in part.fallback.items() if i < k}
         cols = C.user_columns(self._schema)
-        return [Row.from_value(v, cols)
-                for v in C.partition_to_pylist(sub)]
+        self._sample = [Row.from_value(v, cols)
+                        for v in C.partition_to_pylist(sub)]
+        return list(self._sample)
 
     def _load(self, entries) -> list[C.Partition]:
         parts = []
@@ -103,9 +111,16 @@ class TuplexFileSourceOperator(L.LogicalOperator):
             sp = SpilledPartition(
                 os.path.join(self.path, e["file"]),
                 {p: C.ObjectLeaf(v) for p, v in e["obj_leaves"].items()})
+            try:
+                leaves = sp.load()
+            except FileNotFoundError:
+                raise TuplexException(
+                    f"tuplex dataset at {self.path!r} was overwritten "
+                    f"after this reader opened it; reopen with "
+                    f"tuplexfile()") from None
             parts.append(C.Partition(
                 schema=e["schema"], num_rows=e["num_rows"],
-                leaves=sp.load(), normal_mask=e["normal_mask"],
+                leaves=leaves, normal_mask=e["normal_mask"],
                 fallback=dict(e["fallback"]),
                 start_index=e["start_index"]))
         return parts

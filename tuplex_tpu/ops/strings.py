@@ -390,7 +390,11 @@ def parse_i64(bytes_, lens):
     bad = bad | ovf
     bad = bad | (ndigits > 19)  # always overflows i64: python-int territory
     val = jnp.where(neg, -val, val)
-    return val, bad
+    # materialize: the Horner chain must not be re-inlined (and per-element
+    # recomputed) into every downstream consumer fusion
+    from ..runtime.jaxcfg import lax
+
+    return lax.optimization_barrier((val, bad))
 
 
 def parse_f64(bytes_, lens):
@@ -467,7 +471,9 @@ def parse_f64(bytes_, lens):
     val_big = mant * jnp.power(10.0, e)
     val = jnp.where(small, val_small, val_big)
     val = jnp.where(neg, -val, val)
-    return val, bad
+    from ..runtime.jaxcfg import lax
+
+    return lax.optimization_barrier((val, bad))
 
 
 _I64_MAX_DIGITS = 20  # sign + 19 digits
@@ -503,7 +509,13 @@ def format_i64(vals, width: int = 0, pad_zero: bool = False):
     )
     inside = pos < out_len[:, None]
     out = jnp.where(inside, out, 0)
-    return out.astype(jnp.uint8), out_len.astype(jnp.int32)
+    from ..runtime.jaxcfg import lax
+
+    # materialize: the digit-division chain must not re-inline into every
+    # downstream consumer (1D consumers like lengths otherwise recompute
+    # the whole [N, W] loop per element)
+    return lax.optimization_barrier(
+        (out.astype(jnp.uint8), out_len.astype(jnp.int32)))
 
 
 def from_numpy_strings(values: list[str | None]):
