@@ -117,3 +117,22 @@ def test_per_stage_swap_metrics_are_deltas(tmp_path):
     last = [m for m in c.metrics.stages if "swap_out" in m][-1]
     # the tiny second job must not inherit the first job's counters
     assert last["swap_out"] <= 2
+
+
+def test_repl_detection_and_traceback_cleanup():
+    from tuplex_tpu.utils import repl
+
+    # non-interactive test runner: every detector is False
+    assert repl.in_google_colab() is False
+    assert repl.in_jupyter_notebook() is False
+    assert repl.in_interactive_shell() is False
+
+    def user_udf(x):
+        return 1 // x
+
+    try:
+        user_udf(0)
+    except ZeroDivisionError as e:
+        txt = repl.clean_udf_traceback(e)
+    assert "user_udf" in txt and "ZeroDivisionError" in txt
+    assert "tuplex_tpu/utils/repl.py" not in txt

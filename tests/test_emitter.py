@@ -657,3 +657,27 @@ def test_module_qualified_capwords_still_compiles():
     import string
 
     check(lambda s: string.capwords(s), ["hello world", "FOO bar", ""])
+
+
+def test_str_split_indexing_and_len():
+    vals = ["a,b,c", "one", "x,y", ",lead", "trail,", ""]
+    check(lambda s: s.split(",")[0], vals)
+    check(lambda s: s.split(",")[1], vals)       # IndexError where 1 piece
+    check(lambda s: s.split(",")[2], vals)
+    check(lambda s: len(s.split(",")), vals)
+    check(lambda s: s.split("::")[0], ["a::b", "nope", "::x"])
+
+
+def test_str_join_static_iterables():
+    check(lambda s: "-".join((s, "x", s)), ["ab", "", "q"])
+    check(lambda s: ",".join([c for c in "abc"]) + s, ["!", ""])
+    rows = [("a", "b"), ("", "z")]
+    check(lambda x: "|".join((x["u"], x["v"])), rows, columns=["u", "v"])
+
+
+def test_split_in_pipeline_udf():
+    def second_field(x):
+        return x.split(":")[1]
+
+    vals = ["a:b:c", "k:v", "solo"]
+    check(second_field, vals)

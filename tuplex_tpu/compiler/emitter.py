@@ -615,6 +615,13 @@ class Frame:
 
     def eval_Subscript(self, node: ast.Subscript) -> CV:
         val = self.eval(node.value)
+        if val.kind == "split":
+            if isinstance(node.slice, ast.Slice):
+                raise NotCompilable("slicing a split result")
+            kidx = self.eval(node.slice)
+            if not (kidx.is_const and isinstance(kidx.const, int)):
+                raise NotCompilable("split index must be constant")
+            return self._split_item(val, kidx.const)
         # slicing
         if isinstance(node.slice, ast.Slice):
             return self._slice(val, node.slice)
@@ -761,6 +768,29 @@ class Frame:
         return CV(t=T.option(T.tuple_of(*[T.STR] * (rx.n_groups + 1))),
                   elts=tuple(elts), valid=matched, kind="match")
 
+    _SPLIT_INDEX_CAP = 32
+
+    def _split_item(self, sv: CV, k: int) -> CV:
+        """s.split(sep)[k] — k-th piece via k unrolled finds; rows with
+        fewer pieces raise IndexError (python semantics)."""
+        sb, sl, sep = sv.sbytes, sv.slen, sv.names[0]
+        m = len(sep)
+        if k < 0:
+            raise NotCompilable("split negative index")
+        if k > self._SPLIT_INDEX_CAP:
+            raise NotCompilable(f"split index {k} beyond unroll cap")
+        start = jnp.zeros(self.ctx.b, dtype=jnp.int32)
+        missing = jnp.zeros(self.ctx.b, dtype=bool)
+        for _ in range(k):
+            pos = S.find_const(sb, sl, sep, start=start)
+            missing = missing | (pos < 0)
+            start = jnp.where(pos < 0, start, pos + m)
+        nxt = S.find_const(sb, sl, sep, start=start)
+        stop = jnp.where(nxt < 0, sl, nxt)
+        self.raise_where(missing, ExceptionCode.INDEXERROR)
+        fb, fl = S.slice_(sb, sl, start, stop)
+        return CV(t=T.STR, sbytes=fb, slen=fl)
+
     def _match_method(self, m: CV, attr: str, args: list[CV]) -> CV:
         if attr != "group":
             raise NotCompilable(f"match.{attr}")
@@ -797,6 +827,9 @@ class Frame:
     # helpers
     # ===================================================================
     def truthy(self, v: CV):
+        if v.kind == "split":
+            # split() always yields at least one piece
+            return jnp.ones(self.ctx.b, dtype=bool)
         if v.is_const:
             return jnp.full(self.ctx.b, bool(v.const), dtype=bool)
         base = v.base
@@ -1234,6 +1267,37 @@ class Frame:
             if not (recv.is_const and isinstance(recv.const, str)):
                 raise NotCompilable("format on dynamic string")
             return self._format_method(recv.const, args)
+        if name == "split":
+            self._ascii_guard(rb, rl)
+            if len(args) > 1:
+                raise NotCompilable("str.split maxsplit")
+            if not args:
+                raise NotCompilable("str.split() whitespace mode")
+            sep = need_const_str(0)
+            if sep == "":
+                raise NotCompilable("str.split empty separator")
+            # LAZY view (reference: split codegen'd lazily too,
+            # FunctionRegistry): only [const_int] and len() force pieces —
+            # the result's ARITY is data-dependent, so it can't be a tuple
+            return CV(t=T.PYOBJECT, kind="split", names=(sep,),
+                      sbytes=rb, slen=rl)
+        if name == "join":
+            if not (recv.is_const and isinstance(recv.const, str)):
+                raise NotCompilable("join with dynamic separator")
+            if len(args) != 1:
+                raise NotCompilable("join takes exactly one argument")
+            items = self._cv_iter_items(args[0])
+            if items is None:
+                raise NotCompilable("join over non-static iterable")
+            out: Optional[CV] = None
+            sep_cv = const_cv(recv.const)
+            for it in items:
+                if not (it.base is T.STR or
+                        (it.is_const and isinstance(it.const, str))):
+                    raise NotCompilable("join of non-str element")
+                out = it if out is None else self._str_concat(
+                    self._str_concat(out, sep_cv), it)
+            return out if out is not None else const_cv("")
         if name == "center":
             raise NotCompilable("str.center")
         if name == "zfill":
@@ -1399,6 +1463,10 @@ class Frame:
         return CV(t=T.BOOL, data=self.truthy(args[0]))
 
     def _builtin_len(self, args: list[CV]) -> CV:
+        if args and args[0].kind == "split":
+            sv = args[0]
+            cnt = S.count_const(sv.sbytes, sv.slen, sv.names[0])
+            return CV(t=T.I64, data=cnt.astype(jnp.int64) + 1)
         v = args[0]
         if v.is_const:
             try:

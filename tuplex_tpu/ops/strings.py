@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..runtime.jaxcfg import jnp
+from ..runtime.jaxcfg import jnp, lax
 
 
 def const_bytes(s: str) -> np.ndarray:
@@ -392,8 +392,6 @@ def parse_i64(bytes_, lens):
     val = jnp.where(neg, -val, val)
     # materialize: the Horner chain must not be re-inlined (and per-element
     # recomputed) into every downstream consumer fusion
-    from ..runtime.jaxcfg import lax
-
     return lax.optimization_barrier((val, bad))
 
 
@@ -471,8 +469,6 @@ def parse_f64(bytes_, lens):
     val_big = mant * jnp.power(10.0, e)
     val = jnp.where(small, val_small, val_big)
     val = jnp.where(neg, -val, val)
-    from ..runtime.jaxcfg import lax
-
     return lax.optimization_barrier((val, bad))
 
 
@@ -509,8 +505,6 @@ def format_i64(vals, width: int = 0, pad_zero: bool = False):
     )
     inside = pos < out_len[:, None]
     out = jnp.where(inside, out, 0)
-    from ..runtime.jaxcfg import lax
-
     # materialize: the digit-division chain must not re-inline into every
     # downstream consumer (1D consumers like lengths otherwise recompute
     # the whole [N, W] loop per element)

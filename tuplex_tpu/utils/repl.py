@@ -1,0 +1,49 @@
+"""REPL/notebook detection + UDF traceback cleanup (reference:
+python/tuplex/repl/ shell detection, utils/tracebacks.py — strip framework
+frames so a failing UDF shows the USER's code, not the engine's)."""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+
+def in_google_colab() -> bool:
+    return "google.colab" in sys.modules
+
+
+def in_jupyter_notebook() -> bool:
+    try:
+        shell = get_ipython().__class__.__name__  # type: ignore[name-defined]
+        return shell == "ZMQInteractiveShell"
+    except NameError:
+        return False
+
+
+def in_interactive_shell() -> bool:
+    """True in any REPL: plain `python`, IPython terminal, or a notebook."""
+    if hasattr(sys, "ps1"):
+        return True
+    try:
+        get_ipython()  # type: ignore[name-defined]
+        return True
+    except NameError:
+        return False
+
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def clean_udf_traceback(exc: BaseException) -> str:
+    """Format an exception with framework-internal frames removed, so the
+    trace reads from the user's UDF down (reference: tracebacks.py)."""
+    frames = traceback.extract_tb(exc.__traceback__)
+    kept = [f for f in frames
+            if not os.path.abspath(f.filename).startswith(_PKG_DIR + os.sep)]
+    if not kept:          # error raised wholly inside the framework
+        kept = frames
+    lines = ["Traceback (most recent call last):\n"]
+    lines += traceback.format_list(kept)
+    lines += traceback.format_exception_only(type(exc), exc)
+    return "".join(lines)
