@@ -1,0 +1,82 @@
+#!/usr/bin/env python3
+"""Time the full Zillow fused-stage compile on the real TPU, in variants,
+with the persistent compilation cache enabled. Logs progressively so a
+timeout still yields data.
+
+Variants (sequential, same process):
+  A. barriers OFF (TUPLEX_FUSION_BARRIERS=0 is set by the runner)
+  B. run the compiled fn, time steady-state execution
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+CACHE = os.path.expanduser("~/.cache/jax_comp_cache")
+
+
+def log(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def main():
+    os.makedirs(CACHE, exist_ok=True)
+    import jax
+    jax.config.update("jax_compilation_cache_dir", CACHE)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    t0 = time.perf_counter()
+    dev = jax.devices()[0]
+    log(f"devices ok in {time.perf_counter() - t0:.1f}s platform={dev.platform}")
+
+    import tempfile
+    import tuplex_tpu
+    from tuplex_tpu.models import zillow
+    from tuplex_tpu.plan.physical import plan_stages
+    from tuplex_tpu.api.dataset import _source_partitions
+    from tuplex_tpu.runtime import columns as C
+
+    cache_dir = os.path.join(tempfile.gettempdir(), "tuplex_tpu_bench")
+    os.makedirs(cache_dir, exist_ok=True)
+    data = os.path.join(cache_dir, "zillow_20000.csv")
+    if not os.path.exists(data):
+        zillow.generate_csv(data, 20000, seed=42)
+
+    ctx = tuplex_tpu.Context()
+    ds = zillow.build_pipeline(ctx.csv(data))
+    st = plan_stages(ds._op, ctx.options_store)[0]
+    part = list(_source_partitions(ctx, st))[0]
+    batch = C.stage_partition(part, "pow2")
+    log(f"staged batch rows={part.num_rows} arrays={len(batch.arrays)}")
+
+    fn = st.build_device_fn(part.schema)
+    t0 = time.perf_counter()
+    lowered = jax.jit(fn).lower(batch.arrays)
+    log(f"lowered in {time.perf_counter() - t0:.1f}s "
+        f"({len(lowered.as_text().splitlines())} stablehlo lines, "
+        f"barriers={os.environ.get('TUPLEX_FUSION_BARRIERS', 'auto')})")
+
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    log(f"COMPILED in {time.perf_counter() - t0:.1f}s")
+
+    t0 = time.perf_counter()
+    outs = compiled(batch.arrays)
+    jax.block_until_ready(outs)
+    log(f"first run in {time.perf_counter() - t0:.3f}s")
+
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        outs = compiled(batch.arrays)
+        jax.block_until_ready(outs)
+        times.append(time.perf_counter() - t0)
+    log(f"steady runs s={[round(t, 4) for t in times]} "
+        f"-> {part.num_rows / min(times):,.0f} rows/s on-device")
+    log("ALL OK")
+
+
+if __name__ == "__main__":
+    main()

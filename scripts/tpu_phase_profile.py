@@ -1,0 +1,70 @@
+#!/usr/bin/env python3
+"""Per-phase timing of one Zillow run on the real chip: isolates Arrow read,
+host staging, H2D over the axon tunnel, device exec, D2H, and collect boxing
+so perf work targets the real bottleneck."""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+ROWS = int(os.environ.get("ROWS", "100000"))
+
+
+def t(label, t0):
+    print(f"{label:28s} {time.perf_counter() - t0:8.3f}s", flush=True)
+    return time.perf_counter()
+
+
+def main():
+    import tempfile
+
+    import jax
+
+    import tuplex_tpu
+    from tuplex_tpu.api.dataset import _source_partitions
+    from tuplex_tpu.models import zillow
+    from tuplex_tpu.plan.physical import plan_stages
+    from tuplex_tpu.runtime import columns as C
+
+    print("platform:", jax.devices()[0].platform, flush=True)
+    cache = os.path.join(tempfile.gettempdir(), "tuplex_tpu_bench")
+    os.makedirs(cache, exist_ok=True)
+    data = os.path.join(cache, f"zillow_{ROWS}.csv")
+    if not os.path.exists(data):
+        zillow.generate_csv(data, ROWS, seed=42)
+
+    ctx = tuplex_tpu.Context()
+    t0 = time.perf_counter()
+    ds = zillow.build_pipeline(ctx.csv(data))
+    st = plan_stages(ds._op, ctx.options_store)[0]
+    t0 = t("plan(+sample trace)", t0)
+    parts = list(_source_partitions(ctx, st))
+    t0 = t("arrow read -> partitions", t0)
+    part = parts[0]
+    batch = C.stage_partition(part, "pow2")
+    nbytes = sum(v.nbytes for v in batch.arrays.values())
+    t0 = t(f"host stage ({nbytes/1e6:.1f} MB)", t0)
+    fn = jax.jit(st.build_device_fn(part.schema))
+    outs = fn(batch.arrays)            # numpy inputs: the PRODUCTION avals
+    jax.block_until_ready(outs)
+    t0 = t("compile+H2D+first exec", t0)
+    outs = fn(batch.arrays)
+    jax.block_until_ready(outs)
+    t0 = t("steady H2D+exec", t0)
+    host_outs = jax.device_get(outs)
+    onb = sum(v.nbytes for v in host_outs.values())
+    t0 = t(f"D2H ({onb/1e6:.1f} MB)", t0)
+
+    # full framework run for comparison (includes merge + collect boxing)
+    for i in range(3):
+        t0 = time.perf_counter()
+        out = zillow.build_pipeline(ctx.csv(data)).collect()
+        t0 = t(f"full collect run{i} ({len(out)} rows)", t0)
+
+    print("done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

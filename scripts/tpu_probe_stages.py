@@ -1,0 +1,128 @@
+#!/usr/bin/env python3
+"""Staged TPU bring-up probe: compile+run each piece of the Zillow pipeline
+separately on the real chip, timing every step, so we can see exactly which
+kernel the axon tunnel chokes on (round 1/2 saw multi-minute hangs on the
+full fused stage).
+
+Run:  python scripts/tpu_probe_stages.py [--rows N]
+Each step prints `STEP <name> compile_s=... run_s=...` as soon as it
+finishes; run under `timeout` and the last printed STEP is the culprit.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# persistent compile cache: repeat compiles of the same HLO become instant
+CACHE = os.path.expanduser("~/.cache/jax_comp_cache")
+
+
+def step(name):
+    def deco(fn):
+        def wrapped(*a, **k):
+            t0 = time.perf_counter()
+            out = fn(*a, **k)
+            print(f"STEP {name} total_s={time.perf_counter() - t0:.2f}",
+                  flush=True)
+            return out
+        return wrapped
+    return deco
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=20000)
+    args = ap.parse_args()
+
+    os.makedirs(CACHE, exist_ok=True)
+    import jax
+    jax.config.update("jax_compilation_cache_dir", CACHE)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    t0 = time.perf_counter()
+    dev = jax.devices()[0]
+    print(f"STEP devices total_s={time.perf_counter() - t0:.2f} "
+          f"platform={dev.platform}", flush=True)
+
+    import jax.numpy as jnp
+
+    @step("matmul_bf16")
+    def _matmul():
+        x = jnp.ones((1024, 1024), jnp.bfloat16)
+        return (x @ x).sum().block_until_ready()
+    _matmul()
+
+    # --- byte-matrix string kernel: the core primitive of every stage ------
+    import numpy as np
+    from tuplex_tpu.ops import strings as S
+
+    rng = np.random.default_rng(0)
+    N, W = args.rows, 32
+    data = rng.integers(48, 58, size=(N, W), dtype=np.uint8)
+    lens = rng.integers(1, 19, size=(N,), dtype=np.int32)
+
+    @step("parse_i64")
+    def _parse():
+        f = jax.jit(S.parse_i64)
+        v, bad = f(jnp.asarray(data), jnp.asarray(lens))
+        v.block_until_ready()
+    _parse()
+
+    @step("parse_f64")
+    def _parsef():
+        f = jax.jit(S.parse_f64)
+        v, bad = f(jnp.asarray(data), jnp.asarray(lens))
+        v.block_until_ready()
+    _parsef()
+
+    # --- zillow CSV decode stage (fused device CSV parse) ------------------
+    import tempfile
+    import tuplex_tpu
+    from tuplex_tpu.models import zillow
+
+    cache_dir = os.path.join(tempfile.gettempdir(), "tuplex_tpu_bench")
+    os.makedirs(cache_dir, exist_ok=True)
+    data_csv = os.path.join(cache_dir, f"zillow_{args.rows}.csv")
+    if not os.path.exists(data_csv):
+        zillow.generate_csv(data_csv, args.rows, seed=42)
+
+    ctx = tuplex_tpu.Context()
+
+    @step("zillow_source_only")
+    def _src():
+        return ctx.csv(data_csv).take(5)
+    _src()
+
+    @step("zillow_map_only")
+    def _map():
+        ds = ctx.csv(data_csv)
+        return ds.mapColumn("zipcode", lambda z: z[:5]).take(5)
+    _map()
+
+    @step("zillow_full_take")
+    def _full():
+        ds = zillow.build_pipeline(ctx.csv(data_csv))
+        return ds.take(5)
+    _full()
+
+    @step("zillow_full_collect")
+    def _collect():
+        ds = zillow.build_pipeline(ctx.csv(data_csv))
+        return ds.collect()
+    out = _collect()
+    print(f"rows_out={len(out)}", flush=True)
+
+    @step("zillow_full_collect_2nd")
+    def _collect2():
+        ds = zillow.build_pipeline(ctx.csv(data_csv))
+        return ds.collect()
+    _collect2()
+    print("ALL OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

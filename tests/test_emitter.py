@@ -42,7 +42,7 @@ def run_compiled(f, values, columns=None):
     part = C.build_partition(values, schema)
     batch = C.stage_partition(part)
     arrays = {k: jnp.asarray(v) for k, v in batch.arrays.items()}
-    ctx = EmitCtx(batch.b, arrays["#rowvalid"])
+    ctx = EmitCtx(batch.b, arrays["#rowvalid"], seed=arrays.get("#seed"))
     udf = get_udf_source(f)
     em = Emitter(ctx, udf.globals)
     arg = input_row_cv(arrays, schema)
@@ -681,3 +681,68 @@ def test_split_in_pipeline_udf():
 
     vals = ["a:b:c", "k:v", "solo"]
     check(second_field, vals)
+
+
+# -- dict comprehensions ----------------------------------------------------
+
+def test_dict_comprehension_named_row():
+    # dict-valued UDF results become NAMED rows; collect yields value tuples
+    # (same contract as dict literals / reference MapOperator named outputs)
+    f = lambda x: {k: x * (i + 1)                               # noqa: E731
+                   for i, k in enumerate(("a", "b", "c"))}
+    got = run_compiled(f, [1, 2, 3])
+    assert got == [(1, 2, 3), (2, 4, 6), (3, 6, 9)]
+
+
+def test_dict_comprehension_filter_and_dup_keys():
+    # filter is trace-constant; duplicate key keeps the LAST binding
+    got = run_compiled(lambda x: {k: x for k in ("a", "b", "a") if k != "b"},
+                       [5, 7])
+    assert got == [5, 7]    # single column 'a' unwraps like {'a': ...}
+
+
+def test_dict_comprehension_dynamic_key_falls_back():
+    import pytest as _pt
+
+    with _pt.raises(NotCompilable):
+        run_compiled(lambda s: {s: 1}, ["a", "b"])
+
+
+# -- random module ----------------------------------------------------------
+
+def test_random_random_range_and_determinism():
+    import random
+
+    f = lambda x: random.random()  # noqa: E731
+    got1 = run_compiled(f, [1, 2, 3, 4])
+    got2 = run_compiled(f, [1, 2, 3, 4])
+    assert got1 == got2                       # same partition seed -> same
+    assert all(0.0 <= v < 1.0 for v in got1)
+    assert len(set(got1)) > 1                 # rows draw distinct values
+
+
+def test_random_uniform_and_randint_bounds():
+    import random
+
+    g1 = run_compiled(lambda x: random.uniform(10.0, 20.0), [0] * 64)
+    assert all(10.0 <= v <= 20.0 for v in g1)
+    g2 = run_compiled(lambda x: random.randint(3, 5), [0] * 200)
+    assert set(g2) == {3, 4, 5}
+    g3 = run_compiled(lambda x: random.randrange(4), [0] * 200)
+    assert set(g3) == {0, 1, 2, 3}
+
+
+def test_random_randint_bad_range_raises():
+    import random
+
+    got = run_compiled(lambda x: random.randint(5, x), [3, 7])
+    assert got[0] is ValueError
+    assert got[1] in (5, 6, 7)
+
+
+def test_random_choice_static_seq():
+    import random
+
+    got = run_compiled(lambda x: random.choice(("lo", "mid", "hi")), [0] * 99)
+    assert set(got) <= {"lo", "mid", "hi"}
+    assert len(set(got)) > 1

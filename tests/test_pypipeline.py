@@ -37,6 +37,11 @@ def norm(results):
     for status, payload in results:
         if status == "ok":
             normed.append(("ok", tuple(payload.values), payload.columns))
+        elif status == "exc":
+            # payload[3] is a sampled traceback STRING — tier-specific
+            # rendering (closure keeps user frames; source tier runs
+            # generated code), so parity compares the row data only
+            normed.append((status, tuple(payload[:3])))
         else:
             normed.append((status, payload))
     return normed

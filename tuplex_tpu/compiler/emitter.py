@@ -47,11 +47,29 @@ _WHILE_UNROLL_CAP = 24
 class EmitCtx:
     """Per-stage trace state: batch size, error lattice, active mask."""
 
-    def __init__(self, b: int, rowvalid):
+    def __init__(self, b: int, rowvalid, seed=None):
         self.b = b
         self.err = jnp.zeros(b, dtype=jnp.int32)
         # rows that are real + normal-case; padding/fallback slots never active
         self.active = rowvalid
+        # per-partition PRNG seed (0-d uint32, staged as arrays['#seed']) for
+        # compiled `random` UDFs; distinct per partition so batches don't
+        # replay one sequence (reference: StandardModules.cc:30-129 types the
+        # random module; draws are not CPython-sequence-exact there either)
+        self.seed = seed
+        self._rng_base = None
+        self._rng_n = 0
+
+    def next_rng_key(self):
+        if self.seed is None:
+            raise NotCompilable("random requires a staged #seed")
+        from jax import random as jrandom
+
+        if self._rng_base is None:
+            self._rng_base = jrandom.key(self.seed)
+        k = jrandom.fold_in(self._rng_base, self._rng_n)
+        self._rng_n += 1
+        return k
 
     def raise_where(self, cond, code: ExceptionCode) -> None:
         hit = self.active & cond & (self.err == 0)
@@ -140,11 +158,13 @@ class Frame:
         # fused decode have no statement boundaries, so without this the
         # final #err kLoop fusion re-pulls (and per-element RECOMPUTES)
         # every [B, W] intermediate that fed any error condition — measured
-        # ~0.5s of a 1.5s zillow batch on XLA-CPU
-        from ..runtime.jaxcfg import lax
+        # ~0.5s of a 1.5s zillow batch on XLA-CPU (CPU-only: see
+        # jaxcfg.fusion_barriers_enabled)
+        from ..runtime.jaxcfg import fusion_barriers_enabled, lax
 
-        self.ctx.err, self.ctx.active = lax.optimization_barrier(
-            (self.ctx.err, self.ctx.active))
+        if fusion_barriers_enabled():
+            self.ctx.err, self.ctx.active = lax.optimization_barrier(
+                (self.ctx.err, self.ctx.active))
 
     # ===================================================================
     # statements
@@ -159,10 +179,13 @@ class Frame:
         fusion can't inline a whole UDF body into one kLoop fusion that
         recomputes [B, W] string intermediates per output element (measured
         24x slowdown on Zillow extractPrice on XLA-CPU). optimization_barrier
-        is free at runtime; fusion still happens within each statement."""
+        is free at runtime; fusion still happens within each statement.
+        CPU-only (see jaxcfg.fusion_barriers_enabled)."""
         from .values import cv_arrays, cv_rebuild
-        from ..runtime.jaxcfg import lax
+        from ..runtime.jaxcfg import fusion_barriers_enabled, lax
 
+        if not fusion_barriers_enabled():
+            return
         leaves: list = []
         items = list(self.env.items())
         for _, cv in items:
@@ -466,6 +489,49 @@ class Frame:
             self.env = saved   # py3 comprehension scope: target doesn't leak
         return tuple_cv(outs)
 
+    def eval_DictComp(self, node: ast.DictComp) -> CV:
+        """{k: v for ...} with trace-constant string keys becomes a named row
+        (same contract as dict literals; reference: BlockGeneratorVisitor
+        comprehension + MapOperator named-output semantics)."""
+        if len(node.generators) != 1:
+            raise NotCompilable("nested comprehension")
+        gen = node.generators[0]
+        if getattr(gen, "is_async", 0):
+            raise NotCompilable("async comprehension")
+        items = self._static_iter_items(gen.iter)
+        if items is None:
+            raise NotCompilable("comprehension over non-static iterable")
+        saved = dict(self.env)
+        keys: list[str] = []
+        vals: list[CV] = []
+        try:
+            for item in items:
+                self._assign_target(gen.target, item)
+                keep = True
+                for cond_node in gen.ifs:
+                    cond = self.eval(cond_node)
+                    if not cond.is_const:
+                        raise NotCompilable(
+                            "comprehension filter must be trace-constant")
+                    if not bool(cond.const):
+                        keep = False
+                        break
+                if not keep:
+                    continue
+                k = self.eval(node.key)
+                if not (k.is_const and isinstance(k.const, str)):
+                    raise NotCompilable("dict comprehension key must be a "
+                                        "trace-constant str")
+                v = self.eval(node.value)
+                if k.const in keys:          # python: later binding wins
+                    vals[keys.index(k.const)] = v
+                else:
+                    keys.append(k.const)
+                    vals.append(v)
+        finally:
+            self.env = saved
+        return tuple_cv(vals, names=keys)
+
     def exec_Pass(self, node: ast.Pass) -> None:
         pass
 
@@ -688,6 +754,11 @@ class Frame:
                     node.func.attr in ("search", "match"):
                 args = [self.eval(a) for a in node.args]
                 return self._re_search(node.func.attr, args)
+            if recv is not None and recv.is_const and \
+                    getattr(recv.const, "__name__", None) == "random" and \
+                    type(recv.const).__name__ == "module":
+                args = [self.eval(a) for a in node.args]
+                return self._random_fn(node.func.attr, args)
             if recv is not None and recv.base is T.STR:
                 args = [self.eval(a) for a in node.args]
                 return self._str_method(recv, node.func.attr, args)
@@ -729,6 +800,69 @@ class Frame:
         if builtin is not None:
             return builtin(args)
         raise NotCompilable(f"call to {name}")
+
+    def _random_fn(self, fname: str, args: list[CV]) -> CV:
+        """Compiled `random` module calls (reference: FunctionRegistry
+        codegens random.choice; StandardModules.cc:30-129 types the module).
+        Draws use jax's counter-based PRNG keyed per (partition seed, call
+        site) — deterministic per partition, distinct across partitions, and
+        explicitly NOT CPython-Mersenne-sequence-exact (the reference's
+        compiled path diverges from CPython sequences the same way)."""
+        from jax import random as jrandom
+
+        if fname == "random":
+            if args:
+                raise NotCompilable("random.random arity")
+            u = jrandom.uniform(self.ctx.next_rng_key(), (self.ctx.b,),
+                                dtype=jnp.float64)
+            return CV(t=T.F64, data=u)
+        if fname == "uniform":
+            if len(args) != 2:
+                raise NotCompilable("random.uniform arity")
+            a = self._require_numeric(args[0], "random.uniform")
+            b = self._require_numeric(args[1], "random.uniform")
+            af = self._cast(a.data, T.F64)
+            bf = self._cast(b.data, T.F64)
+            u = jrandom.uniform(self.ctx.next_rng_key(), (self.ctx.b,),
+                                dtype=jnp.float64)
+            # CPython formula: a + (b-a) * random()
+            return CV(t=T.F64, data=af + (bf - af) * u)
+        if fname in ("randint", "randrange"):
+            if fname == "randrange" and len(args) == 1:
+                args = [const_cv(0), args[0]]
+            if len(args) != 2:
+                raise NotCompilable(f"random.{fname} arity")
+            for arg in args:
+                # CPython raises per-version (ValueError/TypeError) on float
+                # bounds; the interpreter tier owns that exactness
+                if arg.base not in (T.I64, T.BOOL):
+                    raise NotCompilable(f"random.{fname} non-integer bound")
+            a = self._as_i64(self._require_numeric(args[0], fname))
+            b = self._as_i64(self._require_numeric(args[1], fname))
+            hi = b + 1 if fname == "randint" else b    # randint is inclusive
+            self.raise_where(jnp.broadcast_to(a >= hi, (self.ctx.b,)),
+                             ExceptionCode.VALUEERROR)
+            hi_safe = jnp.maximum(hi, a + 1)           # keep errored rows legal
+            v = jrandom.randint(self.ctx.next_rng_key(), (self.ctx.b,),
+                                a, hi_safe, dtype=jnp.int64)
+            return CV(t=T.I64, data=v)
+        if fname == "choice":
+            if len(args) != 1:
+                raise NotCompilable("random.choice arity")
+            items = self._cv_iter_items(args[0])
+            if items is None:
+                raise NotCompilable("random.choice over non-static iterable")
+            if not items:
+                self.raise_where(jnp.ones(self.ctx.b, dtype=bool),
+                                 ExceptionCode.INDEXERROR)
+                return const_cv(None)
+            idx = jrandom.randint(self.ctx.next_rng_key(), (self.ctx.b,),
+                                  0, len(items), dtype=jnp.int32)
+            acc = items[-1]
+            for i in range(len(items) - 2, -1, -1):
+                acc = merge_cv(self, idx == i, items[i], acc)
+            return acc
+        raise NotCompilable(f"random.{fname}")
 
     def _re_search(self, fname: str, args: list[CV]) -> CV:
         """Compiled re.search/re.match over a string column (reference:

@@ -288,7 +288,7 @@ def _build_closure_pipeline(ops: list) -> Callable[[Row], tuple]:
                         except Exception:
                             pass  # resolver itself raised: try next
                 if row2 is _UNHANDLED:
-                    return ("exc", (op_id, type(e).__name__, row.unwrap()))
+                    return _exc_result(op_id, e, row.unwrap())
             if row2 is None and is_filter:
                 return ("drop", None)
             row = row2
@@ -631,8 +631,24 @@ def _try_build_source_pipeline(ops: list, input_names: tuple, closure):
     return _finish_source(src, env)
 
 
+_TRACE_SAMPLE_CAP = 8    # cleaned tracebacks formatted per process (cost cap)
+_trace_samples = [0]
+
+
 def _exc_result(op_id: int, e: BaseException, rowval):
-    return ("exc", (op_id, type(e).__name__, rowval))
+    """Exception row payload; the first few per process carry a cleaned
+    traceback (framework frames stripped — utils/repl.py, reference:
+    python/tuplex/utils/tracebacks.py) for exception_counts / webui samples."""
+    trace = None
+    if _trace_samples[0] < _TRACE_SAMPLE_CAP:
+        _trace_samples[0] += 1
+        from ..utils.repl import clean_udf_traceback
+
+        try:
+            trace = clean_udf_traceback(e)
+        except Exception:
+            trace = None
+    return ("exc", (op_id, type(e).__name__, rowval, trace))
 
 
 def _finish_source(src: list, env: dict):

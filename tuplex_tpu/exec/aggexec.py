@@ -344,7 +344,7 @@ class AggregateExecutor:
         run = self.backend.jit_cache.get_or_build(
             ("meshfold", op.id, schema.name, shapes),
             lambda: CC.sharded_fold_fn(eval_exprs, spec.reducers, mesh,
-                                       list(arrays)))
+                                       arrays))
         outs = run(arrays)
         ok_np = np.asarray(outs[-1])[: part.num_rows] & _real_mask(part)
         partials = [o.item() for o in outs[:-1]]
@@ -439,7 +439,7 @@ class AggregateExecutor:
         run = self.backend.jit_cache.get_or_build(
             ("meshseg", op.id, schema.name, nseg, shapes),
             lambda: CC.sharded_segment_fold_fn(
-                eval_exprs, spec.reducers, nseg, mesh, list(arrays)))
+                eval_exprs, spec.reducers, nseg, mesh, arrays))
         outs = run(arrays, codes_b)
         ok_np = np.asarray(outs[-1])[:n] & real
         counts = np.asarray(outs[-2])[:nseg]

@@ -36,6 +36,7 @@ class ExceptionRecord:
     op_id: int
     exc_name: str
     row: Any
+    trace: Any = None    # cleaned user-frame traceback (sampled rows only)
 
     def __repr__(self):
         return f"<{self.exc_name} at op#{self.op_id}: {self.row!r}>"
@@ -351,8 +352,10 @@ class LocalBackend:
                 if status == "ok":
                     resolved[i] = payload
                 elif status == "exc":
-                    op_id, exc_name, value = payload
-                    exceptions.append(ExceptionRecord(op_id, exc_name, value))
+                    op_id, exc_name, value = payload[:3]
+                    trace = payload[3] if len(payload) > 3 else None
+                    exceptions.append(
+                        ExceptionRecord(op_id, exc_name, value, trace))
         metrics["slow_path_s"] = time.perf_counter() - t0
 
         outp = self._merge(stage, part, compiled_ok, out_arrays, resolved)

@@ -44,7 +44,7 @@ class JobRecorder:
 
     def stage_done(self, stage, metrics: dict, exceptions: list) -> None:
         self._stage_no += 1
-        sample = [repr(e)[:200]
+        sample = [(getattr(e, "trace", None) or repr(e))[:800]
                   for e in exceptions[: self.exception_display_limit]]
         self._write({"event": "stage", "no": self._stage_no,
                      "kind": type(stage).__name__,

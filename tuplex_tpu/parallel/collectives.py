@@ -35,8 +35,17 @@ def _ident_arr(reducer: str, dtype):
         reduce_identity(reducer, jnp.issubdtype(dtype, jnp.floating)), dtype)
 
 
+def _batch_specs(arrays_example, axis):
+    """Row-shard every batched array; replicate 0-d scalars ('#seed')."""
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    return {k: P(axis) if np.ndim(v) else P()
+            for k, v in arrays_example.items()}
+
+
 def sharded_fold_fn(eval_exprs: Callable, reducers: Sequence[str], mesh,
-                    array_keys: Sequence[str], axis: str = DATA_AXIS):
+                    arrays_example, axis: str = DATA_AXIS):
     """Build a jitted mesh-parallel fold (ONE compile per cache entry: the
     returned callable has stable identity — cache it per stage/shape).
 
@@ -63,7 +72,7 @@ def sharded_fold_fn(eval_exprs: Callable, reducers: Sequence[str], mesh,
         # the interpreter fold
         return tuple(outs) + (ok,)
 
-    specs = {k: P(axis) for k in array_keys}
+    specs = _batch_specs(arrays_example, axis)
     fn = shard_map(local_fold, mesh=mesh, in_specs=(specs,),
                    out_specs=tuple(P() for _ in reducers) + (P(axis),),
                    check_vma=False)
@@ -71,7 +80,7 @@ def sharded_fold_fn(eval_exprs: Callable, reducers: Sequence[str], mesh,
 
 
 def sharded_segment_fold_fn(eval_exprs: Callable, reducers: Sequence[str],
-                            nseg: int, mesh, array_keys: Sequence[str],
+                            nseg: int, mesh, arrays_example,
                             axis: str = DATA_AXIS):
     """Mesh-parallel aggregateByKey: per-device segment reduction over local
     rows, then psum/pmin/pmax of the [nseg] partial tables across the mesh
@@ -104,7 +113,7 @@ def sharded_segment_fold_fn(eval_exprs: Callable, reducers: Sequence[str],
                                 num_segments=nseg + 1), axis)
         return tuple(outs) + (counts, ok)
 
-    specs = {k: P(axis) for k in array_keys}
+    specs = _batch_specs(arrays_example, axis)
     fn = shard_map(local_fold, mesh=mesh, in_specs=(specs, P(axis)),
                    out_specs=tuple(P() for _ in reducers) + (P(), P(axis)),
                    check_vma=False)

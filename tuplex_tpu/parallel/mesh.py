@@ -42,9 +42,11 @@ def shard_stage_fn(raw_fn, mesh, axis: str = DATA_AXIS):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     shard = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())     # 0-d scalars (e.g. '#seed'): replicate
 
     def sharded(arrays):
-        placed = {k: jax.device_put(v, shard) for k, v in arrays.items()}
+        placed = {k: jax.device_put(v, shard if v.ndim else repl)
+                  for k, v in arrays.items()}
         return raw_fn(placed)
 
     return jax.jit(sharded)
@@ -59,6 +61,9 @@ def pad_batch_for_mesh(arrays: dict, n_devices: int) -> dict:
         return arrays
     out = {}
     for k, v in arrays.items():
+        if np.ndim(v) == 0:             # scalars (e.g. '#seed') replicate
+            out[k] = v
+            continue
         pad = [(0, target - b)] + [(0, 0)] * (v.ndim - 1)
         out[k] = np.pad(np.asarray(v), pad)
     return out

@@ -51,7 +51,7 @@ class UniqueOperator(L.LogicalOperator):
     def sample(self) -> list[Row]:
         seen = set()
         out = []
-        for r in self.parent.sample():
+        for r in self.parent.cached_sample():
             k = tuple(r.values)
             try:
                 if k in seen:
@@ -91,7 +91,7 @@ class AggregateOperator(L.LogicalOperator):
 
     def sample(self) -> list[Row]:
         acc = self.initial
-        for r in self.parent.sample():
+        for r in self.parent.cached_sample():
             try:
                 acc = _apply_agg(self.aggregate_udf, acc, r)
             except Exception:
@@ -131,7 +131,7 @@ class AggregateByKeyOperator(L.LogicalOperator):
         ps = self.parent.schema()
         kidx = [ps.columns.index(c) for c in self.key_columns]
         groups: dict = {}
-        for r in self.parent.sample():
+        for r in self.parent.cached_sample():
             k = tuple(r.values[i] for i in kidx)
             acc = groups.get(k, self.initial)
             try:
@@ -455,13 +455,17 @@ class ScanFold:
         from ..runtime.jaxcfg import jnp, lax
 
         def fn(arrays, acc_in):
+            # scan over batched leaves only; 0-d scalars ('#seed') can't ride
+            # the scanned axis
+            xs = {k: v for k, v in arrays.items() if jnp.ndim(v)}
+
             def step(carry, x):
                 new_leaves, bad = self._trace_row(carry, x)
                 out = tuple(jnp.where(bad, old, new)
                             for old, new in zip(carry, new_leaves))
                 return out, bad
 
-            final, bads = lax.scan(step, tuple(acc_in), arrays)
+            final, bads = lax.scan(step, tuple(acc_in), xs)
             return final + (bads,)
 
         return fn
@@ -507,6 +511,7 @@ def _seg_build_fn(scan: "ScanFold"):
 
     def fn(arrays, codes, seg_init):
         nseg_b = seg_init[0].shape[0]
+        arrays = {k: v for k, v in arrays.items() if jnp.ndim(v)}
 
         def step(carry, x):
             code = x["code"]

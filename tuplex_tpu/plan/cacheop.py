@@ -78,7 +78,7 @@ class CacheOperator(L.LogicalOperator):
                 for i in range(min(p.num_rows, 256)):
                     out.append(p.decode_row(i))
             return out
-        return self.parent.sample()
+        return self.parent.cached_sample()
 
     def load_partitions(self, context, projection=None) -> list:
         self.materialize(context)

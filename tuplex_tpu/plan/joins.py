@@ -87,11 +87,11 @@ class JoinOperator(L.LogicalOperator):
         lk = ls.columns.index(self.left_column)
         rk = rs.columns.index(self.right_column)
         build: dict = {}
-        for r in self.right.sample():
+        for r in self.right.cached_sample():
             build.setdefault(r.values[rk], []).append(r)
         out = []
         cols = self.schema().columns
-        for r in self.left.sample():
+        for r in self.left.cached_sample():
             key = r.values[lk]
             matches = build.get(key, [])
             lvals = [v for i, v in enumerate(r.values) if i != lk]

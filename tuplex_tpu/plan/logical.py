@@ -60,6 +60,17 @@ class LogicalOperator:
     def sample(self) -> list[Row]:
         raise NotImplementedError
 
+    def cached_sample(self) -> list[Row]:
+        """Memoized sample(): every consumer (child schema inference, child
+        samples, speculation probes) shares ONE trace per operator instead of
+        re-running the whole upstream UDF chain per call — planning was
+        measurably O(ops²) in sample applications without this (reference:
+        TraceVisitor runs once per operator too)."""
+        memo = getattr(self, "_sample_memo", None)
+        if memo is None:
+            memo = self._sample_memo = self.sample()
+        return memo
+
     def is_breaker(self) -> bool:
         """Pipeline breaker => stage boundary (reference:
         PhysicalPlan.cc:60-238 — joins/aggregates end stages)."""
@@ -108,7 +119,7 @@ class UDFOperator(LogicalOperator):
 class MapOperator(UDFOperator):
     def _infer_schema(self) -> T.RowType:
         outs = []
-        for r in self.parent.sample():
+        for r in self.parent.cached_sample():
             try:
                 outs.append(apply_udf_python(self.udf, r))
             except Exception:
@@ -136,7 +147,7 @@ class MapOperator(UDFOperator):
     def sample(self) -> list[Row]:
         out = []
         cols = self.columns()
-        for r in self.parent.sample():
+        for r in self.parent.cached_sample():
             try:
                 v = apply_udf_python(self.udf, r)
             except Exception:
@@ -157,7 +168,7 @@ class FilterOperator(UDFOperator):
 
     def sample(self) -> list[Row]:
         out = []
-        for r in self.parent.sample():
+        for r in self.parent.cached_sample():
             try:
                 if apply_udf_python(self.udf, r):
                     out.append(r)
@@ -180,7 +191,7 @@ class WithColumnOperator(UDFOperator):
         if user_columns(ps) is None:
             raise TuplexException("withColumn requires named columns")
         outs = []
-        for r in self.parent.sample():
+        for r in self.parent.cached_sample():
             try:
                 outs.append(apply_udf_python(self.udf, r))
             except Exception:
@@ -198,7 +209,7 @@ class WithColumnOperator(UDFOperator):
     def sample(self) -> list[Row]:
         schema = self.schema()
         out = []
-        for r in self.parent.sample():
+        for r in self.parent.cached_sample():
             try:
                 v = apply_udf_python(self.udf, r)
             except Exception:
@@ -222,7 +233,7 @@ class MapColumnOperator(UDFOperator):
             raise TuplexException(f"unknown column {self.column!r}")
         ci = ps.columns.index(self.column)
         outs = []
-        for r in self.parent.sample():
+        for r in self.parent.cached_sample():
             try:
                 outs.append(self.udf.func(r.values[ci]))
             except Exception:
@@ -236,7 +247,7 @@ class MapColumnOperator(UDFOperator):
         ps = self.parent.schema()
         ci = ps.columns.index(self.column)
         out = []
-        for r in self.parent.sample():
+        for r in self.parent.cached_sample():
             try:
                 v = self.udf.func(r.values[ci])
             except Exception:
@@ -274,7 +285,7 @@ class SelectColumnsOperator(LogicalOperator):
         idx = self._resolve_indices()
         s = self.schema()
         return [Row([r.values[i] for i in idx], s.columns)
-                for r in self.parent.sample()]
+                for r in self.parent.cached_sample()]
 
 
 class RenameColumnOperator(LogicalOperator):
@@ -297,7 +308,7 @@ class RenameColumnOperator(LogicalOperator):
 
     def sample(self) -> list[Row]:
         s = self.schema()
-        return [Row(r.values, s.columns) for r in self.parent.sample()]
+        return [Row(r.values, s.columns) for r in self.parent.cached_sample()]
 
 
 class ResolveOperator(LogicalOperator):
@@ -313,7 +324,7 @@ class ResolveOperator(LogicalOperator):
         return self.parent.schema()
 
     def sample(self) -> list[Row]:
-        return self.parent.sample()
+        return self.parent.cached_sample()
 
 
 class IgnoreOperator(LogicalOperator):
@@ -328,7 +339,7 @@ class IgnoreOperator(LogicalOperator):
         return self.parent.schema()
 
     def sample(self) -> list[Row]:
-        return self.parent.sample()
+        return self.parent.cached_sample()
 
 
 class TakeOperator(LogicalOperator):
@@ -340,7 +351,7 @@ class TakeOperator(LogicalOperator):
         return self.parent.schema()
 
     def sample(self) -> list[Row]:
-        s = self.parent.sample()
+        s = self.parent.cached_sample()
         return s if self.limit < 0 else s[: self.limit]
 
 
@@ -370,7 +381,7 @@ class DecodeOperator(LogicalOperator):
 
     def sample(self) -> list[Row]:
         out = []
-        for r in self.parent.sample():
+        for r in self.parent.cached_sample():
             vals = [decode_cell_python(v, t, self.null_values)
                     for v, t in zip(r.values, self.declared.types)]
             out.append(Row(vals, self.declared.columns))

@@ -104,7 +104,7 @@ class TransformStage:
 
         def fn(arrays: dict):
             b = arrays["#rowvalid"].shape[0]
-            ctx = EmitCtx(b, arrays["#rowvalid"])
+            ctx = EmitCtx(b, arrays["#rowvalid"], seed=arrays.get("#seed"))
             keep = arrays["#rowvalid"]
             row = input_row_cv(arrays, schema)
             from ..runtime.columns import user_columns
@@ -132,9 +132,15 @@ def _fusion_barrier(ctx: EmitCtx, row: CV, keep):
     instead of ~1s for the Zillow extractPrice stage. The barrier is a
     runtime no-op; it only tells the fusion pass to materialize each
     operator's outputs (the reference analog: each LLVM pipeline stage writes
-    its row before the next reads it)."""
+    its row before the next reads it).
+
+    TPU's fusion pass doesn't exhibit the kLoop recompute pathology, so the
+    barriers default to CPU-only (see jaxcfg.fusion_barriers_enabled)."""
     from ..compiler.values import cv_arrays, cv_rebuild
-    from ..runtime.jaxcfg import lax
+    from ..runtime.jaxcfg import fusion_barriers_enabled, lax
+
+    if not fusion_barriers_enabled():
+        return row, keep
 
     leaves: list = []
     cv_arrays(row, leaves)

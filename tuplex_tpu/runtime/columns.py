@@ -439,6 +439,12 @@ def stage_partition(part: Partition, bucket_mode: str = "pow2") -> DeviceBatch:
     else:
         rowvalid[:n] = part.normal_mask
     arrays["#rowvalid"] = rowvalid
+    # per-partition PRNG seed for compiled `random` UDFs (Weyl-mixed start
+    # index so partitions draw distinct streams). Stages without random never
+    # read it; jit drops unused inputs at lowering, so the executable and the
+    # persistent compile cache key are untouched for such stages.
+    arrays["#seed"] = np.uint32((part.start_index * 2654435761 + 97531)
+                                & 0xFFFFFFFF)
     return DeviceBatch(arrays=arrays, n=n, b=b, schema=part.schema)
 
 

@@ -6,11 +6,48 @@ i64 is emulated but the hot arithmetic is mostly i32-safe — the emitter
 narrows where value ranges allow (future work, tuplex.tpu.* options).
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compile cache: the fused-stage executables are expensive to
+# build on the TPU service (~6 min for the 7.3k-op Zillow stage via the
+# tunnel) but perfectly cacheable — identical HLO hits the on-disk cache in
+# milliseconds across processes. Reference analog: LLVMOptimizer caches per
+# (stage, schema) in-process only; on TPU the compile is remote so a disk
+# cache is the right redesign.
+_cache_dir = os.environ.get(
+    "TUPLEX_COMPILE_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "jax_comp_cache"))
+if _cache_dir and _cache_dir != "0":
+    try:
+        os.makedirs(_cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # pragma: no cover - cache is best-effort
+        pass
+
 import jax.numpy as jnp  # noqa: E402
 from jax import lax  # noqa: E402
 
-__all__ = ["jax", "jnp", "lax"]
+__all__ = ["jax", "jnp", "lax", "fusion_barriers_enabled"]
+
+
+def fusion_barriers_enabled() -> bool:
+    """Whether stage traces insert lax.optimization_barrier between operators
+    / statements / error-lattice updates.
+
+    XLA-CPU's producer fusion inlines whole UDF bodies into one kLoop fusion
+    that RECOMPUTES [B, W] string intermediates per output element (measured
+    24x on Zillow extractPrice), so barriers are load-bearing there. XLA-TPU
+    fuses loop nests without that pathology — and the barriers sent the
+    TPU-tunnel compile from ~6 min to >15 min wedged — so they default off
+    everywhere except CPU. Override: TUPLEX_FUSION_BARRIERS=0/1."""
+    import os
+
+    mode = os.environ.get("TUPLEX_FUSION_BARRIERS", "auto")
+    if mode in ("0", "1"):
+        return mode == "1"
+    return jax.default_backend() == "cpu"

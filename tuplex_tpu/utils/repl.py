@@ -40,7 +40,8 @@ def clean_udf_traceback(exc: BaseException) -> str:
     trace reads from the user's UDF down (reference: tracebacks.py)."""
     frames = traceback.extract_tb(exc.__traceback__)
     kept = [f for f in frames
-            if not os.path.abspath(f.filename).startswith(_PKG_DIR + os.sep)]
+            if not os.path.abspath(f.filename).startswith(_PKG_DIR + os.sep)
+            and not f.filename.startswith("<tpx-")]   # generated pipeline
     if not kept:          # error raised wholly inside the framework
         kept = frames
     lines = ["Traceback (most recent call last):\n"]
