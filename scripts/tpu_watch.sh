@@ -1,0 +1,25 @@
+#!/bin/bash
+# Patiently probe the axon TPU tunnel until it answers, logging each attempt.
+# One client at a time, generous per-attempt timeout, long sleeps between
+# failures so a wedged server isn't hammered mid-recovery.
+LOG=${1:-/tmp/tpu_watch.log}
+while true; do
+  ts=$(date +%H:%M:%S)
+  raw=$(timeout 420 python -c "
+import time; t0=time.time()
+import jax
+ds = jax.devices()
+import jax.numpy as jnp
+x = jnp.arange(1<<20, dtype=jnp.int32)
+s = int(x.sum())
+print('TPU_OK init+compute_s=%.1f platform=%s sum=%d' % (time.time()-t0, ds[0].platform, s))
+" 2>&1)
+  rc=$?
+  out=$(echo "$raw" | grep -E "TPU_OK|Error|error" | tail -2)
+  echo "$ts rc=$rc $out" >> "$LOG"
+  if echo "$out" | grep -q TPU_OK; then
+    echo "$ts TPU AVAILABLE — stopping watch" >> "$LOG"
+    exit 0
+  fi
+  sleep 300
+done
