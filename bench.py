@@ -33,7 +33,9 @@ import time
 N_ROWS = int(os.environ.get("BENCH_ROWS", "100000"))
 BASELINE_ROWS = int(os.environ.get("BENCH_BASELINE_ROWS", "40000"))
 RUNS = int(os.environ.get("BENCH_RUNS", "2"))
-TPU_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT", "900"))
+# cold numbers through the tunnel: backend init ~2 min, zillow stage compile
+# ~6 min (persistent cache makes reruns fast, but never assume a warm cache)
+TPU_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT", "1800"))
 TPU_ATTEMPTS = int(os.environ.get("BENCH_TPU_ATTEMPTS", "2"))
 TPU_RETRY_WAIT_S = int(os.environ.get("BENCH_TPU_RETRY_WAIT", "120"))
 CPU_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT", "1200"))
