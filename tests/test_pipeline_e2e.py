@@ -268,3 +268,67 @@ def test_comprehension_udf_compiles(ctx):
     got = ctx.parallelize([3, 4, 5]).map(
         lambda x: sum([i * x for i in range(4)])).collect()
     assert got == [6 * v for v in [3, 4, 5]]
+
+
+# --- exact device exceptions (no-resolver fast exit) ------------------------
+
+def test_exact_device_exceptions_skip_interpreter(ctx, monkeypatch):
+    """Without resolvers, rows with exact device error codes must never
+    reach the python pipeline (reference: exception partitions carry
+    (operator id, code) straight from compiled code)."""
+    from tuplex_tpu.plan.physical import TransformStage
+
+    calls = {"n": 0}
+    orig = TransformStage.python_pipeline
+
+    def spy(self, *a, **k):
+        calls["n"] += 1
+        return orig(self, *a, **k)
+
+    monkeypatch.setattr(TransformStage, "python_pipeline", spy)
+    ds = ctx.parallelize([1, 0, 2, 0, 4]).map(lambda x: 10 // x)
+    assert ds.collect() == [10, 5, 2]
+    assert ds.exception_counts() == {"ZeroDivisionError": 2}
+    assert calls["n"] == 0
+
+
+def test_exact_device_exceptions_with_resolver_unchanged(ctx):
+    res = (ctx.parallelize([1, 0, 2, 0, 4])
+           .map(lambda x: 10 // x)
+           .resolve(ZeroDivisionError, lambda x: -1)
+           .collect())
+    assert res == [10, -1, 5, -1, 2]
+
+
+def test_int_underscore_unicode_digits_resolve_on_interpreter(ctx):
+    # PEP 515 / non-ASCII digit grammar the kernels can't evaluate must
+    # ROUTE (CPython converts them), never claim ValueError
+    vals = ["10", "1_0", "\u0661\u0662", "1__0", "zz"]
+    ds = ctx.parallelize(vals).map(lambda s: int(s))
+    assert ds.collect() == [10, 10, 12]
+    assert ds.exception_counts() == {"ValueError": 2}
+    assert ctx.metrics.fastPathWallTime() > 0
+
+
+def test_int_overflow_string_resolves_on_interpreter(ctx):
+    # int("9999999999999999999999") succeeds in CPython (arbitrary
+    # precision) — the device must ROUTE these, never claim ValueError
+    vals = ["12", "9999999999999999999999", "x", "9223372036854775808"]
+    ds = ctx.parallelize(vals).map(lambda s: int(s))
+    assert ds.collect() == [12, 9999999999999999999999, 9223372036854775808]
+    assert ds.exception_counts() == {"ValueError": 1}
+    # the route/ValueError split must have been decided ON DEVICE
+    assert ctx.metrics.fastPathWallTime() > 0
+
+
+def test_float_inf_nan_literals_resolve_on_interpreter(ctx):
+    import math
+
+    vals = ["1.5", "inf", "-Infinity", "nan", "bogus"]
+    ds = ctx.parallelize(vals).map(lambda s: float(s))
+    got = ds.collect()
+    assert got[0] == 1.5
+    assert got[1] == float("inf") and got[2] == float("-inf")
+    assert math.isnan(got[3])
+    assert ds.exception_counts() == {"ValueError": 1}
+    assert ctx.metrics.fastPathWallTime() > 0

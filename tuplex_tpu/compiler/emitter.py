@@ -50,6 +50,7 @@ class EmitCtx:
     def __init__(self, b: int, rowvalid, seed=None):
         self.b = b
         self.err = jnp.zeros(b, dtype=jnp.int32)
+        self.cur_op = -1                  # set per fused op by build_device_fn
         # rows that are real + normal-case; padding/fallback slots never active
         self.active = rowvalid
         # per-partition PRNG seed (0-d uint32, staged as arrays['#seed']) for
@@ -71,9 +72,18 @@ class EmitCtx:
         self._rng_n += 1
         return k
 
+    def coded(self, code: ExceptionCode) -> int:
+        """Pack (exception class, logical-operator id) into ONE lattice
+        value: code in the low byte, operator id above it. Device exceptions
+        become host-attributable with zero extra device ops — a second
+        per-row operator lattice measured a 20x kLoop recompute pathology on
+        XLA-CPU (reference: exception partitions carry (operator id, code)
+        pairs from compiled code too)."""
+        return int(code) | (max(self.cur_op, 0) << 8)
+
     def raise_where(self, cond, code: ExceptionCode) -> None:
         hit = self.active & cond & (self.err == 0)
-        self.err = jnp.where(hit, jnp.int32(int(code)), self.err)
+        self.err = jnp.where(hit, jnp.int32(self.coded(code)), self.err)
         self.active = self.active & ~hit
 
 
@@ -152,7 +162,8 @@ class Frame:
 
     def raise_where(self, cond, code: ExceptionCode):
         hit = self.active() & cond & (self.ctx.err == 0)
-        self.ctx.err = jnp.where(hit, jnp.int32(int(code)), self.ctx.err)
+        self.ctx.err = jnp.where(hit, jnp.int32(self.ctx.coded(code)),
+                                 self.ctx.err)
         self.ctx.active = self.ctx.active & ~hit
         # cut the error lattice's producer chain HERE: lambda UDFs and the
         # fused decode have no statement boundaries, so without this the
@@ -1555,8 +1566,10 @@ class Frame:
         if v.t is T.NULL:
             return CV(t=T.I64, data=jnp.zeros(self.ctx.b, dtype=jnp.int64))
         if v.base is T.STR:
-            val, bad = S.parse_i64(v.sbytes, v.slen)
+            val, bad, route = S.parse_i64(v.sbytes, v.slen)
             self.raise_where(bad, ExceptionCode.VALUEERROR)
+            # valid python int, unrepresentable in i64: interpreter row
+            self.raise_where(route, ExceptionCode.NORMALCASEVIOLATION)
             return CV(t=T.I64, data=val)
         if v.base is T.F64:
             return CV(t=T.I64, data=jnp.trunc(v.data).astype(jnp.int64))
@@ -1577,8 +1590,10 @@ class Frame:
         if v.t is T.NULL:  # error already flagged; dummy keeps tracing
             return CV(t=T.F64, data=jnp.zeros(self.ctx.b, dtype=jnp.float64))
         if v.base is T.STR:
-            val, bad = S.parse_f64(v.sbytes, v.slen)
+            val, bad, route = S.parse_f64(v.sbytes, v.slen)
             self.raise_where(bad, ExceptionCode.VALUEERROR)
+            # inf/nan literals parse fine in CPython: interpreter row
+            self.raise_where(route, ExceptionCode.NORMALCASEVIOLATION)
             return CV(t=T.F64, data=val)
         if v.base in (T.I64, T.BOOL, T.F64):
             return CV(t=T.F64, data=self._cast(

@@ -310,6 +310,10 @@ class LocalBackend:
         compiled_ok = np.zeros(n, dtype=np.bool_)
         out_arrays: dict[str, np.ndarray] = {}
 
+        # device error evidence per fallback row: idx -> (code, operator id).
+        # General-tier codes overwrite fast-path ones (supertype decode is
+        # the authoritative python-semantics run).
+        device_codes: dict[int, tuple[int, int]] = {}
         if pending_outs is not None:
             t0 = time.perf_counter()
             outs = jax.device_get(pending_outs)
@@ -322,7 +326,15 @@ class LocalBackend:
             else:
                 rowvalid[:] = part.normal_mask
             err_rows = rowvalid & (err != 0)
-            fallback_idx.update(np.nonzero(err_rows)[0].tolist())
+            err_idx = np.nonzero(err_rows)[0]
+            fallback_idx.update(err_idx.tolist())
+            if not stage.has_resolvers and not self.interpret_only:
+                # packed lattice value: class code | operator id << 8;
+                # only the no-resolver exact exit below reads these
+                codes = err[err_idx]
+                device_codes.update(
+                    zip(err_idx.tolist(),
+                        zip((codes & 0xFF).tolist(), (codes >> 8).tolist())))
             compiled_ok = rowvalid & keep & (err == 0)
             out_arrays = {k: np.asarray(v) for k, v in outs.items()}
         else:
@@ -336,14 +348,44 @@ class LocalBackend:
         if fallback_idx and pending_outs is not None \
                 and not self.interpret_only:
             t0 = time.perf_counter()
-            self._general_case_pass(stage, part, fallback_idx, resolved)
+            self._general_case_pass(stage, part, fallback_idx, resolved,
+                                    device_codes)
             metrics["general_path_s"] = time.perf_counter() - t0
+
+        # ---- exact device exceptions (no-resolver fast exit) --------------
+        # When the stage carries no resolver/ignore, a row whose device code
+        # is an exact Python exception class (codes 1-9; internal/suspect
+        # codes are >= 100) needs no interpreter re-run: class + operator
+        # come straight off the lattice. The reference likewise emits
+        # exception partitions from compiled code and only runs ResolveTask
+        # when there is something to resolve.
+        exc_by_row: dict[int, ExceptionRecord] = {}
+        if fallback_idx and not stage.has_resolvers \
+                and not self.interpret_only:
+            from ..core.errors import exception_class_for_code, exception_name
+
+            exact = []
+            for i in sorted(fallback_idx):
+                code_op = device_codes.get(i)
+                if code_op is None:
+                    continue
+                code, op_id = code_op
+                if exception_class_for_code(code) is not None:
+                    exact.append((i, op_id, exception_name(code)))
+            # decode a handful of rows so history previews stay informative;
+            # counts only need the class name
+            sample = {}
+            if exact:
+                sidx = [i for i, _, _ in exact[:5]]
+                sample = dict(zip(sidx, C.decode_rows(part, sidx)))
+            for i, op_id, name in exact:
+                exc_by_row[i] = ExceptionRecord(op_id, name, sample.get(i))
+                fallback_idx.discard(i)
 
         # ---- interpreter path (ResolveTask analog) ------------------------
         # one compiled closure chain per stage + bulk row decode: no per-row
         # op dispatch (reference: PythonPipelineBuilder.cc)
         t0 = time.perf_counter()
-        exceptions: list[ExceptionRecord] = []
         if fallback_idx:
             pipeline = stage.python_pipeline(part.user_columns)
             order = sorted(fallback_idx)
@@ -354,8 +396,9 @@ class LocalBackend:
                 elif status == "exc":
                     op_id, exc_name, value = payload[:3]
                     trace = payload[3] if len(payload) > 3 else None
-                    exceptions.append(
-                        ExceptionRecord(op_id, exc_name, value, trace))
+                    exc_by_row[i] = ExceptionRecord(op_id, exc_name, value,
+                                                    trace)
+        exceptions = [exc_by_row[i] for i in sorted(exc_by_row)]
         metrics["slow_path_s"] = time.perf_counter() - t0
 
         outp = self._merge(stage, part, compiled_ok, out_arrays, resolved)
@@ -363,7 +406,8 @@ class LocalBackend:
 
     # ------------------------------------------------------------------
     def _general_case_pass(self, stage: TransformStage, part: C.Partition,
-                           fallback_idx: set, resolved: dict) -> None:
+                           fallback_idx: set, resolved: dict,
+                           device_codes: Optional[dict] = None) -> None:
         """Compiled middle tier: re-run normal-case-violating rows through
         the stage fn traced under the GENERAL-CASE schema (Option/supertype
         widened decode). Rows it completes fold back like resolved python
@@ -414,6 +458,14 @@ class LocalBackend:
         err = np.asarray(outs.pop("#err"))[:k]
         keep = np.asarray(outs.pop("#keep"))[:k]
         ok = err == 0
+        if device_codes is not None and not stage.has_resolvers:
+            # the general tier's verdict supersedes the fast path's: its
+            # supertype decode removes normal-case artifacts
+            bad_j = np.nonzero(~ok)[0]
+            codes = err[bad_j]
+            device_codes.update(
+                zip(idx[bad_j].tolist(),
+                    zip((codes & 0xFF).tolist(), (codes >> 8).tolist())))
         if not ok.any():
             return
         out_arrays = {kk: np.asarray(v) for kk, v in outs.items()}

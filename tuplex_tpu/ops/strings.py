@@ -352,7 +352,11 @@ def compare_lt(a_bytes, a_lens, b_bytes, b_lens, or_equal: bool = False):
 
 def parse_i64(bytes_, lens):
     """int(s) semantics: optional surrounding spaces, optional sign, digits.
-    Returns (val int64 [N], err bool [N])."""
+    Returns (val int64 [N], bad bool [N], route bool [N]): `bad` rows are
+    EXACT CPython ValueErrors (syntactically not an int); `route` rows are
+    valid Python ints that don't fit i64 (arbitrary precision territory) and
+    must resolve on the interpreter — conflating them would report
+    ValueError where CPython succeeds (advisor finding, round 1)."""
     sb, sl = strip(bytes_, lens)
     n, w = sb.shape
     pos = jnp.arange(w, dtype=jnp.int32)[None, :]
@@ -387,17 +391,25 @@ def parse_i64(bytes_, lens):
         # representable edge (-2**63) is conservatively routed too.
         ovf = ovf | (step & (val > (i64max - dw[:, j]) // 10))
         val = jnp.where(step, val * 10 + dw[:, j], val)
-    bad = bad | ovf
-    bad = bad | (ndigits > 19)  # always overflows i64: python-int territory
+    # CPython accepts grammar outside this kernel: PEP 515 underscores
+    # ("1_0" == 10) and non-ASCII digits/whitespace (int("١٢"),
+    # "\xa012\xa0"). Those rows ROUTE to the interpreter — claiming
+    # ValueError would silently drop rows CPython converts.
+    outside = jnp.any(inside & ((sb == 95) | (sb >= 128)), axis=1)
+    bad = bad & ~outside
+    route = (ovf | (ndigits > 19) | outside) & ~bad
     val = jnp.where(neg, -val, val)
     # materialize: the Horner chain must not be re-inlined (and per-element
     # recomputed) into every downstream consumer fusion
-    return lax.optimization_barrier((val, bad))
+    return lax.optimization_barrier((val, bad, route))
 
 
 def parse_f64(bytes_, lens):
-    """float(s): [sign] digits [.digits] [e[sign]digits]. No inf/nan literals
-    yet. Returns (val f64, err bool)."""
+    """float(s): [sign] digits [.digits] [e[sign]digits].
+    Returns (val f64 [N], bad bool [N], route bool [N]): `bad` rows are
+    EXACT CPython ValueErrors; `route` rows are inf/infinity/nan literals
+    (CPython accepts them, this kernel doesn't evaluate them) and must
+    resolve on the interpreter."""
     sb, sl = strip(bytes_, lens)
     n, w = sb.shape
     pos = jnp.arange(w, dtype=jnp.int32)[None, :]
@@ -469,7 +481,26 @@ def parse_f64(bytes_, lens):
     val_big = mant * jnp.power(10.0, e)
     val = jnp.where(small, val_small, val_big)
     val = jnp.where(neg, -val, val)
-    return lax.optimization_barrier((val, bad))
+
+    # float('inf') / 'Infinity' / 'nan' (any case, optional sign) are valid
+    # CPython floats outside this kernel's grammar: route, don't ValueError
+    def _word_at(word):
+        if w == 0:
+            return jnp.zeros(n, dtype=jnp.bool_)
+        L = len(word)
+        idxs = int_start[:, None] + jnp.arange(L, dtype=jnp.int32)[None, :]
+        ch = jnp.take_along_axis(sb, jnp.clip(idxs, 0, w - 1), axis=1)
+        m = (sl - int_start) == L
+        for j, c in enumerate(word):
+            m = m & ((ch[:, j] | 32) == ord(c))
+        return m
+
+    # PEP 515 underscores and non-ASCII digits/whitespace are valid CPython
+    # float grammar this kernel doesn't evaluate: route, don't ValueError
+    outside = jnp.any(inside & ((sb == 95) | (sb >= 128)), axis=1)
+    route = _word_at("inf") | _word_at("infinity") | _word_at("nan") | outside
+    bad = bad & ~route
+    return lax.optimization_barrier((val, bad, route))
 
 
 _I64_MAX_DIGITS = 20  # sign + 19 digits

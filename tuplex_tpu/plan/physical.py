@@ -47,6 +47,16 @@ class TransformStage:
 
     force_interpret = False   # set on segments around non-compilable ops
 
+    @property
+    def has_resolvers(self) -> bool:
+        """Whether any resolver/ignore rides this stage. Without one, a row
+        whose device error code is an exact Python exception class needs no
+        interpreter re-run at all — the reference likewise serializes
+        (operator id, code) exception partitions straight from compiled code
+        when no resolver exists (ResolveTask only runs for resolution)."""
+        return any(isinstance(op, (L.ResolveOperator, L.IgnoreOperator))
+                   for op in self.ops)
+
     def python_pipeline(self, input_names: Optional[tuple] = None):
         """Cached per-stage compiled Python fallback pipeline (reference:
         PythonPipelineBuilder.cc generates one function per stage; ROUND 1
@@ -111,6 +121,7 @@ class TransformStage:
 
             names = user_columns(schema)
             for op in ops:
+                ctx.cur_op = op.id
                 row, keep, names = _emit_op(ctx, op, row, keep, names,
                                             general=general)
                 row, keep = _fusion_barrier(ctx, row, keep)
@@ -292,10 +303,14 @@ def _emit_decode(ctx: EmitCtx, frame, op, row: CV, keep,
             elts.append(null_cv())
             continue
         if base is T.I64:
-            val, bad = S.parse_i64(sb, sl)
+            # a cell outside i64 range violates the i64-typed column either
+            # way at decode: both flags mean "not this schema" here
+            val, bad, route = S.parse_i64(sb, sl)
+            bad = bad | route
             out = CV(t=T.I64, data=val)
         elif base is T.F64:
-            val, bad = S.parse_f64(sb, sl)
+            val, bad, route = S.parse_f64(sb, sl)
+            bad = bad | route
             out = CV(t=T.F64, data=val)
         elif base is T.BOOL:
             low_b, low_l = S.lower(*S.strip(sb, sl))
