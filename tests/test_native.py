@@ -47,3 +47,27 @@ def test_native_non_option_none_is_fallback():
     rows, p = _roundtrip([1, None, 3], schema)
     assert rows == [1, None, 3]
     assert 1 in p.fallback
+
+
+def test_offsets_to_matrix_parity(monkeypatch):
+    """Native arrow->leaf must produce exactly the python gather's output,
+    including over-long-cell clamping and full-length reporting."""
+    import pyarrow as pa
+
+    from tuplex_tpu import native as N
+    from tuplex_tpu.runtime.columns import arrow_string_to_leaf
+
+    vals = ["", "a", "hello world", "日本語テキスト", "x" * 50, "tail"]
+    arr = pa.array(vals, type=pa.large_string())
+    # includes a sliced (offset != 0) view — arrow slicing keeps buffers
+    for a in (arr, arr.slice(2)):
+        n = len(a)
+        leaf_n, full_n = arrow_string_to_leaf(a, n, 16, return_full_lens=True)
+        monkeypatch.setattr(N, "_mod", None)
+        monkeypatch.setattr(N, "_tried", True)  # force the python path
+        leaf_p, full_p = arrow_string_to_leaf(a, n, 16, return_full_lens=True)
+        monkeypatch.setattr(N, "_tried", False)
+        assert leaf_n.bytes.shape == leaf_p.bytes.shape
+        assert (leaf_n.bytes == leaf_p.bytes).all()
+        assert (leaf_n.lengths == leaf_p.lengths).all()
+        assert full_n.tolist() == full_p.tolist()

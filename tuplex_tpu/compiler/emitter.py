@@ -74,12 +74,13 @@ class EmitCtx:
 
     def coded(self, code: ExceptionCode) -> int:
         """Pack (exception class, logical-operator id) into ONE lattice
-        value: code in the low byte, operator id above it. Device exceptions
-        become host-attributable with zero extra device ops — a second
-        per-row operator lattice measured a 20x kLoop recompute pathology on
-        XLA-CPU (reference: exception partitions carry (operator id, code)
-        pairs from compiled code too)."""
-        return int(code) | (max(self.cur_op, 0) << 8)
+        value (core.errors.pack_device_code owns the layout). Device
+        exceptions become host-attributable with zero extra device ops
+        (reference: exception partitions carry (operator id, code) pairs
+        from compiled code too)."""
+        from ..core.errors import pack_device_code
+
+        return pack_device_code(int(code), self.cur_op)
 
     def raise_where(self, cond, code: ExceptionCode) -> None:
         hit = self.active & cond & (self.err == 0)

@@ -78,6 +78,26 @@ def exception_name(code: int) -> str:
         return f"code{code}"
 
 
+# Packed device-lattice layout: exception-class code in the low byte,
+# logical-operator id above it. One int32 per row carries both — a second
+# per-row operator lattice measured a 20x kLoop recompute pathology on
+# XLA-CPU. Operator ids are process-global and unbounded; ids that would
+# overflow the 23 bits left in an int32 pack as 0 ("unknown operator") —
+# attribution degrades, correctness (the class code) never does.
+_OP_ID_LIMIT = 1 << 23
+
+
+def pack_device_code(code: int, op_id: int) -> int:
+    if not 0 < op_id < _OP_ID_LIMIT:
+        op_id = 0
+    return int(code) | (op_id << 8)
+
+
+def unpack_device_code(packed: int) -> tuple[int, int]:
+    """packed -> (exception-class code, operator id)."""
+    return packed & 0xFF, packed >> 8
+
+
 class TuplexException(Exception):
     """Driver-side framework error (not a per-row exception)."""
 

@@ -24,7 +24,7 @@ import numpy as np
 from ..core import typesys as T
 from ..core.errors import (ExceptionCode, NotCompilable, TuplexException,
                            code_for_exception, exception_class_for_code,
-                           exception_name)
+                           exception_name, unpack_device_code)
 from ..core.row import Row
 from ..plan import logical as L
 from ..plan.physical import TransformStage
@@ -328,13 +328,15 @@ class LocalBackend:
             err_rows = rowvalid & (err != 0)
             err_idx = np.nonzero(err_rows)[0]
             fallback_idx.update(err_idx.tolist())
-            if not stage.has_resolvers and not self.interpret_only:
-                # packed lattice value: class code | operator id << 8;
-                # only the no-resolver exact exit below reads these
-                codes = err[err_idx]
-                device_codes.update(
-                    zip(err_idx.tolist(),
-                        zip((codes & 0xFF).tolist(), (codes >> 8).tolist())))
+            # packed lattice value: class code | operator id << 8. Read by
+            # the no-resolver exact exit below AND the general-tier gate: a
+            # row whose fast-path code is already an exact Python class
+            # decoded fine under the normal case — the general re-run cannot
+            # change its outcome, so it skips that tier either way.
+            codes = err[err_idx]
+            device_codes.update(
+                zip(err_idx.tolist(),
+                    map(unpack_device_code, codes.tolist())))
             compiled_ok = rowvalid & keep & (err == 0)
             out_arrays = {k: np.asarray(v) for k, v in outs.items()}
         else:
@@ -362,8 +364,6 @@ class LocalBackend:
         exc_by_row: dict[int, ExceptionRecord] = {}
         if fallback_idx and not stage.has_resolvers \
                 and not self.interpret_only:
-            from ..core.errors import exception_class_for_code, exception_name
-
             exact = []
             for i in sorted(fallback_idx):
                 code_op = device_codes.get(i)
@@ -420,8 +420,15 @@ class LocalBackend:
         gkey = "general/" + stage.key() + "/" + part.schema.name
         if gkey in self._not_compilable:
             return
-        # input-boxed rows can't ride the columnar general path
-        cand = sorted(i for i in fallback_idx if i not in part.fallback)
+        # input-boxed rows can't ride the columnar general path; rows whose
+        # fast-path code is already an exact Python exception class decoded
+        # fine under the normal case — a supertype re-run reproduces the
+        # same exception, so they skip straight past this tier
+        dc = device_codes or {}
+        cand = sorted(
+            i for i in fallback_idx
+            if i not in part.fallback
+            and exception_class_for_code(dc.get(i, (0, 0))[0]) is None)
         if not cand:
             return
         try:
@@ -465,7 +472,7 @@ class LocalBackend:
             codes = err[bad_j]
             device_codes.update(
                 zip(idx[bad_j].tolist(),
-                    zip((codes & 0xFF).tolist(), (codes >> 8).tolist())))
+                    map(unpack_device_code, codes.tolist())))
         if not ok.any():
             return
         out_arrays = {kk: np.asarray(v) for kk, v in outs.items()}

@@ -928,6 +928,18 @@ def arrow_string_to_leaf(arr, n: int, max_w: int,
     return_full_lens, also returns the UNCLAMPED byte lengths so callers can
     detect over-long cells without re-reading the buffers."""
     buffers = arr.buffers()
+    from ..native import get as _native_get
+
+    nat = _native_get()
+    if nat is not None and hasattr(nat, "offsets_to_matrix") and n:
+        mat_b, lens_b, full_b, w = nat.offsets_to_matrix(
+            buffers[2] if buffers[2] else b"", buffers[1], n, arr.offset,
+            max_w)
+        mat = np.frombuffer(mat_b, dtype=np.uint8).reshape(n, w)
+        leaf = StrLeaf(mat, np.frombuffer(lens_b, dtype=np.int32), valid)
+        if return_full_lens:
+            return leaf, np.frombuffer(full_b, dtype=np.int64)
+        return leaf
     offsets = np.frombuffer(buffers[1], dtype=np.int64,
                             count=len(arr) + 1 + arr.offset)[arr.offset:]
     data = np.frombuffer(buffers[2], dtype=np.uint8) if buffers[2] \
