@@ -209,3 +209,30 @@ def test_tuplex_format_stale_reader_clean_error(ctx, tmp_path):
     ctx.parallelize([(2, "b")], columns=["n", "s"]).totuplex(out)
     with pytest.raises(TuplexException, match="overwritten"):
         stale.collect()
+
+
+def test_operator_reordering_orders_filters_by_selectivity(ctx):
+    """reference: tuplex.optimizer.operatorReordering (opt-in there too) —
+    consecutive filters execute most-selective first; output is unchanged."""
+    from tuplex_tpu.plan import logical as L
+    from tuplex_tpu.plan.physical import plan_stages
+
+    ctx.options_store.set("tuplex.optimizer.operatorReordering", True)
+    ctx.options_store.set("tuplex.optimizer.filterPushdown", False)
+    data = list(range(100))
+    ds = (ctx.parallelize(data)
+          .filter(lambda x: x % 2 == 0)      # ~50% pass
+          .filter(lambda x: x % 10 == 0))    # ~10% pass: should run first
+    stages = plan_stages(ds._op, ctx.options_store)
+    filters = [op for op in stages[0].ops
+               if isinstance(op, L.FilterOperator)]
+    assert len(filters) == 2
+    assert "% 10" in filters[0].udf.source
+    assert "% 2" in filters[1].udf.source
+    assert ds.collect() == [x for x in data if x % 10 == 0]
+    # resolver-guarded runs must not move
+    ds2 = (ctx.parallelize([1, 0, 2])
+           .filter(lambda x: 10 // x > 1)
+           .resolve(ZeroDivisionError, lambda x: True)
+           .filter(lambda x: x >= 0))
+    assert ds2.collect() == [1, 0, 2]

@@ -30,7 +30,8 @@ import math as _pymath
 from typing import Any, Callable, Optional
 
 from ..core import typesys as T
-from ..core.errors import ExceptionCode, NotCompilable
+from ..core.errors import (ExceptionCode, NotCompilable,
+                           pack_device_code)
 from ..ops import strings as S
 from ..runtime.jaxcfg import jnp
 from ..utils.reflection import UDFSource, get_udf_source
@@ -78,8 +79,6 @@ class EmitCtx:
         exceptions become host-attributable with zero extra device ops
         (reference: exception partitions carry (operator id, code) pairs
         from compiled code too)."""
-        from ..core.errors import pack_device_code
-
         return pack_device_code(int(code), self.cur_op)
 
     def raise_where(self, cond, code: ExceptionCode) -> None:

@@ -60,22 +60,25 @@ def code_for_exception(exc: BaseException) -> ExceptionCode:
     return ExceptionCode.UNKNOWN
 
 
+_CODE_INT_TO_PY = {int(c): _CODE_TO_PY.get(c) for c in ExceptionCode}
+
+
 def exception_class_for_code(code: int):
-    """Python exception class for a code (None for internal codes)."""
-    try:
-        return _CODE_TO_PY.get(ExceptionCode(code))
-    except ValueError:
-        return None
+    """Python exception class for a code (None for internal codes). Plain
+    dict lookup: enum construction showed up at 0.3s/1M rows on the
+    exact-exception exit."""
+    return _CODE_INT_TO_PY.get(code)
+
+
+_CODE_INT_TO_NAME = {
+    int(c): (_CODE_TO_PY[c].__name__ if c in _CODE_TO_PY else c.name)
+    for c in ExceptionCode
+}
 
 
 def exception_name(code: int) -> str:
-    cls = exception_class_for_code(code)
-    if cls is not None:
-        return cls.__name__
-    try:
-        return ExceptionCode(code).name
-    except ValueError:
-        return f"code{code}"
+    name = _CODE_INT_TO_NAME.get(code)
+    return name if name is not None else f"code{code}"
 
 
 # Packed device-lattice layout: exception-class code in the low byte,

@@ -206,7 +206,8 @@ static PyObject *offsets_to_matrix(PyObject *, PyObject *args) {
   Py_ssize_t n, aoff, maxw;
   if (!PyArg_ParseTuple(args, "y*y*nnn", &data, &offs, &n, &aoff, &maxw))
     return nullptr;
-  if (offs.len < static_cast<Py_ssize_t>((aoff + n + 1) * 8) || maxw < 1 ||
+  if (maxw < 1) maxw = 1;  // python fallback clamps the same way
+  if (offs.len < static_cast<Py_ssize_t>((aoff + n + 1) * 8) ||
       n < 0 || aoff < 0) {
     PyBuffer_Release(&data);
     PyBuffer_Release(&offs);

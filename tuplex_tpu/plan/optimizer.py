@@ -258,3 +258,58 @@ def filter_pushdown(ops: list) -> list:
             result[i - 1], result[i] = f, prev
             changed = True
     return result
+
+
+def reorder_filters(ops: list) -> list:
+    """Operator reordering (reference: LogicalPlan.cc's
+    tuplex.optimizer.operatorReordering, off by default there too): order
+    CONSECUTIVE runs of filters by estimated selectivity so the most
+    selective predicate runs first and shrinks the working set for the rest.
+
+    Selectivity is estimated by running each filter's UDF over its
+    operator's traced sample; rows that raise count as passing (they must
+    still reach the filter that raises for exception parity). Like the
+    reference, this is opt-in: reordering changes WHICH filter first drops
+    (or raises on) a row, so per-operator exception attribution can shift.
+    """
+    result = list(ops)
+    i = 0
+    while i < len(result):
+        if not isinstance(result[i], L.FilterOperator):
+            i += 1
+            continue
+        j = i
+        while j < len(result) and isinstance(result[j], L.FilterOperator):
+            j += 1
+        # resolvers bind to the preceding operator: a guarded run stays put
+        if j < len(result) and isinstance(
+                result[j], (L.ResolveOperator, L.IgnoreOperator)):
+            i = j + 1
+            continue
+        if j - i > 1:
+            run = result[i:j]
+            run.sort(key=_filter_selectivity)
+            result[i:j] = run
+        i = j
+    return result
+
+
+def _filter_selectivity(op) -> float:
+    """Estimated pass fraction of a filter over its traced sample (lower =
+    more selective = runs earlier); 1.0 when no sample is available."""
+    from .logical import apply_udf_python
+
+    try:
+        sample = op.parent.cached_sample()
+    except Exception:
+        return 1.0
+    if not sample:
+        return 1.0
+    passed = 0
+    for row in sample:
+        try:
+            if apply_udf_python(op.udf, row):
+                passed += 1
+        except Exception:
+            passed += 1  # must reach this filter to raise: treat as pass
+    return passed / len(sample)

@@ -416,6 +416,15 @@ def plan_stages(sink: L.LogicalOperator, options=None):
                         "tuplex.optimizer.filterBreakdown", True):
                     st.ops = split_filter_conjunctions(st.ops)
                 st.ops = filter_pushdown(st.ops)
+    # selectivity-ordered filter runs (off by default, like the reference's
+    # tuplex.optimizer.operatorReordering)
+    if options is not None and options.get_bool(
+            "tuplex.optimizer.operatorReordering", False):
+        from .optimizer import reorder_filters
+
+        for st in stages:
+            if isinstance(st, TransformStage):
+                st.ops = reorder_filters(st.ops)
     # projection pushdown into file sources (reference: csv.selectionPushdown)
     for st in stages:
         if isinstance(st, TransformStage):
