@@ -206,7 +206,7 @@ static PyObject *offsets_to_matrix(PyObject *, PyObject *args) {
   Py_ssize_t n, aoff, maxw;
   if (!PyArg_ParseTuple(args, "y*y*nnn", &data, &offs, &n, &aoff, &maxw))
     return nullptr;
-  if (maxw < 1) maxw = 1;  // python fallback clamps the same way
+  if (maxw < 0) maxw = 0;  // python fallback: w = min(max_len, maxw) >= 0
   if (offs.len < static_cast<Py_ssize_t>((aoff + n + 1) * 8) ||
       n < 0 || aoff < 0) {
     PyBuffer_Release(&data);
