@@ -131,18 +131,25 @@ _STATES = ["MA", "NY", "CA", "WA"]
 _TITLES_SALE = ["House For Sale", "Colonial house for sale",
                 "New construction house - for sale!", "Big house for sale"]
 _TITLES_RENT = ["Condo for rent", "Apartment For Rent", "Studio for rent"]
+_TITLES_SALE_CONDO = ["Condo for sale", "Downtown condo - for sale!",
+                      "Apartment for sale"]
 _TITLES_SOLD = ["House recently sold", "Sold: lovely house"]
 _PROVIDERS = ["RE/MAX", "Zillow", "Coldwell Banker", "agent"]
 
 
-def gen_row(rng: random.Random) -> dict:
+def gen_row(rng: random.Random, condo_sales: bool = False) -> dict:
     kind = rng.random()
     bd = rng.randint(1, 12)
     ba = rng.randint(1, 5)
     sqft = rng.randint(400, 9000)
     dirty = rng.random()
     if kind < 0.55:
-        title = rng.choice(_TITLES_SALE)
+        # Z2 filters type=='condo' AND offer=='sale': without condo-sale
+        # titles that cross-cell is empty and the Z2 pipeline outputs
+        # nothing (review finding — the golden test was vacuous)
+        pool = _TITLES_SALE + _TITLES_SALE_CONDO if condo_sales \
+            else _TITLES_SALE
+        title = rng.choice(pool)
         price = f"${rng.randint(100, 3000) * 1000:,}"
     elif kind < 0.8:
         title = rng.choice(_TITLES_RENT)
@@ -173,7 +180,8 @@ def gen_row(rng: random.Random) -> dict:
     }
 
 
-def generate_csv(path: str, n_rows: int, seed: int = 42) -> str:
+def generate_csv(path: str, n_rows: int, seed: int = 42,
+                 condo_sales: bool = False) -> str:
     import csv
 
     rng = random.Random(seed)
@@ -181,16 +189,17 @@ def generate_csv(path: str, n_rows: int, seed: int = 42) -> str:
         w = csv.DictWriter(fp, fieldnames=COLUMNS)
         w.writeheader()
         for _ in range(n_rows):
-            w.writerow(gen_row(rng))
+            w.writerow(gen_row(rng, condo_sales))
     return path
 
 
-def run_reference_python(path: str) -> list:
-    """Pure-CPython implementation of the same pipeline — the golden output
-    AND the interpreter baseline for bench (reference analog: the pure-python
-    comparison scripts in benchmarks/zillow)."""
+def _run_reference(path: str, type_: str, ba_fn, price_pred) -> list:
+    """Shared pure-CPython runner for the Z1/Z2 chains (they differ only in
+    the type filter, the bathrooms UDF, and the price predicate)."""
     import csv
 
+    cols = ["url", "zipcode", "address", "city", "state", "bedrooms",
+            "bathrooms", "sqft", "offer", "type", "price"]
     out = []
     with open(path, newline="") as fp:
         for row in csv.DictReader(fp):
@@ -200,21 +209,78 @@ def run_reference_python(path: str) -> list:
                 if not x["bedrooms"] < 10:
                     continue
                 x["type"] = extractType(x)
-                if x["type"] != "house":
+                if x["type"] != type_:
                     continue
                 x["zipcode"] = "%05d" % int(x["postal_code"])
                 c = x["city"]
                 x["city"] = c[0].upper() + c[1:].lower()
-                x["bathrooms"] = extractBa(x)
+                x["bathrooms"] = ba_fn(x)
                 x["sqft"] = extractSqft(x)
                 x["offer"] = extractOffer(x)
                 x["price"] = extractPrice(x)
-                if not (100000 < x["price"] <= 2e7):
+                if not price_pred(x):
                     continue
-                out.append(tuple(x[c] for c in
-                                 ["url", "zipcode", "address", "city",
-                                  "state", "bedrooms", "bathrooms", "sqft",
-                                  "offer", "type", "price"]))
+                out.append(tuple(x[c] for c in cols))
             except Exception:
                 continue
     return out
+
+
+def run_reference_python(path: str) -> list:
+    """Pure-CPython implementation of the Z1 pipeline — the golden output
+    AND the interpreter baseline for bench (reference analog: the pure-python
+    comparison scripts in benchmarks/zillow)."""
+    return _run_reference(path, "house", extractBa,
+                          lambda x: 100000 < x["price"] <= 2e7)
+
+
+# --- Z2 variant (reference: benchmarks/zillow/Z2/runtuplex.py) --------------
+
+def extractBaZ2(x):
+    """Z2's bathrooms: half-bath rounding via math.ceil (reference:
+    Z2/runtuplex.py:31-47 — the UDF is the workload spec)."""
+    import math
+
+    val = x["facts and features"]
+    max_idx = val.find(" ba")
+    if max_idx < 0:
+        max_idx = len(val)
+    s = val[:max_idx]
+    split_idx = s.rfind(",")
+    if split_idx < 0:
+        split_idx = 0
+    else:
+        split_idx += 2
+    r = s[split_idx:]
+    ba = math.ceil(2.0 * float(r)) / 2.0
+    return ba
+
+
+Z2_OUT_COLUMNS = ["url", "zipcode", "address", "city", "state", "bedrooms",
+                  "bathrooms", "sqft", "offer", "type", "price"]
+
+
+def build_pipeline_z2(ds):
+    """The Z2 chain: condo filter, sale-only price filter, file output
+    (reference: Z2/runtuplex.py:190-203 writes tocsv)."""
+    return (ds
+            .withColumn("bedrooms", extractBd)
+            .filter(lambda x: x["bedrooms"] < 10)
+            .withColumn("type", extractType)
+            .filter(lambda x: x["type"] == "condo")
+            .withColumn("zipcode", lambda x: "%05d" % int(x["postal_code"]))
+            .mapColumn("city", lambda x: x[0].upper() + x[1:].lower())
+            .withColumn("bathrooms", extractBaZ2)
+            .withColumn("sqft", extractSqft)
+            .withColumn("offer", extractOffer)
+            .withColumn("price", extractPrice)
+            .filter(lambda x: 100000 < x["price"] < 2e7
+                    and x["offer"] == "sale")
+            .selectColumns(Z2_OUT_COLUMNS))
+
+
+def run_reference_python_z2(path: str) -> list:
+    """Pure-CPython golden for the Z2 chain."""
+    return _run_reference(
+        path, "condo", extractBaZ2,
+        lambda x: 100000 < x["price"] < 2e7 and x["offer"] == "sale")

@@ -506,7 +506,9 @@ def parse_f64(bytes_, lens):
     abs_e = jnp.clip(jnp.abs(e), 0.0, 22.0).astype(jnp.int32)
     pow_abs = jnp.take(p10, abs_e)
     val_small = jnp.where(e >= 0, mant * pow_abs, mant / pow_abs)
-    val_big = mant * jnp.power(10.0, e)
+    # 0 * inf = NaN for zero mantissas with overflowing exponents ('0e400'
+    # is 0.0 in CPython): pin the zero-mantissa case
+    val_big = jnp.where(mant == 0.0, 0.0, mant * jnp.power(10.0, e))
     val = jnp.where(small, val_small, val_big)
     val = jnp.where(neg, -val, val)
 
