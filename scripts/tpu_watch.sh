@@ -21,5 +21,5 @@ print('TPU_OK init+compute_s=%.1f platform=%s sum=%d' % (time.time()-t0, ds[0].p
     echo "$ts TPU AVAILABLE — stopping watch" >> "$LOG"
     exit 0
   fi
-  sleep 300
+  sleep 1500
 done

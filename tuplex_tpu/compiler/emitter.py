@@ -891,7 +891,10 @@ class Frame:
         pattern = pat.const
         if fname == "match" and not pattern.startswith("^"):
             pattern = "^" + pattern   # re.match anchors implicitly
-        rx = compile_regex(pattern)   # NotCompilable outside the subset
+        try:
+            rx = compile_regex(pattern)   # anchored engine: capture groups
+        except NotCompilable:
+            rx = None                     # NFA below: boolean-only
         if s.base is not T.STR:
             raise NotCompilable("re.search over non-string")
         if s.valid is not None:
@@ -904,6 +907,16 @@ class Frame:
         s = materialize(s, self.ctx.b)
         self._ascii_guard(s.sbytes, s.slen)
         sb, sl = s.sbytes, s.slen
+        if rx is None:
+            # unanchored / alternation patterns: exact EXISTENCE via the
+            # bit-parallel NFA (ops/nfa.py). No capture groups — .group()
+            # raises NotCompilable and the whole UDF interprets.
+            from ..ops.nfa import compile_nfa
+
+            nfa = compile_nfa(pattern)
+            matched = nfa.match(sb, sl)
+            return CV(t=T.option(T.tuple_of(T.STR)), elts=(),
+                      valid=matched, kind="match")
         matched, suspect, gs, ge = rx.match(sb, sl)
         self.raise_where(suspect & ~matched, ExceptionCode.PYTHON_FALLBACK)
         elts = []
@@ -975,6 +988,11 @@ class Frame:
         if v.kind == "split":
             # split() always yields at least one piece
             return jnp.ones(self.ctx.b, dtype=bool)
+        if v.kind == "match":
+            # a match object is truthy exactly when the match exists (the
+            # NFA path's groupless elts=() must not fall into the tuple
+            # branch, where an empty tuple is constant-falsy)
+            return v.valid
         if v.is_const:
             return jnp.full(self.ctx.b, bool(v.const), dtype=bool)
         base = v.base

@@ -1,0 +1,275 @@
+"""Vectorized unanchored regex matching: bit-parallel Glushkov NFA.
+
+The anchored engine (ops/regex.py) compiles `^...` patterns with capture
+groups but rejects unanchored searches and alternation. This module covers
+the BOOLEAN half of that gap exactly (reference codegens re.search for
+arbitrary use, codegen/include/FunctionRegistry.h:71-205): the pattern
+becomes a Glushkov position automaton, the state set packs into ONE uint64
+lane per row, and a single `lax.scan` over byte columns advances all rows'
+state sets together:
+
+    S' = (follow(S) | FIRST) & CLASSTAB[byte]       # unanchored restart
+    matched |= S' & LAST (subject to a $-position check)
+
+NFA simulation explores every alternative simultaneously, so there is no
+backtracking approximation: `matched` is EXACT for the supported feature
+set (literals, classes, '.', alternation, groups-as-grouping, ?, *, +,
+{m,n} via expansion, ^ and $). No capture groups — a UDF that consumes
+`.group()` on this path raises NotCompilable and the whole UDF interprets.
+
+The scan body is traced once (graph cost ~P ops, not W*P), and the
+transition is pure bitwise arithmetic on [N] uint64 — TPU-vector friendly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.errors import NotCompilable
+from ..runtime.jaxcfg import jnp, lax
+from .regex import _category_spec, _in_spec, _byte_in_spec
+
+try:
+    from re import _parser as _sre
+    from re import _constants as _sc
+except ImportError:  # pragma: no cover - older layout
+    import sre_parse as _sre            # type: ignore
+    import sre_constants as _sc         # type: ignore
+
+_MAXREPEAT = _sc.MAXREPEAT
+_MAX_POSITIONS = 64   # one uint64 lane
+_MAX_EXPAND = 32      # {m,n} expansion cap
+
+
+class _Frag:
+    """Glushkov attributes of a subpattern: nullable, first/last position
+    sets (bitmasks), with follow edges accumulated in the builder."""
+
+    __slots__ = ("nullable", "first", "last")
+
+    def __init__(self, nullable: bool, first: int, last: int):
+        self.nullable = nullable
+        self.first = first
+        self.last = last
+
+
+class _Builder:
+    def __init__(self):
+        self.specs: list[tuple] = []     # position -> class spec
+        self.follow: list[int] = []      # position -> bitmask of successors
+
+    def add_position(self, spec: tuple) -> int:
+        p = len(self.specs)
+        if p >= _MAX_POSITIONS:
+            raise NotCompilable("regex too large for the NFA lane")
+        self.specs.append(spec)
+        self.follow.append(0)
+        return p
+
+    def link(self, lasts: int, firsts: int) -> None:
+        p = 0
+        while lasts:
+            if lasts & 1:
+                self.follow[p] |= firsts
+            lasts >>= 1
+            p += 1
+
+    # -- construction over the sre parse tree ------------------------------
+    def build_seq(self, seq) -> _Frag:
+        frag = _Frag(True, 0, 0)
+        for term in seq:
+            nxt = self.build_term(term)
+            self.link(frag.last, nxt.first)
+            frag = _Frag(
+                frag.nullable and nxt.nullable,
+                frag.first | (nxt.first if frag.nullable else 0),
+                nxt.last | (frag.last if nxt.nullable else 0),
+            )
+        return frag
+
+    def build_term(self, term) -> _Frag:
+        op, av = term
+        opn = str(op)
+        if opn.endswith("NOT_LITERAL"):
+            p = self.add_position((("neg",), ("lit", av)))
+            return _Frag(False, 1 << p, 1 << p)
+        if opn.endswith("LITERAL"):
+            p = self.add_position((("lit", av),))
+            return _Frag(False, 1 << p, 1 << p)
+        if opn.endswith("ANY"):
+            p = self.add_position((("neg",), ("lit", 10)))   # '.'
+            return _Frag(False, 1 << p, 1 << p)
+        if opn.endswith("IN"):
+            p = self.add_position(_in_spec(av))
+            return _Frag(False, 1 << p, 1 << p)
+        if opn.endswith("BRANCH"):
+            _, branches = av
+            frag = None
+            for b in branches:
+                f = self.build_seq(list(b))
+                frag = f if frag is None else _Frag(
+                    frag.nullable or f.nullable,
+                    frag.first | f.first, frag.last | f.last)
+            return frag if frag is not None else _Frag(True, 0, 0)
+        if opn.endswith("SUBPATTERN"):
+            g, addf, delf, sub = av
+            if addf or delf:
+                raise NotCompilable("regex inline flags")
+            return self.build_seq(list(sub))
+        if opn.endswith("MAX_REPEAT") or opn.endswith("MIN_REPEAT"):
+            # MIN (lazy) repeats: laziness changes which match python picks,
+            # not WHETHER one exists — boolean existence is identical
+            mn, mx, item = av
+            sub = list(item)
+            if mx != _MAXREPEAT and mx > _MAX_EXPAND:
+                raise NotCompilable("regex repeat bound too large")
+            if mn > _MAX_EXPAND:
+                raise NotCompilable("regex repeat bound too large")
+            frag = _Frag(True, 0, 0)
+            # m mandatory copies
+            for _ in range(mn):
+                nxt = self.build_seq(sub)
+                self.link(frag.last, nxt.first)
+                frag = _Frag(frag.nullable and nxt.nullable,
+                             frag.first | (nxt.first if frag.nullable else 0),
+                             nxt.last | (frag.last if nxt.nullable else 0))
+            if mx == _MAXREPEAT:
+                # one looping copy (E* after the mandatory prefix)
+                nxt = self.build_seq(sub)
+                self.link(frag.last, nxt.first)
+                self.link(nxt.last, nxt.first)
+                frag = _Frag(frag.nullable,
+                             frag.first | (nxt.first if frag.nullable else 0),
+                             frag.last | nxt.last)
+            else:
+                for _ in range(mx - mn):
+                    nxt = self.build_seq(sub)
+                    self.link(frag.last, nxt.first)
+                    frag = _Frag(frag.nullable,
+                                 frag.first |
+                                 (nxt.first if frag.nullable else 0),
+                                 frag.last | nxt.last)
+            return frag
+        raise NotCompilable(f"regex op {op} (NFA)")
+
+
+class NFARegex:
+    """match(bytes [N, W], lens [N]) -> matched [N] bool (exact)."""
+
+    def __init__(self, pattern: str, anchored_start: bool = False):
+        try:
+            tree = _sre.parse(pattern)
+        except Exception as e:
+            raise NotCompilable(f"regex parse: {e}")
+        import re as _pyre
+
+        if tree.state.flags & ~_pyre.UNICODE.value:
+            raise NotCompilable("regex flags")
+        if any(ord(c) > 127 for c in pattern):
+            raise NotCompilable("non-ASCII regex pattern")
+        terms = list(tree)
+        self.anchored_start = anchored_start
+        self.anchored_end = False
+        # leading ^ / trailing $ (only at the top level)
+        # NB: the sre op name for anchors is exactly "AT" — endswith would
+        # also hit MAX_REPEAT
+        if terms and str(terms[0][0]) == "AT":
+            name = str(terms[0][1])
+            if name.endswith("AT_BEGINNING"):
+                self.anchored_start = True
+                terms = terms[1:]
+            else:
+                raise NotCompilable(f"regex anchor {terms[0][1]}")
+        if terms and str(terms[-1][0]) == "AT":
+            name = str(terms[-1][1])
+            if name.endswith("AT_END"):
+                self.anchored_end = True
+                terms = terms[:-1]
+            else:
+                raise NotCompilable(f"regex anchor {terms[-1][1]}")
+        if any(str(op) == "AT" for op, _ in terms):
+            raise NotCompilable("regex anchor mid-pattern")
+        b = _Builder()
+        frag = b.build_seq(terms)
+        self.nullable = frag.nullable
+        self.first = frag.first
+        self.last = frag.last
+        self.follow = list(b.follow)
+        self.n_pos = len(b.specs)
+        # CLASSTAB[c] = bitmask of positions whose class contains byte c
+        tab = np.zeros(256, dtype=np.uint64)
+        for p, spec in enumerate(b.specs):
+            for c in range(256):
+                if _byte_in_spec(c, spec):
+                    tab[c] |= np.uint64(1 << p)
+        self._classtab = tab
+        self._follow_np = np.asarray(self.follow, dtype=np.uint64)
+
+    def match(self, bytes_, lens):
+        n, w = bytes_.shape
+        classtab = jnp.asarray(self._classtab)
+        first = jnp.uint64(self.first)
+        last = jnp.uint64(self.last)
+        follow_masks = [jnp.uint64(m) for m in self.follow]
+        lens64 = lens.astype(jnp.int64)
+        # $ also matches just before one trailing '\n' (python semantics)
+        lastpos = jnp.clip(lens64 - 1, 0, max(w - 1, 0))
+        trailing_nl = (lens64 > 0) & (
+            jnp.take_along_axis(bytes_, lastpos[:, None].astype(jnp.int32),
+                                axis=1)[:, 0] == 10)
+        end_at = jnp.where(trailing_nl, lens64 - 1, lens64)
+
+        if self.nullable:
+            # an empty match exists at position 0 (and, for '$'-anchored
+            # searches, at the end — which every string has). Only the
+            # doubly-anchored nullable case ('^$', '^a*$') constrains it:
+            # the empty match must sit at BOTH ends, i.e. end_at == 0.
+            if self.anchored_start and self.anchored_end:
+                matched0 = end_at == 0
+            else:
+                matched0 = jnp.ones(n, dtype=bool)
+        else:
+            matched0 = jnp.zeros(n, dtype=bool)
+
+        xs = (jnp.transpose(bytes_).astype(jnp.int32),
+              jnp.arange(w, dtype=jnp.int64))
+
+        def step(carry, x):
+            S, matched = carry
+            byte_col, j = x
+            cm = jnp.take(classtab, byte_col)
+            inb = j < lens64
+            nxt = jnp.zeros(n, dtype=jnp.uint64)
+            for p, fm in enumerate(follow_masks):
+                bit = (S >> np.uint64(p)) & jnp.uint64(1)
+                nxt = nxt | jnp.where(bit.astype(bool), fm, jnp.uint64(0))
+            if self.anchored_start:
+                seed = jnp.where(j == 0, first, jnp.uint64(0))
+            else:
+                seed = first          # restart at every position
+            S2 = (nxt | seed) & cm
+            S2 = jnp.where(inb, S2, jnp.uint64(0))
+            hit = (S2 & last) != 0
+            if self.anchored_end:
+                # python's $ matches at end-of-string AND just before one
+                # trailing newline — a match may consume that newline too
+                hit = hit & ((j + 1 == lens64) | (j + 1 == end_at))
+            return (S2, matched | hit), None
+
+        (S, matched), _ = lax.scan(
+            step, (jnp.zeros(n, dtype=jnp.uint64), matched0), xs)
+        return matched
+
+
+_NFA_CACHE: dict[tuple, NFARegex] = {}
+
+
+def compile_nfa(pattern: str, anchored_start: bool = False) -> NFARegex:
+    key = (pattern, anchored_start)
+    rx = _NFA_CACHE.get(key)
+    if rx is None:
+        rx = NFARegex(pattern, anchored_start)
+        if len(_NFA_CACHE) > 256:
+            _NFA_CACHE.clear()
+        _NFA_CACHE[key] = rx
+    return rx
