@@ -90,7 +90,7 @@ class LocalBackend:
         self.options = options
         self.jit_cache = JitCache(options.get_int("tuplex.tpu.jitCacheSize", 128))
         self.interpret_only = options.get_bool("tuplex.tpu.interpretOnly")
-        self.bucket_mode = options.get_str("tuplex.tpu.padBucketing", "pow2")
+        self.bucket_mode = options.get_str("tuplex.tpu.padBucketing", "q8")
         self._not_compilable: set[str] = set()
         from ..runtime.spill import MemoryManager
 

@@ -30,7 +30,7 @@ from ..core.errors import TuplexException
 from ..core.row import Row
 from ..plan import logical as L
 from ..runtime import columns as C
-from .vfs import VirtualFileSystem
+from .vfs import VirtualFileSystem, files_fingerprint
 
 DEFAULT_NULL_VALUES = ("",)
 _DELIM_CANDIDATES = (",", ";", "|", "\t")
@@ -215,6 +215,19 @@ class CSVSourceOperator(L.LogicalOperator):
 
     def schema(self) -> T.RowType:
         return self._raw_schema
+
+    def source_key(self):
+        # the stat OUTCOME (delimiter/header/columns/null values/speculated
+        # types) captures every sniffing parameter incl. per-call overrides
+        # and type hints — two calls that sniff identically may share
+        stat = self.stat
+        return files_fingerprint(
+            self.files, extra=(
+                self.pattern, stat.delimiter, stat.has_header,
+                tuple(stat.columns), tuple(stat.null_values),
+                tuple(t.name for t in stat.types),
+                tuple(t.name for t in stat.general_types),
+                len(stat.sample_rows)))
 
     def sample(self) -> list[Row]:
         k = self.stat.num_columns
@@ -510,6 +523,9 @@ class TextSourceOperator(L.LogicalOperator):
         self._schema = T.row_of(["_0"], [T.STR])
         self._sample_lines: Optional[list[str]] = None
 
+    def source_key(self):
+        return files_fingerprint(self.files, extra=self.pattern)
+
     def schema(self) -> T.RowType:
         return self._schema
 
@@ -551,14 +567,8 @@ _STAT_CACHE_CAP = 64
 
 
 def _file_sig(path: str):
-    """(path, size, mtime_ns) when cheaply stat-able; None => uncacheable."""
-    import os
-
-    try:
-        st = os.stat(path)
-        return (path, st.st_size, st.st_mtime_ns)
-    except OSError:
-        return None
+    """Stat identity when cheaply stat-able; None => uncacheable."""
+    return files_fingerprint([path])
 
 
 def make_csv_operator(options, pattern: str, columns=None, header=None,

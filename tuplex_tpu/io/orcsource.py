@@ -15,7 +15,7 @@ from ..core.errors import TuplexException
 from ..core.row import Row
 from ..plan import logical as L
 from ..runtime import columns as C
-from .vfs import VirtualFileSystem
+from .vfs import VirtualFileSystem, files_fingerprint
 
 
 def _arrow_to_type(at) -> T.Type:
@@ -91,6 +91,10 @@ class ORCSourceOperator(L.LogicalOperator):
         self.user_cols = list(columns) if columns else None
         self._schema: Optional[T.RowType] = None
         self._sample: Optional[list[Row]] = None
+
+    def source_key(self):
+        return files_fingerprint(
+            self.files, extra=(self.pattern, self.user_cols))
 
     def _load_meta(self):
         if self._schema is not None:

@@ -311,3 +311,24 @@ def _default_backend(scheme: str):
     except ImportError:
         return None
     return None
+
+
+def files_fingerprint(files, extra=None) -> Optional[str]:
+    """Cheap content identity for a list of LOCAL files: (path, mtime_ns,
+    size) per file, hashed with any extra context. Returns None when any
+    file can't be stat'd locally (remote URIs: no cheap stable identity),
+    which disables cross-job plan memoization for that source."""
+    import hashlib
+
+    h = hashlib.sha256()
+    try:
+        for path in files:
+            if "://" in str(path):
+                return None
+            st = os.stat(path)
+            h.update(f"{path}|{st.st_mtime_ns}|{st.st_size};".encode())
+    except OSError:
+        return None
+    if extra is not None:
+        h.update(repr(extra).encode())
+    return h.hexdigest()[:24]

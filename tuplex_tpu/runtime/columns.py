@@ -372,11 +372,30 @@ def _placeholder(t: T.Type) -> Any:
 # device staging
 # ---------------------------------------------------------------------------
 
-def bucket_size(n: int, mode: str = "pow2", minimum: int = 8) -> int:
+def bucket_size(n: int, mode: str = "q8", minimum: int = 8) -> int:
+    """Padded size for a real size `n`.
+
+    "pow2"  — next power of two. Up to ~50% padding waste (round 2 measured
+              31% wasted kernel time on the 100k-row bench batch padded to
+              131072), at most 1 jit shape variant per octave.
+    "q8"    — quantize to 1/8 of the pow2 FLOOR: waste <= 12.5% (typically
+              ~6%), at most 8 shape variants per octave. The persistent
+              compile cache makes the extra variants a one-time cost; this
+              is the default.
+    "exact" — no padding (one executable per distinct partition size; only
+              sensible for single-batch jobs or tests).
+    """
     if mode == "exact" or n <= 0:
         return max(n, 1)
-    b = max(minimum, 1 << int(math.ceil(math.log2(max(n, 1)))))
-    return b
+    n = max(n, minimum)
+    p2 = 1 << (n - 1).bit_length()          # pow2 ceil
+    # "fixed" was a documented alias for pow2 behavior; unknown modes also
+    # degrade to pow2 (the conservative shape policy) rather than silently
+    # changing padding semantics
+    if mode != "q8" or n == p2:
+        return p2
+    q = max(minimum, (p2 >> 1) >> 3)        # pow2floor / 8
+    return ((n + q - 1) // q) * q
 
 
 def pad_to(arr: np.ndarray, n: int, axis: int = 0) -> np.ndarray:
@@ -692,8 +711,8 @@ def key_signature_matrix(part: Partition, cis: Sequence[int],
 
 
 def harmonize_partitions(parts: list) -> list:
-    """Pad every partition's str leaves to the dataset-wide pow2 width and
-    align row-count buckets, so ONE jit executable serves every partition
+    """Pad every partition's str leaves to the dataset-wide bucketed width
+    and align row-count buckets, so ONE jit executable serves every partition
     (reference analog: one LLVM module per stage regardless of partition
     count). Without this each partition's distinct shapes would recompile."""
     if not parts:
@@ -704,7 +723,7 @@ def harmonize_partitions(parts: list) -> list:
             if isinstance(leaf, StrLeaf):
                 widths[path] = max(widths.get(path, 1), leaf.width)
     for path in widths:
-        widths[path] = bucket_size(widths[path], "pow2", minimum=8)
+        widths[path] = bucket_size(widths[path], minimum=8)
     for p in parts:
         for path, w in widths.items():
             leaf = p.leaves.get(path)

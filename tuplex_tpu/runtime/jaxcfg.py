@@ -18,9 +18,31 @@ jax.config.update("jax_enable_x64", True)
 # milliseconds across processes. Reference analog: LLVMOptimizer caches per
 # (stage, schema) in-process only; on TPU the compile is remote so a disk
 # cache is the right redesign.
+def _host_tag() -> str:
+    """Cache-partition tag for this host's CPU. XLA:CPU AOT results encode
+    target machine features; loading artifacts compiled on a different
+    machine warns about SIGILL risk (observed with a shared cache dir:
+    +prefer-no-scatter/+avx512* mismatches). TPU artifacts are host-neutral
+    but live happily in the per-host partition too."""
+    import hashlib
+    import platform
+
+    tag = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as fp:
+            for line in fp:
+                if line.startswith("flags"):
+                    tag += hashlib.sha256(line.encode()).hexdigest()[:8]
+                    break
+    except OSError:
+        pass
+    return tag
+
+
 _cache_dir = os.environ.get(
     "TUPLEX_COMPILE_CACHE",
-    os.path.join(os.path.expanduser("~"), ".cache", "jax_comp_cache"))
+    os.path.join(os.path.expanduser("~"), ".cache",
+                 f"jax_comp_cache_{_host_tag()}"))
 if _cache_dir and _cache_dir != "0":
     try:
         os.makedirs(_cache_dir, exist_ok=True)
