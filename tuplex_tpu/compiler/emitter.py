@@ -171,9 +171,9 @@ class Frame:
         # every [B, W] intermediate that fed any error condition — measured
         # ~0.5s of a 1.5s zillow batch on XLA-CPU (CPU-only: see
         # jaxcfg.fusion_barriers_enabled)
-        from ..runtime.jaxcfg import fusion_barriers_enabled, lax
+        from ..runtime.jaxcfg import stmt_barriers_enabled, lax
 
-        if fusion_barriers_enabled():
+        if stmt_barriers_enabled():
             self.ctx.err, self.ctx.active = lax.optimization_barrier(
                 (self.ctx.err, self.ctx.active))
 
@@ -193,9 +193,9 @@ class Frame:
         is free at runtime; fusion still happens within each statement.
         CPU-only (see jaxcfg.fusion_barriers_enabled)."""
         from .values import cv_arrays, cv_rebuild
-        from ..runtime.jaxcfg import fusion_barriers_enabled, lax
+        from ..runtime.jaxcfg import stmt_barriers_enabled, lax
 
-        if not fusion_barriers_enabled():
+        if not stmt_barriers_enabled():
             return
         leaves: list = []
         items = list(self.env.items())

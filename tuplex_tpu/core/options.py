@@ -74,6 +74,7 @@ DEFAULTS: dict[str, str] = {
     # --- TPU-native keys ---------------------------------------------------
     "tuplex.tpu.deviceBatchSize": "1048576",    # rows per device dispatch
     "tuplex.tpu.padBucketing": "q8",            # q8 | pow2 | exact
+    "tuplex.tpu.filterCompaction": "true",      # selection-vector compaction
     "tuplex.tpu.maxStrBytes": "4096",           # cap for fixed-width str cols
     "tuplex.tpu.meshShape": "auto",             # e.g. "8" or "4x2"
     "tuplex.tpu.meshAxes": "data",

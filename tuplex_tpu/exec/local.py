@@ -86,12 +86,20 @@ class JitCache:
 
 
 class LocalBackend:
+    # selection-vector compaction is correct only where the plain dispatch/
+    # collect path consumes '#rowidx' outputs; the mesh backend shards
+    # batches across devices and keeps full-length outputs instead
+    supports_compaction = True
+
     def __init__(self, options):
         self.options = options
         self.jit_cache = JitCache(options.get_int("tuplex.tpu.jitCacheSize", 128))
         self.interpret_only = options.get_bool("tuplex.tpu.interpretOnly")
         self.bucket_mode = options.get_str("tuplex.tpu.padBucketing", "q8")
         self._not_compilable: set[str] = set()
+        # stages whose sample-estimated compaction bucket overflowed: re-run
+        # and remember to build without compaction from then on
+        self._compaction_off: set[str] = set()
         from ..runtime.spill import MemoryManager
 
         self.mm = MemoryManager(
@@ -148,23 +156,14 @@ class LocalBackend:
         device_fn = None
         in_schema = first_part.schema if first_part is not None else None
         skey = stage.key() + "/" + (in_schema.name if in_schema else "")
+        use_comp = (self.supports_compaction
+                    and self.options.get_bool(
+                        "tuplex.tpu.filterCompaction", True)
+                    and stage.key() not in self._compaction_off)
         if not self.interpret_only and skey not in self._not_compilable \
                 and in_schema is not None:
-            try:
-                raw_fn = stage.build_device_fn(in_schema)
-                device_fn = self.jit_cache.get_or_build(
-                    ("stagefn", skey), lambda: self._jit_stage_fn(raw_fn))
-            except NotCompilable:
-                self._not_compilable.add(skey)
-                device_fn = None
-            except Exception as e:  # any build failure: interpreter is
-                from ..utils.logging import get_logger  # always correct
-
-                get_logger("exec").warning(
-                    "stage build failed (%s: %s); falling back to the "
-                    "interpreter", type(e).__name__, e)
-                self._not_compilable.add(skey)
-                device_fn = None
+            device_fn, use_comp = self._build_stage_fn(
+                stage, in_schema, skey, use_comp)
 
         out_parts: list[C.Partition] = []
         exceptions: list[ExceptionRecord] = []
@@ -208,7 +207,7 @@ class LocalBackend:
                         type(e).__name__, e)
                     try:
                         _, outs2, d2 = self._dispatch_partition(
-                            part, device_fn, skey)
+                            part, device_fn, skey, use_comp)
                         outp, excs, m = self._collect_partition(
                             stage, part, outs2, d2)
                     except Exception as e2:
@@ -250,8 +249,15 @@ class LocalBackend:
                 break
             if skey in self._not_compilable:
                 device_fn = None
+            elif use_comp and stage.key() in self._compaction_off:
+                # an earlier partition overflowed (or failed to trace) under
+                # compaction: rebuild the plain fn instead of paying the
+                # dispatch-then-redo cost for every remaining partition
+                device_fn, use_comp = self._build_stage_fn(
+                    stage, in_schema, skey, False)
             self.mm.touch(part)
-            window.append(self._dispatch_partition(part, device_fn, skey))
+            window.append(self._dispatch_partition(part, device_fn, skey,
+                                                    use_comp))
             if len(window) >= window_size:
                 collect_one()
         while window:
@@ -268,7 +274,39 @@ class LocalBackend:
         return StageResult(out_parts, exceptions, metrics)
 
     # ------------------------------------------------------------------
-    def _dispatch_partition(self, part: C.Partition, device_fn, skey: str):
+    def _build_stage_fn(self, stage, in_schema, skey: str, use_comp: bool):
+        """Build + jit the fast-path fn. A build failure under compaction
+        retries without it (an opt-in optimization must never demote the
+        stage to the interpreter); only a plain build failure does that."""
+        while True:
+            try:
+                raw_fn = stage.build_device_fn(in_schema,
+                                               compaction=use_comp)
+                return self.jit_cache.get_or_build(
+                    ("stagefn", skey, use_comp),
+                    lambda: self._jit_stage_fn(raw_fn)), use_comp
+            except NotCompilable:
+                self._not_compilable.add(skey)
+                return None, use_comp
+            except Exception as e:
+                from ..utils.logging import get_logger
+
+                if use_comp:
+                    get_logger("exec").warning(
+                        "stage build failed under compaction (%s: %s); "
+                        "retrying without", type(e).__name__, e)
+                    self._compaction_off.add(stage.key())
+                    use_comp = False
+                    continue
+                get_logger("exec").warning(
+                    "stage build failed (%s: %s); falling back to the "
+                    "interpreter", type(e).__name__, e)
+                self._not_compilable.add(skey)
+                return None, use_comp
+
+    # ------------------------------------------------------------------
+    def _dispatch_partition(self, part: C.Partition, device_fn, skey: str,
+                            use_comp: bool = False):
         """Stage the batch and launch the device call WITHOUT blocking
         (jax dispatch is async; the result is awaited in _collect_partition).
         Returns (part, pending_outs | None, dispatch_seconds)."""
@@ -276,14 +314,19 @@ class LocalBackend:
             return (part, None, 0.0)
         t0 = time.perf_counter()
         batch = C.stage_partition(part, self.bucket_mode)
-        cache_key = ("stagefn", skey)
+        cache_key = ("stagefn", skey, use_comp)
         spec = batch.spec()                     # jit retraces per shape
         first_call = not self.jit_cache.was_traced(cache_key, spec)
         try:
             outs = device_fn(batch.arrays)
             self.jit_cache.note_traced(cache_key, spec)
         except NotCompilable:
-            # surfaces at TRACE time (first call): route to interpreter
+            # surfaces at TRACE time (first call): route to interpreter —
+            # but first drop compaction if it was on (it may be the culprit;
+            # the per-partition loop rebuilds the plain fn)
+            if use_comp:
+                self._compaction_off.add(skey.split("/", 1)[0])
+                return (part, None, time.perf_counter() - t0)
             self._not_compilable.add(skey)
             return (part, None, time.perf_counter() - t0)
         except Exception as e:
@@ -291,6 +334,13 @@ class LocalBackend:
                 raise  # executed before: a real runtime failure
             from ..utils.logging import get_logger
 
+            if use_comp:
+                get_logger("exec").warning(
+                    "stage trace failed under compaction (%s: %s); "
+                    "disabling compaction for the stage",
+                    type(e).__name__, e)
+                self._compaction_off.add(skey.split("/", 1)[0])
+                return (part, None, time.perf_counter() - t0)
             get_logger("exec").warning(
                 "stage trace failed (%s: %s); falling back to the "
                 "interpreter", type(e).__name__, e)
@@ -314,9 +364,43 @@ class LocalBackend:
         # General-tier codes overwrite fast-path ones (supertype decode is
         # the authoritative python-semantics run).
         device_codes: dict[int, tuple[int, int]] = {}
+        src_map = None
         if pending_outs is not None:
             t0 = time.perf_counter()
             outs = jax.device_get(pending_outs)
+            rowidx = outs.pop("#rowidx", None)
+            ovf = outs.pop("#overflow", None)
+            if rowidx is not None and bool(np.asarray(ovf)):
+                # the sample under-estimated this filter's survivors and the
+                # compaction bucket overflowed: results are unusable. Re-run
+                # the partition without compaction and disable it for the
+                # stage (reference analog: speculation failure -> general
+                # path; here the failure is a SIZE speculation)
+                from ..utils.logging import get_logger
+
+                get_logger("exec").warning(
+                    "compaction bucket overflow (stage %s); re-running "
+                    "partition without compaction", stage.key()[:8])
+                self._compaction_off.add(stage.key())
+                nkey = ("stagefn", stage.key() + "/" + part.schema.name,
+                        False)
+                nfn = self.jit_cache.get_or_build(
+                    nkey, lambda: self._jit_stage_fn(
+                        stage.build_device_fn(part.schema,
+                                              compaction=False)))
+                batch = C.stage_partition(part, self.bucket_mode)
+                outs = jax.device_get(nfn(batch.arrays))
+                outs.pop("#rowidx", None)
+                outs.pop("#overflow", None)
+                rowidx = None
+            if rowidx is not None:
+                # inverse map: original row i -> compact slot j (ascending
+                # original order is preserved by compaction, so merge order
+                # is unaffected)
+                rowidx = np.asarray(rowidx)
+                jpos = np.nonzero(rowidx < n)[0]
+                src_map = np.full(n, -1, dtype=np.int64)
+                src_map[rowidx[jpos]] = jpos
             metrics["fast_path_s"] = dispatch_s + time.perf_counter() - t0
             err = np.asarray(outs.pop("#err"))[:n]
             keep = np.asarray(outs.pop("#keep"))[:n]
@@ -401,7 +485,8 @@ class LocalBackend:
         exceptions = [exc_by_row[i] for i in sorted(exc_by_row)]
         metrics["slow_path_s"] = time.perf_counter() - t0
 
-        outp = self._merge(stage, part, compiled_ok, out_arrays, resolved)
+        outp = self._merge(stage, part, compiled_ok, out_arrays, resolved,
+                           src_map=src_map)
         return outp, exceptions, metrics
 
     # ------------------------------------------------------------------
@@ -497,7 +582,8 @@ class LocalBackend:
     # ------------------------------------------------------------------
     def _merge(self, stage: TransformStage, part: C.Partition,
                compiled_ok: np.ndarray, out_arrays: dict,
-               resolved: dict[int, Row]) -> C.Partition:
+               resolved: dict[int, Row],
+               src_map: np.ndarray | None = None) -> C.Partition:
         """Positional merge-in-order (reference: ResolveTask.cc:238-283).
 
         The output schema is derived from the ACTUAL device arrays (never the
@@ -532,13 +618,18 @@ class LocalBackend:
         from ..plan.physical import runtime_output_columns
 
         out_cols = runtime_output_columns(part.schema, stage.ops)
+        n_full = n if src_map is None else \
+            int(next(iter(out_arrays.values())).shape[0])
         full = C.partition_from_result_arrays(
-            out_arrays, n, columns=out_cols,
+            out_arrays, n_full, columns=out_cols,
             start_index=part.start_index)
         comp_out = np.asarray([k for k, (_, src, _) in enumerate(emit_rows)
                                if src is not None], dtype=np.int64)
         comp_src = np.asarray([src for (_, src, _) in emit_rows
                                if src is not None], dtype=np.int64)
+        if src_map is not None and comp_src.size:
+            # compacted device outputs: original position -> compact slot
+            comp_src = src_map[comp_src]
         outp = C.gather_partition(full, comp_out, comp_src, m)
         out_schema = outp.schema
 

@@ -27,6 +27,11 @@ class MultiHostBackend(LocalBackend):
     and unchanged under multi-host jax.distributed initialization.
     """
 
+    # selection-vector compaction computes a global nonzero() over the batch;
+    # under shard_map that would need a cross-device exchange to stay
+    # load-balanced, so the mesh path keeps full-length outputs
+    supports_compaction = False
+
     def __init__(self, options):
         super().__init__(options)
         import jax

@@ -85,7 +85,8 @@ class TransformStage:
 
     # ------------------------------------------------------------------
     def build_device_fn(self, input_schema: Optional[T.RowType] = None,
-                        general: bool = False) -> Callable:
+                        general: bool = False,
+                        compaction: bool = False) -> Callable:
         """The fused fast-path function: staged arrays -> output arrays +
         '#err' + '#keep'. Raises NotCompilable if any fused UDF can't compile
         (the backend then interprets every row).
@@ -98,7 +99,18 @@ class TransformStage:
         types columns under the general-case (supertype) schema so normal-
         case violations stay vectorized before any per-row python
         (reference: StageBuilder.cc:1145 generateResolveCodePath;
-        ResolveTask.h:31-98 tries resolve_f before the interpreter)."""
+        ResolveTask.h:31-98 tries resolve_f before the interpreter).
+
+        `compaction=True` inserts selection-vector compaction after
+        selective filters: surviving rows are gathered to the front of a
+        smaller (sample-estimated, bucketed) batch so every downstream op
+        touches fewer rows — the vectorized-engine analog of the
+        reference's per-row short-circuit on filtered rows (its LLVM row
+        loop simply skips them; a SIMD batch can't, so we shrink the
+        batch). Outputs gain '#rowidx' ([B'] original positions, ascending;
+        sentinel=padded input size for dead slots) and '#overflow' (bool:
+        survivors exceeded the estimated bucket — host must discard and
+        re-run without compaction)."""
         schema = input_schema if input_schema is not None else self.input_schema
         ops = [op for op in self.ops
                if not isinstance(op, (L.ResolveOperator, L.IgnoreOperator,
@@ -112,6 +124,8 @@ class TransformStage:
                 for op in ops):
             raise NotCompilable("stage has no general-case decode")
 
+        plan = _compaction_plan(ops) if (compaction and not general) else {}
+
         def fn(arrays: dict):
             b = arrays["#rowvalid"].shape[0]
             ctx = EmitCtx(b, arrays["#rowvalid"], seed=arrays.get("#seed"))
@@ -120,15 +134,39 @@ class TransformStage:
             from ..runtime.columns import user_columns
 
             names = user_columns(schema)
+            rowidx = None          # [B'] original positions after compaction
+            full_err = None        # [b] error codes incl. compacted-away rows
+            overflow = None
+            bcur = b
             for op in ops:
                 ctx.cur_op = op.id
                 row, keep, names = _emit_op(ctx, op, row, keep, names,
                                             general=general)
                 row, keep = _fusion_barrier(ctx, row, keep)
-            outs, out_t = result_arrays(row, b)
+                frac = plan.get(op.id)
+                if frac is not None and bcur >= 8192:
+                    from ..runtime.columns import bucket_size
+
+                    target = int(b * frac * _COMPACT_MARGIN) + 64
+                    b2 = bucket_size(min(bcur, target), "q8")
+                    if b2 < bcur:
+                        (row, keep, rowidx, full_err,
+                         overflow) = _compact_rows(ctx, row, keep, rowidx,
+                                                   full_err, overflow,
+                                                   b2, b)
+                        bcur = b2
+            outs, out_t = result_arrays(row, bcur)
             outs = dict(outs)
-            outs["#err"] = ctx.err
-            outs["#keep"] = keep & (ctx.err == 0)
+            fin = keep & (ctx.err == 0)
+            if rowidx is None:
+                outs["#err"] = ctx.err
+                outs["#keep"] = fin
+            else:
+                outs["#err"] = full_err.at[rowidx].set(ctx.err, mode="drop")
+                outs["#keep"] = jnp.zeros(b, dtype=bool).at[rowidx].set(
+                    fin, mode="drop")
+                outs["#rowidx"] = rowidx
+                outs["#overflow"] = overflow
             return outs
 
         return fn
@@ -162,6 +200,105 @@ def _fusion_barrier(ctx: EmitCtx, row: CV, keep):
     row2 = cv_rebuild(row, it)
     keep2, ctx.err, ctx.active = out[n_row], out[n_row + 1], out[n_row + 2]
     return row2, keep2
+
+
+_COMPACT_MARGIN = 1.15   # headroom over the sample estimate (~9 sigma for a
+_COMPACT_GATHER = 0.5    # 1000-row sample); gather cost in per-op-pass units
+
+
+def _compaction_plan(ops) -> dict[int, float]:
+    """Choose WHERE to insert selection-vector compactions.
+
+    Returns op.id -> estimated live fraction (relative to the stage input
+    sample) for the chosen filters. Selection is a small exhaustive search
+    over filter subsets with a unit-cost-per-op model: each operator costs
+    its current batch fraction, each compaction costs a gather
+    (_COMPACT_GATHER) at the pre-compaction fraction. A greedy first-filter
+    compaction can block a much better later one (measured on zillow: the
+    72.8% bedrooms filter starved the 53.3% type filter), hence the global
+    search. Estimates come from the same operator sampling that drives type
+    speculation (reference: TraceVisitor branch counts feed its cost
+    decisions the same way)."""
+    try:
+        base_op = next((op.parents[0] for op in ops if op.parents), None)
+        if base_op is None:
+            return {}
+        base = len(base_op.cached_sample())
+        if base < 32:
+            return {}
+        fracs = {}   # position in ops -> cumulative live fraction after it
+        for k, op in enumerate(ops):
+            if isinstance(op, L.FilterOperator):
+                fracs[k] = len(op.cached_sample()) / base
+        # candidates must leave >=2 real compute ops downstream
+        cand = [k for k in fracs
+                if sum(1 for o in ops[k + 1:]
+                       if not isinstance(o, L.SelectColumnsOperator)) >= 2]
+        cand = cand[:10]
+        if not cand:
+            return {}
+
+        def cost(subset) -> float:
+            factor, total = 1.0, 0.0
+            for k, op in enumerate(ops):
+                total += factor
+                if k in subset:
+                    # bucketed batch after compacting here (~6% pad waste)
+                    new = min(factor,
+                              fracs[k] * _COMPACT_MARGIN * 1.06 + 0.01)
+                    if new < factor:
+                        total += _COMPACT_GATHER * factor
+                        factor = new
+            return total
+
+        best, best_cost = (), cost(())
+        import itertools as _it
+
+        for r in (1, 2, 3):
+            for subset in _it.combinations(cand, r):
+                c = cost(set(subset))
+                if c < best_cost - 1e-9:
+                    best, best_cost = subset, c
+        return {ops[k].id: fracs[k] for k in best}
+    except Exception:
+        return {}
+
+
+def _compact_rows(ctx: EmitCtx, row: CV, keep, rowidx, full_err, overflow,
+                  b2: int, full_b: int):
+    """Gather live rows (keep & no error) to the front of a [b2] batch.
+
+    Maintains: `rowidx` [b2] original input positions (ascending; sentinel
+    full_b in dead slots), `full_err` [full_b] error codes for rows that
+    left the batch (their dual-mode routing must survive compaction), and
+    `overflow` (live count exceeded b2 — results are unusable and the host
+    re-runs the partition without compaction)."""
+    from ..compiler.values import cv_arrays, cv_rebuild
+
+    bcur = keep.shape[0]
+    cur_orig = rowidx if rowidx is not None \
+        else jnp.arange(bcur, dtype=jnp.int32)
+    if full_err is None:
+        full_err = ctx.err
+    else:
+        full_err = full_err.at[cur_orig].set(ctx.err, mode="drop")
+    live = keep & (ctx.err == 0)
+    idx = jnp.nonzero(live, size=b2, fill_value=bcur)[0].astype(jnp.int32)
+    count = jnp.sum(live.astype(jnp.int32))
+    ovf = count > b2
+    overflow = ovf if overflow is None else (overflow | ovf)
+    valid = jnp.arange(b2, dtype=jnp.int32) < count
+    safe = jnp.minimum(idx, bcur - 1)
+    new_rowidx = jnp.where(valid, jnp.take(cur_orig, safe, axis=0),
+                           jnp.int32(full_b))
+    leaves: list = []
+    cv_arrays(row, leaves)
+    gathered = [jnp.take(a, safe, axis=0) for a in leaves]
+    row2 = cv_rebuild(row, iter(gathered))
+    ctx.b = b2
+    ctx.err = jnp.zeros(b2, dtype=jnp.int32)
+    ctx.active = valid
+    return row2, valid, new_rowidx, full_err, overflow
 
 
 def runtime_output_columns(input_schema: T.RowType,

@@ -73,3 +73,16 @@ def fusion_barriers_enabled() -> bool:
     if mode in ("0", "1"):
         return mode == "1"
     return jax.default_backend() == "cpu"
+
+
+def stmt_barriers_enabled() -> bool:
+    """Statement-level barriers inside UDF bodies (finer than the per-
+    operator barriers in the stage loop). Separately switchable so the
+    granularity tradeoff (materialized bandwidth vs recompute) can be
+    tuned per platform. TUPLEX_STMT_BARRIERS=0/1 overrides."""
+    import os
+
+    mode = os.environ.get("TUPLEX_STMT_BARRIERS", "auto")
+    if mode in ("0", "1"):
+        return mode == "1"
+    return fusion_barriers_enabled()
