@@ -207,7 +207,7 @@ class LocalBackend:
                         type(e).__name__, e)
                     try:
                         _, outs2, d2 = self._dispatch_partition(
-                            part, device_fn, skey, use_comp)
+                            part, device_fn, skey, use_comp, stage)
                         outp, excs, m = self._collect_partition(
                             stage, part, outs2, d2)
                     except Exception as e2:
@@ -257,7 +257,7 @@ class LocalBackend:
                     stage, in_schema, skey, False)
             self.mm.touch(part)
             window.append(self._dispatch_partition(part, device_fn, skey,
-                                                    use_comp))
+                                                    use_comp, stage))
             if len(window) >= window_size:
                 collect_one()
         while window:
@@ -306,7 +306,7 @@ class LocalBackend:
 
     # ------------------------------------------------------------------
     def _dispatch_partition(self, part: C.Partition, device_fn, skey: str,
-                            use_comp: bool = False):
+                            use_comp: bool = False, stage=None):
         """Stage the batch and launch the device call WITHOUT blocking
         (jax dispatch is async; the result is awaited in _collect_partition).
         Returns (part, pending_outs | None, dispatch_seconds)."""
@@ -321,12 +321,12 @@ class LocalBackend:
             outs = device_fn(batch.arrays)
             self.jit_cache.note_traced(cache_key, spec)
         except NotCompilable:
-            # surfaces at TRACE time (first call): route to interpreter —
-            # but first drop compaction if it was on (it may be the culprit;
-            # the per-partition loop rebuilds the plain fn)
+            # surfaces at TRACE time (first call): drop compaction first if
+            # it was on (it may be the culprit) and re-dispatch THIS
+            # partition with the plain fn; only that failing too routes to
+            # the interpreter
             if use_comp:
-                self._compaction_off.add(skey.split("/", 1)[0])
-                return (part, None, time.perf_counter() - t0)
+                return self._redispatch_plain(part, skey, stage, t0)
             self._not_compilable.add(skey)
             return (part, None, time.perf_counter() - t0)
         except Exception as e:
@@ -339,14 +339,26 @@ class LocalBackend:
                     "stage trace failed under compaction (%s: %s); "
                     "disabling compaction for the stage",
                     type(e).__name__, e)
-                self._compaction_off.add(skey.split("/", 1)[0])
-                return (part, None, time.perf_counter() - t0)
+                return self._redispatch_plain(part, skey, stage, t0)
             get_logger("exec").warning(
                 "stage trace failed (%s: %s); falling back to the "
                 "interpreter", type(e).__name__, e)
             self._not_compilable.add(skey)
             return (part, None, time.perf_counter() - t0)
         return (part, outs, time.perf_counter() - t0)
+
+    def _redispatch_plain(self, part: C.Partition, skey: str, stage, t0):
+        """Compaction couldn't trace: disable it for the stage and run the
+        SAME partition through the plain compiled fn (an opt-in optimization
+        must never demote work to the interpreter)."""
+        self._compaction_off.add(skey.split("/", 1)[0])
+        if stage is None:
+            return (part, None, time.perf_counter() - t0)
+        plain_fn, _ = self._build_stage_fn(stage, part.schema, skey, False)
+        if plain_fn is None:
+            return (part, None, time.perf_counter() - t0)
+        res = self._dispatch_partition(part, plain_fn, skey, False, stage)
+        return (res[0], res[1], time.perf_counter() - t0)
 
     # ------------------------------------------------------------------
     def _collect_partition(self, stage: TransformStage, part: C.Partition,
@@ -390,6 +402,7 @@ class LocalBackend:
                                               compaction=False)))
                 batch = C.stage_partition(part, self.bucket_mode)
                 outs = jax.device_get(nfn(batch.arrays))
+                self.jit_cache.note_traced(nkey, batch.spec())
                 outs.pop("#rowidx", None)
                 outs.pop("#overflow", None)
                 rowidx = None
