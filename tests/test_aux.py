@@ -136,3 +136,64 @@ def test_repl_detection_and_traceback_cleanup():
         txt = repl.clean_udf_traceback(e)
     assert "user_udf" in txt and "ZeroDivisionError" in txt
     assert "tuplex_tpu/utils/repl.py" not in txt
+
+
+# ---------------------------------------------------------------------------
+# plan visualization + codegen stats (reference: Context.cc:171
+# visualizeOperationGraph; InstructionCountPass.h)
+# ---------------------------------------------------------------------------
+
+def test_explain_and_dot(ctx, capsys):
+    ds = (ctx.parallelize([1, 2, 3, 4])
+          .map(lambda x: x * 2)
+          .filter(lambda x: x > 2))
+    text = ds.explain()
+    assert "Stage 0" in text and "Map" in text and "Filter" in text
+    dot = ds.to_dot()
+    assert dot.startswith("digraph plan {") and "Map" in dot
+    assert dot.count("->") >= 2
+
+
+def test_explain_code_stats(tmp_path):
+    import tuplex_tpu
+
+    ctx = tuplex_tpu.Context({"tuplex.optimizer.codeStats": "true"})
+    ds = ctx.parallelize([1, 2, 3, 4]).map(lambda x: x + 1)
+    text = ds.explain()
+    assert "jaxpr equations" in text
+
+
+def test_jedi_completer():
+    from tuplex_tpu.utils.repl import JediCompleter
+
+    jc = JediCompleter(lambda: {"alpha_beta": 1, "alpha_gamma": 2})
+    names = jc._complete_line("alpha_")
+    assert "alpha_beta" in names and "alpha_gamma" in names
+
+
+def test_jedi_completer_dotted(monkeypatch):
+    """readline passes only the word under the cursor ('.' is a delimiter);
+    candidates must complete that word, not the whole expression."""
+    import sys
+    import types
+
+    from tuplex_tpu.utils import repl
+
+    class Obj:
+        def csv(self):
+            pass
+
+    jc = repl.JediCompleter(lambda: {"c": Obj()})
+    fake = types.SimpleNamespace(get_line_buffer=lambda: "c.cs",
+                                 get_endidx=lambda: 4)
+    monkeypatch.setitem(sys.modules, "readline", fake)
+    assert jc.complete("cs", 0) == "csv"
+
+
+def test_stdlib_completer_fallback():
+    from tuplex_tpu.utils.repl import JediCompleter
+
+    jc = JediCompleter(lambda: {"alpha_beta": 1})
+    # token-level fallback must work inside call contexts (readline hands
+    # us 'alp' for 'len(alp')
+    assert "alpha_beta" in jc._stdlib_complete("alp")

@@ -130,6 +130,24 @@ class DataSet:
             else:
                 print(repr(r))
 
+    def explain(self) -> str:
+        """Human-readable physical plan: stages + fused operators, with
+        per-stage jaxpr codegen stats when tuplex.optimizer.codeStats is on
+        (reference: LocalBackend.cc:932-949 stage logs +
+        InstructionCountPass.h)."""
+        from ..utils.planviz import explain as _explain
+
+        text = _explain(self._op, self._context.options_store)
+        print(text)
+        return text
+
+    def to_dot(self) -> str:
+        """Operator DAG as graphviz DOT text (reference:
+        Context.cc:171 visualizeOperationGraph / GENERATE_PDFS)."""
+        from ..utils.planviz import plan_to_dot
+
+        return plan_to_dot(self._op)
+
     def tocsv(self, path: str, **kwargs) -> None:
         """Stream results to CSV from columnar buffers — normal-case rows
         never box into python tuples (reference: buildWithCSVRowWriter,

@@ -604,6 +604,24 @@ class LocalBackend:
         reinterpreted under a mismatched layout; with no compiled rows the
         resolved python rows are re-encoded from scratch."""
         n = part.num_rows
+        if not resolved and out_arrays:
+            # fast path (no python-resolved rows to splice): the emit set is
+            # exactly the compiled_ok positions — skip the per-row loop
+            # (0.3s/300k rows measured on TPC-H Q1)
+            from ..plan.physical import runtime_output_columns
+
+            comp_src = np.nonzero(compiled_ok)[0].astype(np.int64)
+            m = int(comp_src.size)
+            out_cols = runtime_output_columns(part.schema, stage.ops)
+            n_full = n if src_map is None else \
+                int(next(iter(out_arrays.values())).shape[0])
+            full = C.partition_from_result_arrays(
+                out_arrays, n_full, columns=out_cols,
+                start_index=part.start_index)
+            if src_map is not None and comp_src.size:
+                comp_src = src_map[comp_src]
+            return C.gather_partition(
+                full, np.arange(m, dtype=np.int64), comp_src, m)
         emit_rows: list[tuple[int, Optional[int], Optional[Row]]] = []
         # (orig_idx, compiled_src or None, resolved Row or None)
         for i in range(n):

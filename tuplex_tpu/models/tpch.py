@@ -59,6 +59,30 @@ def q6(ds):
                        0.0))
 
 
+def read_lineitem_csv(path: str):
+    """Parse the lineitem CSV with csv+typed conversion — the pure-python
+    side of the SAME work the framework pipeline does (CSV read + parse +
+    query), so suite speedups compare like for like."""
+    import csv as _csv
+
+    rows = []
+    with open(path, newline="") as f:
+        r = _csv.reader(f)
+        next(r)   # header
+        for rec in r:
+            rows.append((float(rec[0]), float(rec[1]), float(rec[2]),
+                         float(rec[3]), rec[4], rec[5], rec[6]))
+    return rows
+
+
+def run_reference_q1(path: str) -> dict:
+    return q1_python(read_lineitem_csv(path))
+
+
+def run_reference_q6(path: str) -> float:
+    return q6_python(read_lineitem_csv(path))
+
+
 def q6_python(rows) -> float:
     total = 0.0
     for (qty, price, disc, tax, rf, ls, ship) in rows:

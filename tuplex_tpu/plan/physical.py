@@ -675,8 +675,10 @@ def _op_identity(op: L.LogicalOperator) -> str:
     return h.hexdigest()[:20]
 
 
-def _op_compiles_uncached(op: L.LogicalOperator,
-                          input_schema: T.RowType) -> bool:
+def abstract_batch_arrays(input_schema: T.RowType):
+    """Abstract 8-row DeviceBatch arrays for an input schema, or None when
+    a column type has no columnar layout (the stage can't compile). Shared
+    by the compile probe and the codeStats jaxpr counter."""
     from ..runtime.columns import flatten_type
     from ..runtime.jaxcfg import jax
     import numpy as np
@@ -701,9 +703,19 @@ def _op_compiles_uncached(op: L.LogicalOperator,
             elif base in (T.NULL, T.EMPTYTUPLE):
                 pass
             else:
-                return False
+                return None
             if opt and not path.endswith("#opt"):
                 arrays[path + "#valid"] = jax.ShapeDtypeStruct((8,), np.bool_)
+    return arrays
+
+
+def _op_compiles_uncached(op: L.LogicalOperator,
+                          input_schema: T.RowType) -> bool:
+    from ..runtime.jaxcfg import jax
+
+    arrays = abstract_batch_arrays(input_schema)
+    if arrays is None:
+        return False
 
     probe = TransformStage(None, [op], input_schema=input_schema,
                            input_op=op)

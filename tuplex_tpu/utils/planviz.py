@@ -1,0 +1,106 @@
+"""Plan visualization + codegen stats.
+
+Reference: core/src/Context.cc:171 visualizeOperationGraph (GraphVizBuilder
+→ PDF behind the GENERATE_PDFS cmake option) and
+codegen/include/InstructionCountPass.h (per-stage generated-instruction
+counts behind tuplex.optimizer.codeStats). The TPU redesign emits graphviz
+DOT text directly (no graphviz binary needed to inspect it) and counts
+jaxpr equations instead of LLVM instructions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def _op_label(op) -> str:
+    name = type(op).__name__.replace("Operator", "")
+    bits = [name]
+    col = getattr(op, "column", None)
+    if col:
+        bits.append(repr(col))
+    udf = getattr(op, "udf", None)
+    if udf is not None and udf.source:
+        src = udf.source.replace('"', "'")
+        if len(src) > 40:
+            src = src[:37] + "..."
+        bits.append(src)
+    return "\\n".join(bits)
+
+
+def plan_to_dot(sink) -> str:
+    """Operator DAG as graphviz DOT text (render with `dot -Tpdf` if
+    graphviz is installed; the text itself is the artifact)."""
+    lines = ["digraph plan {", "  rankdir=BT;",
+             '  node [shape=box, fontname="monospace", fontsize=10];']
+    seen: set[int] = set()
+
+    def walk(op):
+        if op.id in seen:
+            return
+        seen.add(op.id)
+        lines.append(f'  n{op.id} [label="#{op.id} {_op_label(op)}"];')
+        for p in op.parents:
+            walk(p)
+            lines.append(f"  n{p.id} -> n{op.id};")
+
+    walk(sink)
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def explain(sink, options=None) -> str:
+    """Human-readable physical plan: stages, fused operators, and (when
+    tuplex.optimizer.codeStats is on) per-stage jaxpr equation counts —
+    the reference logs the same shape at LocalBackend.cc:932-949."""
+    from ..plan.physical import plan_stages
+
+    stages = plan_stages(sink, options)
+    out = []
+    code_stats = options is not None and options.get_bool(
+        "tuplex.optimizer.codeStats", False)
+    for i, st in enumerate(stages):
+        kind = type(st).__name__
+        ops = getattr(st, "ops", [])
+        head = f"Stage {i} [{kind}]"
+        if getattr(st, "force_interpret", False):
+            head += " (interpreter segment)"
+        out.append(head)
+        src = getattr(st, "source", None)
+        if src is not None:
+            out.append(f"  source: {type(src).__name__.replace('Operator', '')}")
+        for op in ops:
+            out.append(f"  - #{op.id} {_op_label(op).replace(chr(92)+'n', ' ')}")
+        if code_stats and hasattr(st, "build_device_fn"):
+            n = stage_eqn_count(st)
+            if n is not None:
+                out.append(f"  codegen: {n} jaxpr equations (fast path)")
+    return "\n".join(out)
+
+
+def stage_eqn_count(stage) -> Optional[int]:
+    """Total jaxpr equations of the stage's fast-path fn over an abstract
+    8-row batch (InstructionCountPass analog — a size proxy, not a cost)."""
+    try:
+        from ..plan.physical import abstract_batch_arrays
+        from ..runtime.jaxcfg import jax
+
+        arrays = abstract_batch_arrays(stage.input_schema)
+        if arrays is None:
+            return None
+        fn = stage.build_device_fn()
+        jaxpr = jax.make_jaxpr(fn)(arrays)
+        count = 0
+
+        def walk(jx):
+            nonlocal count
+            for eq in jx.eqns:
+                count += 1
+                for p in eq.params.values():
+                    if hasattr(p, "jaxpr"):
+                        walk(p.jaxpr)
+
+        walk(jaxpr.jaxpr)
+        return count
+    except Exception:
+        return None

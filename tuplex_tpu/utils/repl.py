@@ -48,3 +48,100 @@ def clean_udf_traceback(exc: BaseException) -> str:
     lines += traceback.format_list(kept)
     lines += traceback.format_exception_only(type(exc), exc)
     return "".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# interactive shell + completion (reference: python/tuplex/utils/
+# interactive_shell.py + jedi_completer.py — theirs builds on
+# prompt_toolkit; this redesign uses stdlib readline + code so the shell
+# works in minimal environments, with jedi supplying the completions)
+# ---------------------------------------------------------------------------
+
+class JediCompleter:
+    """readline-style completer over a live namespace via jedi.
+
+    Usage: readline.set_completer(JediCompleter(lambda: ns).complete)
+    """
+
+    def __init__(self, get_locals):
+        self._get_locals = get_locals
+        self._matches: list[str] = []
+
+    def _jedi_completions(self, line: str):
+        """jedi completion objects for the buffer, or None if jedi is
+        missing."""
+        try:
+            from jedi import Interpreter, settings
+        except ImportError:
+            return None
+        prev = settings.case_insensitive_completion
+        settings.case_insensitive_completion = False
+        try:
+            interp = Interpreter(line, [self._get_locals()])
+            comps = (interp.complete() if hasattr(interp, "complete")
+                     else interp.completions())
+            return [c for c in comps
+                    if not c.name_with_symbols.startswith("_")]
+        except Exception:
+            return []
+        finally:
+            settings.case_insensitive_completion = prev
+
+    def _complete_line(self, line: str) -> list[str]:
+        comps = self._jedi_completions(line)
+        if comps is None:
+            return self._stdlib_complete(line.split()[-1] if line.split()
+                                         else line)
+        return [c.name_with_symbols for c in comps]
+
+    def _stdlib_complete(self, token: str) -> list[str]:
+        """rlcompleter fallback when jedi isn't installed. `token` is the
+        readline word under the cursor (already delimiter-split)."""
+        import rlcompleter
+
+        comp = rlcompleter.Completer(self._get_locals())
+        out, i = [], 0
+        while True:
+            m = comp.complete(token, i)
+            if m is None:
+                break
+            out.append(m)
+            i += 1
+        return out
+
+    def complete(self, text: str, state: int):
+        """readline entry point. `text` is the delimiter-split word under
+        the cursor (for `c.cs<TAB>` readline passes 'cs' — '.' is a
+        delimiter); each candidate must be a replacement for that word, so
+        jedi's remaining-suffix (`c.complete`) is appended to `text`."""
+        import readline
+
+        if state == 0:
+            buf = readline.get_line_buffer()[:readline.get_endidx()]
+            comps = self._jedi_completions(buf)
+            if comps is None:
+                self._matches = self._stdlib_complete(text)
+            else:
+                self._matches = [text + c.complete for c in comps]
+        return self._matches[state] if state < len(self._matches) else None
+
+
+def interactive_shell(banner: str | None = None):
+    """Start a REPL with a ready `Context` and jedi tab-completion
+    (reference: interactive_shell.py TuplexShell)."""
+    import code
+
+    import tuplex_tpu
+
+    ns: dict = {"Context": tuplex_tpu.Context, "tuplex": tuplex_tpu}
+    try:
+        import readline
+
+        readline.set_completer(JediCompleter(lambda: ns).complete)
+        readline.parse_and_bind("tab: complete")
+    except ImportError:
+        pass
+    if banner is None:
+        banner = ("tuplex_tpu interactive shell — `c = Context()` to begin; "
+                  "tab completes")
+    code.interact(banner=banner, local=ns)
