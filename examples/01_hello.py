@@ -5,6 +5,11 @@ The None row raises TypeError inside the compiled fast path, falls back to
 the interpreter tier, and is dropped (no resolver) — exactly CPython
 semantics, counted in exception_counts().
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import tuplex_tpu as tuplex
 
 c = tuplex.Context()

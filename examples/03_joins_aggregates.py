@@ -1,6 +1,11 @@
 """Broadcast hash join + aggregateByKey on device (reference:
 test/core/JoinTest.cc, AggregateTest.cc).
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import tuplex_tpu as tuplex
 
 c = tuplex.Context()

@@ -2,6 +2,11 @@
 via jax.sharding; run with XLA_FLAGS=--xla_force_host_platform_device_count=8
 to simulate 8 devices on CPU).
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import tuplex_tpu as tuplex
 
 c = tuplex.Context({"tuplex.backend": "multihost"})
