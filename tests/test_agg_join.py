@@ -541,3 +541,74 @@ def test_scan_fold_by_key_float_drift_falls_back(ctx):
     want1 = 2 * sum(range(2000))
     assert got == {0: want0, 1: want1}, (got, {0: want0, 1: want1})
     assert isinstance(got[0], float) and isinstance(got[1], int)
+
+
+# ---------------------------------------------------------------------------
+# fused fold partials (plan_stages fuses recognized aggregate folds into the
+# preceding transform stage's device fn; reference: PipelineBuilder.h
+# aggregate:398-401 sinks rows into per-task aggregates inside the pipeline)
+# ---------------------------------------------------------------------------
+
+def _fused_csv(tmp_path, n=20000, dirty_every=0):
+    p = tmp_path / "f.csv"
+    with open(p, "w") as f:
+        f.write("a,b\n")
+        for i in range(n):
+            b = "x" if dirty_every and i % dirty_every == 0 else str(i % 100)
+            f.write(f"{i},{b}\n")
+    return str(p)
+
+
+def test_fused_fold_parity(tmp_path):
+    import tuplex_tpu
+    import tuplex_tpu.exec.aggexec as AG
+
+    p = _fused_csv(tmp_path)
+    ctx = tuplex_tpu.Context()
+    hits = {"fused": 0}
+    orig = AG.AggregateExecutor._device_fold
+
+    def probe(self, op, spec, part):
+        if getattr(part, "fold_partials", None) is not None:
+            hits["fused"] += 1
+        return orig(self, op, spec, part)
+
+    AG.AggregateExecutor._device_fold = probe
+    try:
+        got = (ctx.csv(p)
+               .filter(lambda x: x["a"] % 3 == 0)
+               .aggregate(lambda a, b: a + b,
+                          lambda a, x: a + x["b"] * 2, 0)
+               .collect())
+    finally:
+        AG.AggregateExecutor._device_fold = orig
+    want = sum(2 * (i % 100) for i in range(20000) if i % 3 == 0)
+    assert got == [want]
+    assert hits["fused"] >= 1
+
+
+def test_fused_fold_with_dirty_rows(tmp_path):
+    """Rows whose values violate the normal case resolve via the general/
+    interpreter tiers; fused partials must NOT be used for partitions with
+    resolved rows (they'd be missing from the partials)."""
+    import tuplex_tpu
+
+    p = _fused_csv(tmp_path, dirty_every=211)
+    ctx = tuplex_tpu.Context()
+    ds = (ctx.csv(p)
+          .filter(lambda x: x["a"] % 3 == 0)
+          .aggregate(lambda a, b: a + b,
+                     lambda a, x: a + x["b"] * 2, 0))
+    got = ds.collect()
+    want = 0
+    exc = 0
+    for i in range(20000):
+        if i % 3 != 0:
+            continue
+        b = "x" if i % 211 == 0 else i % 100
+        try:
+            want += b * 2
+        except TypeError:
+            exc += 1
+    assert got == [want]
+    assert sum(ds.exception_counts().values()) == exc

@@ -296,6 +296,14 @@ class AggregateExecutor:
     def _device_fold(self, op, spec: A.FoldSpec, part: C.Partition):
         """(partial_tuple|scalar, bad_row_indices) or (None, _) if the
         partition can't run on device."""
+        fp = getattr(part, "fold_partials", None)
+        if fp is not None and fp[0] == op.id:
+            # the transform stage already computed identity-seeded partials
+            # inside its own device pass (plan_stages fused the fold) — no
+            # second staging/dispatch needed
+            partials, bad = fp[1], fp[2]
+            out = tuple(partials) if not spec.scalar else partials[0]
+            return out, list(bad)
         mesh = getattr(self.backend, "mesh", None)
         if mesh is not None:
             try:

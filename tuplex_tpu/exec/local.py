@@ -435,6 +435,10 @@ class LocalBackend:
                 zip(err_idx.tolist(),
                     map(unpack_device_code, codes.tolist())))
             compiled_ok = rowvalid & keep & (err == 0)
+            fold_vals = []
+            while f"#fold{len(fold_vals)}" in outs:
+                fold_vals.append(outs.pop(f"#fold{len(fold_vals)}"))
+            foldok = outs.pop("#foldok", None)
             out_arrays = {k: np.asarray(v) for k, v in outs.items()}
         else:
             # whole partition interpreted (UDF not compilable / forced /
@@ -500,6 +504,19 @@ class LocalBackend:
 
         outp = self._merge(stage, part, compiled_ok, out_arrays, resolved,
                            src_map=src_map)
+        if pending_outs is not None and fold_vals and foldok is not None \
+                and not resolved and not outp.fallback \
+                and getattr(stage, "fold_op", None) is not None:
+            # fused aggregate partials are exact only when every output row
+            # came off the device (python-resolved/boxed rows would be
+            # missing from them)
+            ok_np = np.asarray(foldok)[:n]
+            badmask = compiled_ok & ~ok_np
+            kept_rank = np.cumsum(compiled_ok) - 1
+            outp.fold_partials = (
+                stage.fold_op.id,
+                tuple(v.item() for v in fold_vals),
+                [int(r) for r in kept_rank[badmask]])
         return outp, exceptions, metrics
 
     # ------------------------------------------------------------------

@@ -46,6 +46,8 @@ class TransformStage:
         self.output_columns = last.columns()
 
     force_interpret = False   # set on segments around non-compilable ops
+    fold_op = None            # AggregateOperator whose pattern fold is fused
+                              # into this stage's device fn (plan_stages)
 
     @property
     def has_resolvers(self) -> bool:
@@ -81,6 +83,9 @@ class TransformStage:
         h.update(self.input_schema.name.encode())
         for op in self.ops:
             h.update(_op_identity(op).encode())
+        if self.fold_op is not None:
+            h.update(b"fold")
+            h.update(_op_identity(self.fold_op).encode())
         return h.hexdigest()[:16]
 
     # ------------------------------------------------------------------
@@ -125,6 +130,11 @@ class TransformStage:
             raise NotCompilable("stage has no general-case decode")
 
         plan = _compaction_plan(ops) if (compaction and not general) else {}
+        fold_spec = None
+        if self.fold_op is not None and not general:
+            from . import aggregates as A
+
+            fold_spec = A.recognize_fold(self.fold_op.aggregate_udf)
 
         def fn(arrays: dict):
             b = arrays["#rowvalid"].shape[0]
@@ -158,6 +168,8 @@ class TransformStage:
             outs, out_t = result_arrays(row, bcur)
             outs = dict(outs)
             fin = keep & (ctx.err == 0)
+            if fold_spec is not None:
+                _emit_fused_fold(outs, fold_spec, row, names, fin, bcur)
             if rowidx is None:
                 outs["#err"] = ctx.err
                 outs["#keep"] = fin
@@ -167,6 +179,9 @@ class TransformStage:
                     fin, mode="drop")
                 outs["#rowidx"] = rowidx
                 outs["#overflow"] = overflow
+                if "#foldok" in outs:
+                    outs["#foldok"] = jnp.zeros(b, dtype=bool).at[
+                        rowidx].set(outs["#foldok"], mode="drop")
             return outs
 
         return fn
@@ -204,6 +219,41 @@ def _fusion_barrier(ctx: EmitCtx, row: CV, keep):
 
 _COMPACT_MARGIN = 1.15   # headroom over the sample estimate (~9 sigma for a
 _COMPACT_GATHER = 0.5    # 1000-row sample); gather cost in per-op-pass units
+
+
+def _emit_fused_fold(outs: dict, spec, row: CV, names, fin, bcur) -> None:
+    """Evaluate the recognized aggregate fold exprs against the stage's
+    OUTPUT row under a fresh error context and emit identity-seeded scalar
+    partials ('#fold{i}') plus the per-row ok mask ('#foldok'). Rows whose
+    fold expr errs fold on the host afterwards; a NotCompilable expr simply
+    omits the outputs (the aggregate stage then runs its own pass)."""
+    import dataclasses
+
+    from ..parallel.collectives import reduce_identity
+
+    try:
+        fctx = EmitCtx(bcur, fin)
+        em = Emitter(fctx, spec.globals)
+        rrow = row
+        if rrow.elts is not None and names:
+            rrow = dataclasses.replace(rrow, names=tuple(names))
+        frame = Frame(em, {spec.row_param: rrow})
+        datas = []
+        for expr in spec.exprs:
+            cv = frame.eval(expr)
+            cv = frame._require_numeric(cv, "aggregate expr")
+            datas.append(cv.data)
+        ok = fin & (fctx.err == 0)
+        for fi, (d, red) in enumerate(zip(datas, spec.reducers)):
+            ident = reduce_identity(red, d.dtype.kind == "f")
+            m = jnp.where(ok, d, ident)
+            outs[f"#fold{fi}"] = (m.sum() if red == "sum"
+                                  else m.min() if red == "min" else m.max())
+        outs["#foldok"] = ok
+    except NotCompilable:
+        for k in list(outs):
+            if k.startswith("#fold"):
+                del outs[k]
 
 
 def _compaction_plan(ops) -> dict[int, float]:
@@ -574,6 +624,20 @@ def plan_stages(sink: L.LogicalOperator, options=None):
             out.extend(segment_stage(st))
         else:
             out.append(st)
+    # fuse pattern-fold aggregates into the preceding transform stage's
+    # device fn: identity-seeded partials come back with the stage outputs,
+    # so the whole plan is ONE device pass instead of two (the reference
+    # likewise sinks rows straight into per-task aggregates inside the
+    # compiled pipeline — PipelineBuilder.h aggregate:398-401)
+    from . import aggregates as A
+
+    for i in range(len(out) - 1):
+        st, nxt = out[i], out[i + 1]
+        if (isinstance(st, TransformStage) and not st.force_interpret
+                and st.limit < 0 and isinstance(nxt, AggregateStage)
+                and type(nxt.op) is A.AggregateOperator
+                and A.recognize_fold(nxt.op.aggregate_udf) is not None):
+            st.fold_op = nxt.op
     return out
 
 
