@@ -265,6 +265,10 @@ def _suite(cache_dir: str, platform: str) -> None:
     lg = prep(f"logs_{n}.txt", lambda p: logs.generate_log(p, n))
     li = prep(f"lineitem_{n}.csv", lambda p: tpch.generate_csv(p, n))
     nc = prep(f"n311_{n}.csv", lambda p: nyc311.generate_csv(p, n))
+    pq = os.path.join(cache_dir, f"q19part_{n}.csv")
+    lq = os.path.join(cache_dir, f"q19li_{n}.csv")
+    if not (os.path.exists(pq) and os.path.exists(lq)):
+        tpch.generate_q19_csvs(pq, lq, max(200, n // 50), n)
 
     ctx = tuplex_tpu.Context()
     metrics = ctx.metrics
@@ -278,6 +282,8 @@ def _suite(cache_dir: str, platform: str) -> None:
          lambda: tpch.run_reference_q1(li)),
         ("tpch_q6", lambda: tpch.q6(ctx.csv(li)).collect(),
          lambda: tpch.run_reference_q6(li)),
+        ("tpch_q19", lambda: tpch.q19(ctx, pq, lq).collect(),
+         lambda: tpch.run_reference_q19(pq, lq)),
         ("nyc311", lambda: nyc311.build_pipeline(ctx, nc).collect(),
          lambda: nyc311.run_reference_python(nc)),
     ]

@@ -90,6 +90,7 @@ class LocalBackend:
     # collect path consumes '#rowidx' outputs; the mesh backend shards
     # batches across devices and keeps full-length outputs instead
     supports_compaction = True
+    supports_fused_fold = True
 
     def __init__(self, options):
         self.options = options
@@ -280,8 +281,9 @@ class LocalBackend:
         stage to the interpreter); only a plain build failure does that."""
         while True:
             try:
-                raw_fn = stage.build_device_fn(in_schema,
-                                               compaction=use_comp)
+                raw_fn = stage.build_device_fn(
+                    in_schema, compaction=use_comp,
+                    fused_fold=self.supports_fused_fold)
                 return self.jit_cache.get_or_build(
                     ("stagefn", skey, use_comp),
                     lambda: self._jit_stage_fn(raw_fn)), use_comp
@@ -681,13 +683,22 @@ class LocalBackend:
         outp = C.gather_partition(full, comp_out, comp_src, m)
         out_schema = outp.schema
 
-        normal_mask = np.ones(m, dtype=np.bool_)
-        fallback: dict[int, Any] = {}
+        res_ks = []
+        res_vals = []
         for k, (_, src, row) in enumerate(emit_rows):
             if row is None:
                 continue
-            value = row.unwrap() if len(out_schema.columns) == 1 \
-                else tuple(row.values)
+            res_ks.append(k)
+            res_vals.append(row.unwrap() if len(out_schema.columns) == 1
+                            else tuple(row.values))
+        if not res_ks:
+            return outp
+        if _bulk_fold_rows(outp.leaves, out_schema,
+                           np.asarray(res_ks, dtype=np.int64), res_vals):
+            return outp
+        normal_mask = np.ones(m, dtype=np.bool_)
+        fallback: dict[int, Any] = {}
+        for k, value in zip(res_ks, res_vals):
             if _try_fold_row(outp.leaves, out_schema, k, value):
                 continue
             normal_mask[k] = False
@@ -797,6 +808,68 @@ def _truncate_partition(p: C.Partition, k: int) -> C.Partition:
         normal_mask=None if p.normal_mask is None else p.normal_mask[:k],
         fallback={i: v for i, v in p.fallback.items() if i < k},
         start_index=p.start_index)
+
+
+def _bulk_fold_rows(leaves: dict, schema: T.RowType,
+                    ks: "np.ndarray", values: list) -> bool:
+    """All-or-nothing vectorized fold-back of resolved python rows into
+    columnar slots. Returns False (writing nothing) when any value doesn't
+    conform exactly — the caller then runs the per-row path, which handles
+    partial conformance by boxing. ~5x cheaper than per-row _try_fold_row
+    on dual-mode-heavy data (measured 0.57s/3.3k rows on flights)."""
+    cols = schema.columns
+    multi = len(cols) > 1
+    rows = []
+    for v in values:
+        rt = v if multi else ((v,) if not (isinstance(v, tuple)
+                                           and len(v) == 1) else v)
+        if multi and not (isinstance(rt, tuple) and len(rt) == len(cols)):
+            return False
+        rows.append(rt)
+    cols_cache: list = []
+    bytes_cache: dict = {}
+    for ci, ct in enumerate(schema.types):
+        base = ct.without_option() if ct.is_optional() else ct
+        if isinstance(base, T.TupleType):
+            return False   # nested layouts: per-row path
+        col = [r[ci] for r in rows]
+        cols_cache.append(col)
+        if not all(T.python_value_conforms(v, ct) for v in col):
+            return False
+        leaf = leaves[str(ci)]
+        if isinstance(leaf, C.StrLeaf):
+            bs = [b"" if v is None else v.encode("utf-8") for v in col]
+            bytes_cache[ci] = bs
+            if max(map(len, bs), default=0) > leaf.bytes.shape[1]:
+                return False
+        elif not isinstance(leaf, C.NumericLeaf):
+            return False
+    # every value conforms: write
+    for ci, ct in enumerate(schema.types):
+        leaf = leaves[str(ci)]
+        col = cols_cache[ci]
+        if isinstance(leaf, C.StrLeaf):
+            bs = bytes_cache[ci]
+            w = leaf.bytes.shape[1]
+            block = np.zeros((len(bs), w), dtype=np.uint8)
+            for j, b in enumerate(bs):
+                if b:
+                    block[j, : len(b)] = np.frombuffer(b, np.uint8)
+            leaf.bytes[ks] = block
+            leaf.lengths[ks] = np.fromiter(map(len, bs), np.int32,
+                                           count=len(bs))
+            if leaf.valid is not None:
+                leaf.valid[ks] = np.fromiter(
+                    (v is not None for v in col), np.bool_, count=len(col))
+        else:
+            if leaf.valid is not None:
+                leaf.valid[ks] = np.fromiter(
+                    (v is not None for v in col), np.bool_, count=len(col))
+                leaf.data[ks] = np.asarray(
+                    [0 if v is None else v for v in col], dtype=leaf.data.dtype)
+            else:
+                leaf.data[ks] = np.asarray(col, dtype=leaf.data.dtype)
+    return True
 
 
 def _try_fold_row(leaves: dict, schema: T.RowType, k: int, value: Any) -> bool:

@@ -31,14 +31,10 @@ class MultiHostBackend(LocalBackend):
     # under shard_map that would need a cross-device exchange to stay
     # load-balanced, so the mesh path keeps full-length outputs
     supports_compaction = False
-
-    def execute(self, stage, partitions):
-        # fused fold partials are scalar outputs the shard_map wrapper's
-        # out_specs don't carry; the mesh fold path (psum over ICI) handles
-        # aggregation instead
-        if getattr(stage, "fold_op", None) is not None:
-            stage.fold_op = None
-        return super().execute(stage, partitions)
+    # fused fold partials are scalar outputs the shard_map wrapper's
+    # out_specs don't carry; the mesh fold path (psum over ICI) handles
+    # aggregation instead
+    supports_fused_fold = False
 
     def __init__(self, options):
         super().__init__(options)

@@ -63,16 +63,7 @@ def read_lineitem_csv(path: str):
     """Parse the lineitem CSV with csv+typed conversion — the pure-python
     side of the SAME work the framework pipeline does (CSV read + parse +
     query), so suite speedups compare like for like."""
-    import csv as _csv
-
-    rows = []
-    with open(path, newline="") as f:
-        r = _csv.reader(f)
-        next(r)   # header
-        for rec in r:
-            rows.append((float(rec[0]), float(rec[1]), float(rec[2]),
-                         float(rec[3]), rec[4], rec[5], rec[6]))
-    return rows
+    return read_csv_rows(path, (float, float, float, float, str, str, str))
 
 
 def run_reference_q1(path: str) -> dict:
@@ -198,6 +189,26 @@ def q19(ctx, part_path: str, lineitem_path: str):
             .aggregate(lambda a, b: a + b,
                        lambda a, x: a + x["l_extendedprice"] *
                        (1 - x["l_discount"]), 0.0))
+
+
+def read_csv_rows(path: str, parsers) -> list:
+    import csv as _csv
+
+    out = []
+    with open(path, newline="") as f:
+        r = _csv.reader(f)
+        next(r)
+        for rec in r:
+            out.append(tuple(p(c) for p, c in zip(parsers, rec)))
+    return out
+
+
+def run_reference_q19(part_path: str, lineitem_path: str) -> float:
+    """File-based python baseline doing the SAME csv parse work."""
+    parts = read_csv_rows(part_path, (int, str, int, str))
+    lis = read_csv_rows(lineitem_path,
+                        (int, float, float, float, str, str))
+    return q19_python(parts, lis)
 
 
 def q19_python(part_rows, li_rows) -> float:

@@ -91,7 +91,8 @@ class TransformStage:
     # ------------------------------------------------------------------
     def build_device_fn(self, input_schema: Optional[T.RowType] = None,
                         general: bool = False,
-                        compaction: bool = False) -> Callable:
+                        compaction: bool = False,
+                        fused_fold: bool = True) -> Callable:
         """The fused fast-path function: staged arrays -> output arrays +
         '#err' + '#keep'. Raises NotCompilable if any fused UDF can't compile
         (the backend then interprets every row).
@@ -131,7 +132,7 @@ class TransformStage:
 
         plan = _compaction_plan(ops) if (compaction and not general) else {}
         fold_spec = None
-        if self.fold_op is not None and not general:
+        if fused_fold and self.fold_op is not None and not general:
             from . import aggregates as A
 
             fold_spec = A.recognize_fold(self.fold_op.aggregate_udf)
