@@ -55,3 +55,85 @@ def test_device_staging_pads_to_bucket():
     spec1 = batch.spec()
     p2 = C.build_partition([(i, "zz") for i in range(7)], schema)
     assert C.stage_partition(p2).spec() == spec1  # same bucket => same jit key
+
+
+# ---------------------------------------------------------------------------
+# device-resident inter-stage handoff (local._attach_device_view +
+# stage_partition consumption; reference analog: hash intermediates passed
+# by pointer as stage globals, LocalBackend.cc:903-908)
+# ---------------------------------------------------------------------------
+
+def test_device_view_handoff(tmp_path, monkeypatch):
+    monkeypatch.setenv("TUPLEX_DEVICE_HANDOFF", "1")
+    import numpy as np
+
+    import tuplex_tpu
+    from tuplex_tpu.runtime import columns as C
+
+    p = tmp_path / "h.csv"
+    with open(p, "w") as f:
+        f.write("a,g\n")
+        for i in range(20000):
+            f.write(f"{i},{i % 5}\n")
+    ctx = tuplex_tpu.Context()
+    # transform -> aggregateByKey: the agg stage re-stages the transform
+    # output; with handoff on it must consume the device view
+    hits = {"view": 0}
+    orig = C.stage_partition
+
+    def probe(part, mode="q8"):
+        dv = getattr(part, "device_batch", None)
+        batch = orig(part, mode)
+        if dv is not None and batch is dv:
+            hits["view"] += 1
+        return batch
+
+    monkeypatch.setattr(C, "stage_partition", probe)
+    import tuplex_tpu.exec.aggexec as AG
+    monkeypatch.setattr(AG.C, "stage_partition", probe)
+    got = (ctx.csv(str(p))
+           .map(lambda x: {"v": x["a"] * 3, "g": x["g"]})
+           .aggregateByKey(lambda a, b: a + b,
+                           lambda a, x: a + x["v"], 0, ["g"])
+           .collect())
+    want = {}
+    for i in range(20000):
+        want[i % 5] = want.get(i % 5, 0) + i * 3
+    assert sorted(got) == sorted(want.items())
+    assert hits["view"] >= 1
+
+
+def test_device_view_dropped_on_spill(tmp_path):
+    # a swapped-out partition must not keep pinning device memory: force a
+    # MemoryManager eviction on a partition carrying a device view and
+    # check the view is dropped (and the data survives the round trip)
+    from tuplex_tpu.runtime import columns as C
+    from tuplex_tpu.runtime.spill import MemoryManager
+
+    schema = T.row_of(["a", "s"], [T.I64, T.STR])
+    data = [(i, f"s{i}") for i in range(5000)]
+    p1 = C.build_partition(data, schema)
+    p1.device_batch = C.stage_partition(p1)   # stand-in device view
+    mm = MemoryManager(budget_bytes=1024, scratch_dir=str(tmp_path))
+    mm.register(p1)
+    p2 = C.build_partition(data, schema)
+    mm.register(p2)   # blows the 1KB budget -> p1 swaps out
+    assert not p1.leaves, "expected p1 to be swapped out"
+    assert p1.device_batch is None
+    mm.ensure_loaded(p1)
+    assert C.partition_to_pylist(p1) == data
+
+
+def test_device_view_one_shot():
+    # consuming a device view releases the partition's reference so HBM
+    # frees as soon as the dispatch retires; a second staging goes back to
+    # the (authoritative) host leaves
+    from tuplex_tpu.runtime import columns as C
+
+    schema = T.row_of(["a", "s"], [T.I64, T.STR])
+    p = C.build_partition([(i, f"s{i}") for i in range(100)], schema)
+    view = C.stage_partition(p)
+    p.device_batch = view
+    assert C.stage_partition(p) is view
+    assert p.device_batch is None
+    assert C.stage_partition(p) is not view

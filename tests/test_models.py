@@ -199,11 +199,11 @@ def test_failure_log_retry_and_degrade(ctx):
     calls = {"n": 0}
     orig = LB.LocalBackend._collect_partition
 
-    def poisoned(self, stage, part, outs, dispatch_s):
+    def poisoned(self, stage, part, outs, dispatch_s, **kw):
         if outs is not None:
             calls["n"] += 1
             raise RuntimeError("injected device failure")
-        return orig(self, stage, part, outs, dispatch_s)
+        return orig(self, stage, part, outs, dispatch_s, **kw)
 
     LB.LocalBackend._collect_partition = poisoned
     try:

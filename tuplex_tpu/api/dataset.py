@@ -16,7 +16,7 @@ from typing import Any, Callable, Optional, Sequence
 from ..core import typesys as T
 from ..core.errors import TuplexException
 from ..plan import logical as L
-from ..plan.physical import TransformStage, plan_stages
+from ..plan.physical import AggregateStage, TransformStage, plan_stages
 
 
 class DataSet:
@@ -216,7 +216,7 @@ class DataSet:
         all_exceptions = []
         try:
             with capture_sigint():
-                for stage in stages:
+                for si, stage in enumerate(stages):
                     check_interrupted()
                     if getattr(stage, "source", None) is not None:
                         # take(n): stream partitions lazily so the backend
@@ -227,8 +227,15 @@ class DataSet:
                             isinstance(stage, TransformStage)
                         partitions = _source_partitions(
                             self._context, stage, lazy=lazy)
-                    result = backend.execute_any(stage, partitions,
-                                                 self._context)
+                    # device handoff pays off only when the NEXT stage
+                    # re-stages this output onto the device (transform/
+                    # aggregate); join probes consume host-side
+                    nxt = stages[si + 1] if si + 1 < len(stages) else None
+                    result = backend.execute_any(
+                        stage, partitions, self._context,
+                        intermediate=isinstance(
+                            nxt, (TransformStage, AggregateStage))
+                        and not getattr(nxt, "force_interpret", False))
                     partitions = result.partitions
                     all_exceptions.extend(result.exceptions)
                     self._context.metrics.record_stage(result.metrics)

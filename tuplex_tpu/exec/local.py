@@ -121,8 +121,12 @@ class LocalBackend:
         return jax.jit(raw_fn)
 
     # ------------------------------------------------------------------
-    def execute_any(self, stage, partitions, context) -> StageResult:
-        """Dispatch by stage kind (reference: LocalBackend.cc:145-180)."""
+    def execute_any(self, stage, partitions, context,
+                    intermediate: bool = False) -> StageResult:
+        """Dispatch by stage kind (reference: LocalBackend.cc:145-180).
+        `intermediate`: a later stage consumes this one's output (enables
+        the device-resident handoff; terminal outputs only ever go to
+        host)."""
         from ..plan.physical import AggregateStage, JoinStage
 
         if isinstance(stage, AggregateStage):
@@ -134,11 +138,12 @@ class LocalBackend:
 
             return JoinExecutor(self).execute(stage, partitions or [],
                                               context)
-        return self.execute(stage, partitions or [])
+        return self.execute(stage, partitions or [],
+                            intermediate=intermediate)
 
     # ------------------------------------------------------------------
     def execute(self, stage: TransformStage,
-                partitions) -> StageResult:
+                partitions, intermediate: bool = False) -> StageResult:
         """Window-pipelined dual-mode execution (reference analog:
         Executor/WorkQueue task parallelism, Executor.h:45-109 +
         LocalBackend.cc:1531-1586). Device dispatch is ASYNC — while the
@@ -169,6 +174,15 @@ class LocalBackend:
         out_parts: list[C.Partition] = []
         exceptions: list[ExceptionRecord] = []
         emitted_total = 0
+        if intermediate:
+            from ..runtime.jaxcfg import (device_handoff_budget_bytes,
+                                          device_handoff_enabled)
+
+            # fold enablement into the flag once per stage (not per
+            # partition) and probe the HBM budget only when it matters
+            intermediate = device_handoff_enabled()
+            self._handoff_left = \
+                device_handoff_budget_bytes() if intermediate else 0
         limit = stage.limit
         window_size = max(1, self.options.get_int(
             "tuplex.tpu.dispatchWindow", 3))
@@ -187,8 +201,9 @@ class LocalBackend:
             self.mm.pin(part)
             try:
                 try:
-                    outp, excs, m = self._collect_partition(stage, part,
-                                                            outs, dispatch_s)
+                    outp, excs, m = self._collect_partition(
+                        stage, part, outs, dispatch_s,
+                        intermediate=intermediate)
                 except Exception as e:
                     if outs is None:
                         raise   # interpreter failure is deterministic
@@ -210,7 +225,8 @@ class LocalBackend:
                         _, outs2, d2 = self._dispatch_partition(
                             part, device_fn, skey, use_comp, stage)
                         outp, excs, m = self._collect_partition(
-                            stage, part, outs2, d2)
+                            stage, part, outs2, d2,
+                            intermediate=intermediate)
                     except Exception as e2:
                         self.failure_log.append({
                             "stage": skey[:16],
@@ -222,7 +238,8 @@ class LocalBackend:
                             "retry failed (%s: %s); partition runs on the "
                             "interpreter", type(e2).__name__, e2)
                         outp, excs, m = self._collect_partition(
-                            stage, part, None, 0.0)
+                            stage, part, None, 0.0,
+                            intermediate=intermediate)
             finally:
                 self.mm.unpin(part)
             self.mm.register(outp)
@@ -273,6 +290,46 @@ class LocalBackend:
             1 for e in self.failure_log[fl_snap:] if e.get("attempt") == 1)
         metrics.update(self.mm.metrics_delta(mm_snap))
         return StageResult(out_parts, exceptions, metrics)
+
+    # ------------------------------------------------------------------
+    def _attach_device_view(self, outp: C.Partition, pending_outs) -> None:
+        """Keep a device-resident gathered view of this output partition so
+        a downstream stage re-stages it without host copies + H2D (reference
+        analog: hash intermediates passed by pointer as stage globals,
+        LocalBackend.cc:903-908 — here the 'pointer' is a device buffer).
+        Best-effort: any mismatch falls back to host staging."""
+        try:
+            from ..runtime.jaxcfg import jnp
+
+            expect = C.staged_keys(outp)
+            if expect is None or not expect <= set(pending_outs):
+                return
+            m = outp.num_rows
+            if m == 0:
+                return
+            b2 = C.bucket_size(m, self.bucket_mode)
+            # charge the per-stage HBM budget BEFORE building the view: a
+            # stage's whole output holds views until the next stage drains
+            # them, so unbounded attachment would pin O(dataset) HBM
+            est = b2 + sum(
+                (pending_outs[k].nbytes // max(1, pending_outs[k].shape[0]))
+                * b2 for k in expect)
+            if est > getattr(self, "_handoff_left", 0):
+                return
+            self._handoff_left -= est
+            src = np.zeros(b2, dtype=np.int32)
+            src[:m] = outp._gather_src
+            idx = jnp.asarray(src)
+            arrays = {k: jnp.take(pending_outs[k], idx, axis=0)
+                      for k in expect}
+            rv = np.zeros(b2, dtype=np.bool_)
+            rv[:m] = True
+            arrays["#rowvalid"] = jnp.asarray(rv)
+            arrays["#seed"] = C.partition_seed(outp)
+            outp.device_batch = C.DeviceBatch(
+                arrays=arrays, n=m, b=b2, schema=outp.schema)
+        except Exception:   # pragma: no cover - purely an optimization
+            outp.device_batch = None
 
     # ------------------------------------------------------------------
     def _build_stage_fn(self, stage, in_schema, skey: str, use_comp: bool):
@@ -364,7 +421,8 @@ class LocalBackend:
 
     # ------------------------------------------------------------------
     def _collect_partition(self, stage: TransformStage, part: C.Partition,
-                           pending_outs, dispatch_s: float):
+                           pending_outs, dispatch_s: float,
+                           intermediate: bool = False):
         import jax
 
         metrics: dict[str, float] = {}
@@ -379,6 +437,7 @@ class LocalBackend:
         # the authoritative python-semantics run).
         device_codes: dict[int, tuple[int, int]] = {}
         src_map = None
+        device_outs = pending_outs     # arrays eligible for the device view
         if pending_outs is not None:
             t0 = time.perf_counter()
             outs = jax.device_get(pending_outs)
@@ -403,11 +462,15 @@ class LocalBackend:
                         stage.build_device_fn(part.schema,
                                               compaction=False)))
                 batch = C.stage_partition(part, self.bucket_mode)
-                outs = jax.device_get(nfn(batch.arrays))
+                pending2 = nfn(batch.arrays)
+                outs = jax.device_get(pending2)
                 self.jit_cache.note_traced(nkey, batch.spec())
                 outs.pop("#rowidx", None)
                 outs.pop("#overflow", None)
                 rowidx = None
+                # the original compacted arrays overflowed and are garbage:
+                # the device view must come from the re-run
+                device_outs = pending2
             if rowidx is not None:
                 # inverse map: original row i -> compact slot j (ascending
                 # original order is preserved by compaction, so merge order
@@ -506,6 +569,10 @@ class LocalBackend:
 
         outp = self._merge(stage, part, compiled_ok, out_arrays, resolved,
                            src_map=src_map)
+        if intermediate and device_outs is not None and not resolved \
+                and not outp.fallback \
+                and getattr(outp, "_gather_src", None) is not None:
+            self._attach_device_view(outp, device_outs)
         if pending_outs is not None and fold_vals and foldok is not None \
                 and not resolved and not outp.fallback \
                 and getattr(stage, "fold_op", None) is not None:
@@ -639,8 +706,10 @@ class LocalBackend:
                 start_index=part.start_index)
             if src_map is not None and comp_src.size:
                 comp_src = src_map[comp_src]
-            return C.gather_partition(
+            outp = C.gather_partition(
                 full, np.arange(m, dtype=np.int64), comp_src, m)
+            outp._gather_src = comp_src   # device-view handoff indices
+            return outp
         emit_rows: list[tuple[int, Optional[int], Optional[Row]]] = []
         # (orig_idx, compiled_src or None, resolved Row or None)
         for i in range(n):

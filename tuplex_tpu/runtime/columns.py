@@ -433,25 +433,65 @@ class DeviceBatch:
         ))
 
 
-def stage_partition(part: Partition, bucket_mode: str = "pow2") -> DeviceBatch:
+def _leaf_keys(path: str, leaf):
+    """Device array keys for one leaf — THE single definition of the
+    per-leaf key layout (staged_keys and stage_partition both derive from
+    it). [] for layout-free leaves (Null), None for host-only (Object)."""
+    if isinstance(leaf, NullLeaf):
+        return []
+    if isinstance(leaf, ObjectLeaf):
+        return None
+    keys = [path] if isinstance(leaf, NumericLeaf) \
+        else [path + "#bytes", path + "#len"]
+    if leaf.valid is not None:
+        keys.append(path + "#valid")
+    return keys
+
+
+def staged_keys(part: Partition):
+    """The array keys stage_partition would produce for `part` (without
+    '#rowvalid'/'#seed'), or None when a leaf has no device layout."""
+    keys: set = set()
+    for path, leaf in part.leaves.items():
+        ks = _leaf_keys(path, leaf)
+        if ks is None:
+            return None
+        keys.update(ks)
+    return keys
+
+
+def partition_seed(part: Partition):
+    """Per-partition PRNG seed (Weyl-mixed start index) for compiled
+    `random` UDFs — distinct per partition so batches don't replay one
+    sequence."""
+    return np.uint32((part.start_index * 2654435761 + 97531) & 0xFFFFFFFF)
+
+
+def stage_partition(part: Partition, bucket_mode: str = "q8") -> DeviceBatch:
+    dv = getattr(part, "device_batch", None)
+    if dv is not None:
+        # one-shot: drop the partition's reference either way so device
+        # memory is released as soon as the consumer's dispatch retires
+        # (host leaves stay authoritative for any retry)
+        part.device_batch = None
+        if dv.n == part.num_rows \
+                and dv.b == bucket_size(part.num_rows, bucket_mode):
+            return dv   # device-resident view from the producing stage
     n = part.num_rows
     b = bucket_size(n, bucket_mode)
     arrays: dict[str, np.ndarray] = {}
     for path, leaf in part.leaves.items():
-        if isinstance(leaf, NullLeaf):
-            continue
-        if isinstance(leaf, ObjectLeaf):
-            continue  # host-only column: device code must not touch it
+        ks = _leaf_keys(path, leaf)
+        if not ks:   # NullLeaf (layout-free) or host-only ObjectLeaf:
+            continue  # device code must not touch it
         if isinstance(leaf, NumericLeaf):
             arrays[path] = pad_to(leaf.data, b)
-            if leaf.valid is not None:
-                arrays[path + "#valid"] = pad_to(leaf.valid, b)
-        elif isinstance(leaf, StrLeaf):
+        else:   # StrLeaf
             wb = bucket_size(max(leaf.width, 1), bucket_mode, minimum=8)
             arrays[path + "#bytes"] = pad_to(pad_to(leaf.bytes, b, 0), wb, 1)
             arrays[path + "#len"] = pad_to(leaf.lengths, b)
-            if leaf.valid is not None:
-                arrays[path + "#valid"] = pad_to(leaf.valid, b)
+        if path + "#valid" in ks:
+            arrays[path + "#valid"] = pad_to(leaf.valid, b)
     rowvalid = np.zeros(b, dtype=np.bool_)
     if part.normal_mask is None:
         rowvalid[:n] = True
@@ -462,8 +502,7 @@ def stage_partition(part: Partition, bucket_mode: str = "pow2") -> DeviceBatch:
     # index so partitions draw distinct streams). Stages without random never
     # read it; jit drops unused inputs at lowering, so the executable and the
     # persistent compile cache key are untouched for such stages.
-    arrays["#seed"] = np.uint32((part.start_index * 2654435761 + 97531)
-                                & 0xFFFFFFFF)
+    arrays["#seed"] = partition_seed(part)
     return DeviceBatch(arrays=arrays, n=n, b=b, schema=part.schema)
 
 

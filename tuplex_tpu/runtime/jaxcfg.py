@@ -75,6 +75,47 @@ def fusion_barriers_enabled() -> bool:
     return jax.default_backend() == "cpu"
 
 
+def device_handoff_enabled() -> bool:
+    """Whether intermediate stage outputs keep a device-resident gathered
+    view for downstream re-staging (skips host pad/copy + H2D — the analog
+    of the reference passing hash intermediates by pointer as stage
+    globals, LocalBackend.cc:903-908). Default: off on CPU (host staging IS
+    device memory there; the extra device gather would be pure overhead),
+    on everywhere else. TUPLEX_DEVICE_HANDOFF=0/1 overrides (tests force it
+    on under the CPU platform)."""
+    import os
+
+    mode = os.environ.get("TUPLEX_DEVICE_HANDOFF", "auto")
+    if mode in ("0", "1"):
+        return mode == "1"
+    return jax.default_backend() != "cpu"
+
+
+def device_handoff_budget_bytes() -> int:
+    """Cap on device memory pinned by handoff views per stage. Views are
+    one-shot (released at consumption), but ALL of a stage's outputs hold
+    views until the next stage drains them — without a cap a large
+    intermediate dataset would pin O(dataset) HBM. Default: 25% of the
+    device's reported bytes_limit, else 1 GiB. TUPLEX_DEVICE_HANDOFF_MB
+    overrides."""
+    import os
+
+    mb = os.environ.get("TUPLEX_DEVICE_HANDOFF_MB")
+    if mb is not None:
+        try:
+            return int(float(mb) * (1 << 20))
+        except ValueError:
+            pass
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+        if limit > 0:
+            return limit // 4
+    except Exception:
+        pass
+    return 1 << 30
+
+
 def stmt_barriers_enabled() -> bool:
     """Statement-level barriers inside UDF bodies (finer than the per-
     operator barriers in the stage loop). Separately switchable so the

@@ -193,6 +193,10 @@ class MemoryManager:
         # orphaned spill files are removed when the partition is GC'd
         part._spill_fin = weakref.finalize(part, sp.delete)  # type: ignore[attr-defined]
         part.leaves = {}
+        # a device-resident view pins device memory: a partition under
+        # memory pressure must not keep one
+        if getattr(part, "device_batch", None) is not None:
+            part.device_batch = None
         log.debug("swapped out partition (%d rows) to %s", part.num_rows, path)
 
     def _swap_in_locked(self, part: C.Partition) -> None:
