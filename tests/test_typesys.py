@@ -69,3 +69,17 @@ def test_conformance():
     assert T.python_value_conforms(None, T.option(T.STR))
     assert T.python_value_conforms("a", T.option(T.STR))
     assert T.python_value_conforms((1, "a"), T.tuple_of(T.I64, T.STR))
+
+
+def test_pickle_resolves_to_interned_singletons():
+    # schemas cross process boundaries (tuplexfile manifests, serverless
+    # stage specs); the emitter compares types with `is`, so unpickling
+    # MUST return the canonical instances
+    import pickle
+
+    r = T.row_of(["a", "b"], [T.I64, T.option(T.tuple_of(T.STR, T.F64))])
+    assert pickle.loads(pickle.dumps(r)) is r
+    for t in (T.I64, T.F64, T.BOOL, T.STR, T.NULL, T.PYOBJECT,
+              T.EMPTYTUPLE, T.option(T.I64), T.list_of(T.STR),
+              T.dict_of(T.STR, T.F64), T.fn_of([T.I64], T.BOOL)):
+        assert pickle.loads(pickle.dumps(t)) is t

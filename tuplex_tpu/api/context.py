@@ -65,6 +65,10 @@ class Context:
             from ..exec.multihost import MultiHostBackend
 
             return MultiHostBackend(self.options_store)
+        if name in ("serverless", "lambda"):
+            from ..exec.serverless import ServerlessBackend
+
+            return ServerlessBackend(self.options_store)
         raise TuplexException(f"unknown backend {name!r}")
 
     # ------------------------------------------------------------------

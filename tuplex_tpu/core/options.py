@@ -71,6 +71,11 @@ DEFAULTS: dict[str, str] = {
     "tuplex.redirectToPythonLogging": "false",
     "tuplex.aws.scratchDir": "",
     "tuplex.aws.maxConcurrency": "100",
+    "tuplex.aws.requestTimeout": "600",     # per-task seconds
+    "tuplex.aws.retryCount": "2",           # re-invocations before degrade
+    "tuplex.aws.workerPlatform": "cpu",     # jax platform inside workers
+                                            # ("" = inherit; one local chip
+                                            # cannot be shared by N procs)
     # --- TPU-native keys ---------------------------------------------------
     "tuplex.tpu.deviceBatchSize": "1048576",    # rows per device dispatch
     "tuplex.tpu.padBucketing": "q8",            # q8 | pow2 | exact
@@ -109,6 +114,11 @@ class ContextOptions:
 
     def set(self, key: str, value: Any) -> None:
         self._store[_normalize_key(key)] = _stringify(value)
+
+    def to_dict(self) -> dict[str, str]:
+        """Flat copy for shipping to workers (serverless InvocationRequest
+        carries the full option set, reference: Lambda.proto settings)."""
+        return dict(self._store)
 
     # -- getters ------------------------------------------------------------
     def get(self, key: str, default: Any = None) -> Any:

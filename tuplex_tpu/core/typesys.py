@@ -45,6 +45,14 @@ class Type:
     def __eq__(self, other) -> bool:
         return self is other
 
+    # types are interned singletons compared with `is`; pickling must
+    # therefore resolve back to the canonical instance in the TARGET
+    # process (schemas cross process boundaries in tuplexfile manifests
+    # and serverless stage specs). Each subclass reduces to its interning
+    # constructor; primitives reduce to a name lookup.
+    def __reduce__(self):
+        return (_primitive_by_name, (self._name,))
+
     # --- lattice predicates -------------------------------------------------
     def is_optional(self) -> bool:
         return False
@@ -95,6 +103,19 @@ EMPTYTUPLE = Type("()")
 EMPTYLIST = Type("[]")
 EMPTYDICT = Type("{}")
 
+_PRIMITIVES: dict[str, Type] = {
+    t.name: t for t in (BOOL, I64, F64, STR, NULL, PYOBJECT, UNKNOWN,
+                        EMPTYTUPLE, EMPTYLIST, EMPTYDICT)}
+
+
+def _primitive_by_name(name: str) -> Type:
+    """Unpickle target for non-composite types (see Type.__reduce__)."""
+    try:
+        return _PRIMITIVES[name]
+    except KeyError:
+        raise ValueError(f"unknown primitive type {name!r}") from None
+
+
 _intern_lock = threading.Lock()
 _interned: dict[str, Type] = {}
 
@@ -126,6 +147,9 @@ class OptionType(Type):
     def is_numeric(self) -> bool:
         return False
 
+    def __reduce__(self):
+        return (option, (self.inner,))
+
 
 class TupleType(Type):
     __slots__ = ("elements",)
@@ -136,6 +160,9 @@ class TupleType(Type):
 
     def __len__(self):
         return len(self.elements)
+
+    def __reduce__(self):
+        return (tuple_of, tuple(self.elements))
 
 
 class ListType(Type):
@@ -148,6 +175,9 @@ class ListType(Type):
     def element_type(self) -> Type:
         return self.elt
 
+    def __reduce__(self):
+        return (list_of, (self.elt,))
+
 
 class DictType(Type):
     __slots__ = ("key", "val")
@@ -156,6 +186,9 @@ class DictType(Type):
         super().__init__(f"Dict[{key.name},{val.name}]")
         self.key = key
         self.val = val
+
+    def __reduce__(self):
+        return (dict_of, (self.key, self.val))
 
 
 class RowType(Type):
@@ -187,6 +220,9 @@ class RowType(Type):
     def col_index(self, name: str) -> int:
         return self.columns.index(name)
 
+    def __reduce__(self):
+        return (row_of, (self.columns, self.types))
+
 
 class FunctionType(Type):
     __slots__ = ("params", "ret")
@@ -197,6 +233,9 @@ class FunctionType(Type):
         )
         self.params = params
         self.ret = ret
+
+    def __reduce__(self):
+        return (fn_of, (self.params, self.ret))
 
 
 # ---------------------------------------------------------------------------

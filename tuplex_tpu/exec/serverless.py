@@ -1,0 +1,564 @@
+"""Serverless-style fan-out backend (reference: AWSLambdaBackend,
+core/src/ee/aws/AWSLambdaBackend.cc:254-506 + awslambda/src/lambda_main.cc).
+
+The reference ships each stage as a protobuf InvocationRequest (LLVM
+bitcode + symbols + S3 input/output URIs) to AWS Lambda workers, uploads
+memory inputs to an S3 scratch dir, invokes up to aws.maxConcurrency
+lambdas, polls responses, and downloads output parts. This backend is the
+same architecture with TPU-native substitutions:
+
+- invocation   = a detached WORKER PROCESS (`python -m tuplex_tpu.exec.
+  worker`) — the process boundary stands in for the cloud boundary; on a
+  real pod each worker owns its own chip/host (set
+  ``tuplex.aws.workerPlatform`` accordingly).
+- bitcode      = the stage SPEC: normalized UDF sources + captured globals
+  (utils/reflection) + schemas + source recipe. Workers re-derive the
+  jitted XLA executable through the ordinary emitter — the persistent
+  compile cache dedupes compilation across workers.
+- S3 parts     = directories of native-format partitions
+  (io/tuplexfmt npz parts + manifest) under ``tuplex.aws.scratchDir``.
+- file splits  = multi-file sources are split BY FILE across tasks and
+  read inside the worker (AWSLambdaBackend.cc:410-430 input_uris); memory
+  / intermediate inputs are staged to scratch first (:306-330).
+
+Failure path: a task that dies, times out, or writes no valid response is
+retried ``tuplex.aws.retryCount`` times and finally re-run in-process on
+the driver (degrade, never wedge); every attempt lands in the backend
+failure log. Aggregate/join/limit stages run on the driver, like the
+reference's driver-side resolve/merge tier (AWSLambdaBackend.cc:468-506).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+import time
+import types
+from typing import Any, Optional
+
+from ..core.errors import TuplexException
+from ..plan import logical as L
+from ..utils.logging import get_logger
+from ..utils.reflection import UDFSource, get_udf_source
+from .local import LocalBackend, StageResult
+
+log = get_logger("tuplex_tpu.serverless")
+
+
+class NotShippable(Exception):
+    """Stage/UDF cannot be serialized for remote execution (no source, an
+    unpicklable captured global, an unknown operator...). The driver falls
+    back to in-process execution — never a user-visible failure."""
+
+
+# ---------------------------------------------------------------------------
+# UDF + operator spec (de)serialization
+# ---------------------------------------------------------------------------
+
+def _pack_value(v: Any, owner: UDFSource, seen: frozenset):
+    """One captured global -> a picklable tagged cell. `seen` carries the
+    code objects of enclosing UDFs so helper-function cycles terminate."""
+    if isinstance(v, types.ModuleType):
+        return ("mod", v.__name__)
+    if isinstance(v, types.FunctionType):
+        if getattr(owner.func, "__code__", None) is v.__code__ \
+                and owner.source.startswith("def"):
+            # a recursive def references itself by name; the worker-side
+            # exec re-binds that name in the rebuilt function's own
+            # namespace, so nothing needs to travel
+            return ("selfref",)
+        if v.__code__ in seen:
+            raise NotShippable(f"mutually recursive helper {v!r}")
+        us = get_udf_source(v)
+        if us.source:
+            return ("udf", _udf_spec(us, seen | {v.__code__}))
+        raise NotShippable(f"global function {v!r} has no source")
+    try:
+        return ("pkl", pickle.dumps(v))
+    except Exception as e:
+        raise NotShippable(f"global {v!r} not picklable: {e}") from None
+
+
+def _unpack_value(cell):
+    tag = cell[0]
+    if tag == "selfref":
+        return None   # dropped: the exec'd def binds its own name
+    if tag == "mod":
+        import importlib
+
+        return importlib.import_module(cell[1])
+    if tag == "udf":
+        return _rebuild_udf(cell[1])
+    return pickle.loads(cell[1])
+
+
+def _udf_spec(us: UDFSource, seen: frozenset = frozenset()) -> dict:
+    if not us.source:
+        raise NotShippable(f"UDF {us.name!r} has no retrievable source")
+    code = getattr(us.func, "__code__", None)
+    if code is not None:
+        seen = seen | {code}
+    return {"src": us.source, "name": us.name,
+            "globals": {k: _pack_value(v, us, seen)
+                        for k, v in us.globals.items()}}
+
+
+def _rebuild_udf(spec: dict):
+    from ..utils.reflection import udf_from_source
+
+    globs = {k: _unpack_value(c) for k, c in spec["globals"].items()
+             if c[0] != "selfref"}
+    return udf_from_source(spec["src"], spec["name"], globs)
+
+
+def _op_spec(op: L.LogicalOperator) -> tuple:
+    """Operator -> ctor recipe. Only data + UDF sources travel; the worker
+    reconstructs real operator objects against its own chain."""
+    from ..io.csvsource import CSVSourceOperator  # noqa: F401 (isinstance)
+
+    if isinstance(op, L.MapOperator):
+        return ("map", _udf_spec(op.udf))
+    if isinstance(op, L.FilterOperator):
+        return ("filter", _udf_spec(op.udf))
+    if isinstance(op, L.WithColumnOperator):
+        return ("withcol", op.column, _udf_spec(op.udf))
+    if isinstance(op, L.MapColumnOperator):
+        return ("mapcol", op.column, _udf_spec(op.udf))
+    if isinstance(op, L.SelectColumnsOperator):
+        return ("select", list(op.selected))
+    if isinstance(op, L.RenameColumnOperator):
+        return ("rename", op.old, op.new)
+    if isinstance(op, L.ResolveOperator):
+        return ("resolve", pickle.dumps(op.exc_class), _udf_spec(op.udf))
+    if isinstance(op, L.IgnoreOperator):
+        return ("ignore", pickle.dumps(op.exc_class))
+    if isinstance(op, L.TakeOperator):
+        return ("take", op.limit)
+    if isinstance(op, L.DecodeOperator):
+        return ("decode",
+                pickle.dumps((op.declared, op.null_values, op.general)))
+    raise NotShippable(f"operator {type(op).__name__} not shippable")
+
+
+def _op_rebuild(spec: tuple, parent: L.LogicalOperator) -> L.LogicalOperator:
+    kind = spec[0]
+    if kind == "map":
+        return L.MapOperator(parent, _rebuild_udf(spec[1]))
+    if kind == "filter":
+        return L.FilterOperator(parent, _rebuild_udf(spec[1]))
+    if kind == "withcol":
+        return L.WithColumnOperator(parent, spec[1], _rebuild_udf(spec[2]))
+    if kind == "mapcol":
+        return L.MapColumnOperator(parent, spec[1], _rebuild_udf(spec[2]))
+    if kind == "select":
+        return L.SelectColumnsOperator(parent, spec[1])
+    if kind == "rename":
+        return L.RenameColumnOperator(parent, spec[1], spec[2])
+    if kind == "resolve":
+        return L.ResolveOperator(parent, pickle.loads(spec[1]),
+                                 _rebuild_udf(spec[2]))
+    if kind == "ignore":
+        return L.IgnoreOperator(parent, pickle.loads(spec[1]))
+    if kind == "take":
+        return L.TakeOperator(parent, spec[1])
+    if kind == "decode":
+        declared, nulls, general = pickle.loads(spec[1])
+        return L.DecodeOperator(parent, declared, nulls, general)
+    raise TuplexException(f"unknown op spec {kind!r}")
+
+
+class _SpecInput(L.LogicalOperator):
+    """Worker-side stand-in for the upstream chain of a staged-input task:
+    fixed schema, sample shipped from the driver (may be empty — planning
+    already happened there; the sample only feeds worker-side cost
+    heuristics like compaction sizing)."""
+
+    def __init__(self, schema, columns, sample_rows):
+        super().__init__([])
+        self._schema = schema
+        self._columns = columns
+        self._sample = sample_rows
+
+    def schema(self):
+        return self._schema
+
+    def columns(self):
+        return self._columns
+
+    def sample(self):
+        from ..core.row import Row
+
+        return [Row(list(v), self._columns) for v in self._sample]
+
+
+def serialize_stage(stage) -> dict:
+    """TransformStage -> picklable spec (the InvocationRequest 'code' half;
+    reference: TransformStage::to_protobuf, physical/TransformStage.h:76)."""
+    spec: dict[str, Any] = {
+        "ops": [_op_spec(op) for op in stage.ops],
+        "schemas": pickle.dumps(
+            [op.schema() for op in stage.ops]),
+        "input_schema": pickle.dumps(stage.input_schema),
+        "input_columns": _input_columns(stage),
+        "limit": stage.limit,
+        "force_interpret": stage.force_interpret,
+        "source_projection": getattr(stage, "source_projection", None),
+        "sample": _input_sample(stage),
+    }
+    src = stage.source
+    if src is None or isinstance(src, L.ParallelizeOperator):
+        # memory input: the driver stages partitions to scratch (reference:
+        # upload to S3 scratch, AWSLambdaBackend.cc:306-330); the worker
+        # sees only the staged parts
+        spec["source"] = None
+    elif type(src).__name__ == "CSVSourceOperator":
+        spec["source"] = ("csv", src.pattern, pickle.dumps(src.stat))
+    elif type(src).__name__ == "ORCSourceOperator":
+        spec["source"] = ("orc", src.pattern, src.user_cols)
+    elif type(src).__name__ == "TuplexFileSourceOperator":
+        # directory source: the driver already has the partitions loaded;
+        # ship them through the staged-parts path like memory inputs
+        spec["source"] = None
+    else:
+        raise NotShippable(f"source {type(src).__name__} not shippable")
+    return spec
+
+
+def _input_columns(stage):
+    src_like = stage.source
+    if src_like is None and stage.ops:
+        src_like = stage.ops[0].parent if stage.ops[0].parents else None
+    if src_like is not None:
+        try:
+            return src_like.columns()
+        except Exception:
+            pass
+    return stage.input_schema.columns
+
+
+def _input_sample(stage, cap: int = 256):
+    """Up to `cap` input rows (as value tuples) for worker-side cost
+    heuristics. Best-effort: an empty sample only disables compaction."""
+    src_like = stage.source
+    if src_like is None and stage.ops and stage.ops[0].parents:
+        src_like = stage.ops[0].parent
+    if src_like is None:
+        return []
+    try:
+        rows = src_like.cached_sample()[:cap]
+        return pickle.dumps([tuple(r.values) for r in rows])
+    except Exception:
+        return []
+
+
+def rebuild_stage(spec: dict, options, files: Optional[list] = None):
+    """Spec -> executable TransformStage (worker side). `files` is this
+    task's file-split subset for file sources."""
+    from ..plan.physical import TransformStage
+
+    input_schema = pickle.loads(spec["input_schema"])
+    sample = pickle.loads(spec["sample"]) if spec["sample"] else []
+    source = None
+    sspec = spec["source"]
+    if files is None:
+        # staged-parts task: input partitions arrive via the scratch dir
+        # regardless of what the original source was
+        sspec = None
+    if sspec is None:
+        root: L.LogicalOperator = _SpecInput(
+            input_schema, spec["input_columns"], sample)
+    elif sspec[0] == "csv":
+        from ..io.csvsource import CSVSourceOperator
+
+        source = CSVSourceOperator(options, sspec[1],
+                                   pickle.loads(sspec[2]), list(files or []))
+        root = source
+    elif sspec[0] == "orc":
+        from ..io.orcsource import ORCSourceOperator
+
+        source = ORCSourceOperator(options, sspec[1], list(files or []),
+                                   sspec[2])
+        root = source
+    else:
+        raise TuplexException(f"unknown source spec {sspec!r}")
+
+    ops: list[L.LogicalOperator] = []
+    parent = root
+    schemas = pickle.loads(spec["schemas"])
+    for ospec, schema in zip(spec["ops"], schemas):
+        op = _op_rebuild(ospec, parent)
+        # authoritative schemas travel with the spec: workers must never
+        # re-speculate (different file subsets could sniff differently)
+        op._schema_cache = schema          # UDFOperator slot
+        op._schema = schema                # structural-op convention
+        ops.append(op)
+        parent = op
+
+    stage = TransformStage(source, ops, limit=spec["limit"],
+                           input_schema=input_schema,
+                           input_op=None if source is not None else root)
+    stage.force_interpret = spec["force_interpret"]
+    if spec["source_projection"] is not None:
+        stage.source_projection = spec["source_projection"]
+    return stage
+
+
+# ---------------------------------------------------------------------------
+# driver-side backend
+# ---------------------------------------------------------------------------
+
+class ServerlessBackend(LocalBackend):
+    """Fan a TransformStage out over detached worker processes with
+    object-store-style part staging. Aggregates, joins, fused folds, and
+    limited (take) stages run on the driver via LocalBackend."""
+
+    def __init__(self, options):
+        super().__init__(options)
+        # counts WORKERS, not local cores (reference: concurrent Lambda
+        # invocations) — on a real deployment each worker owns its own
+        # host/chip, so do not clamp to the driver's cpu_count
+        self.max_conc = max(1, options.get_int(
+            "tuplex.aws.maxConcurrency", 100))
+        self.retries = options.get_int("tuplex.aws.retryCount", 2)
+        self.timeout_s = options.get_int("tuplex.aws.requestTimeout", 600)
+        scratch = options.get_str("tuplex.aws.scratchDir", "") or \
+            os.path.join(options.get_str("tuplex.scratchDir",
+                                         "/tmp/tuplex_tpu"), "serverless")
+        self.scratch = scratch
+
+    # -- dispatch ----------------------------------------------------------
+    def execute_any(self, stage, partitions, context,
+                    intermediate: bool = False) -> StageResult:
+        from ..plan.physical import TransformStage
+
+        fan_out = (isinstance(stage, TransformStage)
+                   and stage.fold_op is None
+                   and stage.limit < 0
+                   and not self.interpret_only)
+        if fan_out:
+            try:
+                spec = serialize_stage(stage)
+            except NotShippable as e:
+                log.info("stage not shippable (%s); running on driver", e)
+            except Exception as e:   # serialization must never kill a job
+                log.warning("stage spec serialization failed (%s: %s); "
+                            "running on driver", type(e).__name__, e)
+            else:
+                return self._execute_fanout(stage, spec, partitions, context)
+        # device views never survive the process boundary
+        return super().execute_any(stage, partitions, context,
+                                   intermediate=False)
+
+    # -- task planning -----------------------------------------------------
+    def _plan_tasks(self, stage, spec, partitions, run_dir):
+        """Returns a list of task dicts ({'files': [...]} or
+        {'indir': path}). File sources with >1 file split BY FILE (workers
+        read their own input); everything else stages partitions to
+        scratch."""
+        from ..io.tuplexfmt import write_partitions_tuplex
+
+        src = stage.source
+        files = list(getattr(src, "files", []) or []) if src is not None \
+            else []
+        if src is not None and len(files) > 1 and spec["source"] is not None \
+                and spec["source"][0] in ("csv", "orc"):
+            n_tasks = min(self.max_conc, len(files))
+            per = -(-len(files) // n_tasks)
+            return [{"files": files[i: i + per]}
+                    for i in range(0, len(files), per)]
+        # memory / intermediate / single-file input: stage partitions
+        parts = list(partitions or [])
+        if not parts:
+            return []
+        n_tasks = min(self.max_conc, len(parts))
+        per = -(-len(parts) // n_tasks)
+        tasks = []
+        for t, i in enumerate(range(0, len(parts), per)):
+            indir = os.path.join(run_dir, f"in-{t:04d}")
+            write_partitions_tuplex(indir, parts[i: i + per], backend=self)
+            tasks.append({"indir": indir})
+        return tasks
+
+    # -- fan-out core ------------------------------------------------------
+    def _execute_fanout(self, stage, spec, partitions, context) -> StageResult:
+        import uuid
+
+        from ..utils.signals import check_interrupted
+
+        t0 = time.perf_counter()
+        fl_snap = len(self.failure_log)
+        run_dir = os.path.join(self.scratch, uuid.uuid4().hex[:12])
+        os.makedirs(run_dir, exist_ok=True)
+        tasks = self._plan_tasks(stage, spec, partitions, run_dir)
+        if not tasks:
+            return StageResult([], [], {"serverless_tasks": 0})
+        req_base = {"stage": spec, "options": self.options.to_dict()}
+        procs: dict[int, tuple[subprocess.Popen, float, int]] = {}
+        done: dict[int, Optional[str]] = {}   # task -> outdir (None = local)
+        pending = list(range(len(tasks)))
+        attempts = {t: 0 for t in pending}
+        try:
+            while pending or procs:
+                check_interrupted()
+                while pending and len(procs) < self.max_conc:
+                    t = pending.pop(0)
+                    procs[t] = (self._launch(run_dir, t, tasks[t], req_base),
+                                time.perf_counter(), attempts[t])
+                self._reap(procs, done, pending, attempts, tasks, run_dir)
+                if procs:
+                    time.sleep(0.02)
+        finally:
+            for p, _, _ in procs.values():
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+        result = self._collect(stage, tasks, done, context, run_dir, t0,
+                               fl_snap)
+        if all(d is not None for d in done.values()):
+            # clean scratch only for fully-healthy runs; failed runs keep
+            # their request/worker.log for post-mortem (reference keeps the
+            # S3 scratch parts for the same reason)
+            import shutil
+
+            shutil.rmtree(run_dir, ignore_errors=True)
+        return result
+
+    def _launch(self, run_dir: str, task: int, tspec: dict,
+                req_base: dict) -> subprocess.Popen:
+        task_dir = os.path.join(run_dir, f"task-{task:04d}")
+        os.makedirs(task_dir, exist_ok=True)
+        req = dict(req_base)
+        req["task"] = task
+        req["files"] = tspec.get("files")
+        req["indir"] = tspec.get("indir")
+        req["outdir"] = os.path.join(task_dir, "out")
+        req_path = os.path.join(task_dir, "request.pkl")
+        with open(req_path, "wb") as fp:
+            pickle.dump(req, fp)
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + \
+            env.get("PYTHONPATH", "")
+        env["TUPLEX_WORKER_PLATFORM"] = self.options.get_str(
+            "tuplex.aws.workerPlatform", "cpu")
+        with open(os.path.join(task_dir, "worker.log"), "wb") as logf:
+            return subprocess.Popen(
+                [sys.executable, "-m", "tuplex_tpu.exec.worker", req_path],
+                stdout=logf, stderr=subprocess.STDOUT, env=env)
+
+    def _reap(self, procs, done, pending, attempts, tasks, run_dir):
+        now = time.perf_counter()
+        for t in list(procs):
+            p, started, att = procs[t]
+            rc = p.poll()
+            if rc is None:
+                if now - started > self.timeout_s:
+                    p.kill()
+                    rc = -9
+                else:
+                    continue
+            del procs[t]
+            outdir = os.path.join(run_dir, f"task-{t:04d}", "out")
+            resp = os.path.join(run_dir, f"task-{t:04d}", "response.pkl")
+            if rc == 0 and os.path.exists(resp):
+                done[t] = outdir
+                continue
+            tail = self._log_tail(run_dir, t)
+            self.failure_log.append({
+                "stage": "serverless", "task": t, "attempt": att,
+                "rc": rc, "error": tail})
+            if att + 1 <= self.retries:
+                log.warning("task %d failed (rc=%s); retry %d/%d",
+                            t, rc, att + 1, self.retries)
+                attempts[t] = att + 1
+                pending.append(t)
+            else:
+                log.warning("task %d failed after %d attempts; running "
+                            "on the driver", t, att + 1)
+                done[t] = None   # degrade: in-process fallback
+
+    @staticmethod
+    def _log_tail(run_dir: str, task: int, n: int = 800) -> str:
+        try:
+            with open(os.path.join(run_dir, f"task-{task:04d}",
+                                   "worker.log"), "rb") as fp:
+                fp.seek(0, 2)
+                fp.seek(max(0, fp.tell() - n))
+                return fp.read().decode("utf-8", "replace")
+        except OSError:
+            return ""
+
+    # -- result collection -------------------------------------------------
+    def _collect(self, stage, tasks, done, context, run_dir, t0,
+                 fl_snap) -> StageResult:
+        from ..runtime import columns as C
+
+        out_parts: list = []
+        exceptions: list = []
+        metrics: dict[str, Any] = {"serverless_tasks": len(tasks),
+                                   "serverless_retries":
+                                       len(self.failure_log) - fl_snap}
+        offset = 0
+        for t in range(len(tasks)):
+            outdir = done.get(t)
+            if outdir is None:
+                res = self._run_task_local(stage, tasks[t], context)
+            else:
+                res = self._load_response(run_dir, t, outdir, context)
+            for part in res.partitions:
+                part.start_index = offset
+                offset += part.num_rows
+                self.mm.register(part)
+                out_parts.append(part)
+            exceptions.extend(res.exceptions)
+            for k, v in res.metrics.items():
+                if isinstance(v, (int, float)):
+                    metrics[k] = metrics.get(k, 0) + v
+        metrics["wall_s"] = time.perf_counter() - t0
+        metrics["rows_out"] = offset
+        return StageResult(C.harmonize_partitions(out_parts), exceptions,
+                           metrics)
+
+    def _load_response(self, run_dir, t, outdir, context) -> StageResult:
+        from ..io.tuplexfmt import TuplexFileSourceOperator
+
+        with open(os.path.join(run_dir, f"task-{t:04d}", "response.pkl"),
+                  "rb") as fp:
+            resp = pickle.load(fp)
+        for entry in resp.get("failure_log", []):
+            self.failure_log.append(dict(entry, task=t))
+        if not resp.get("rows"):
+            return StageResult([], resp.get("exceptions", []),
+                               resp.get("metrics", {}))
+        src = TuplexFileSourceOperator(self.options, outdir)
+        parts = src.load_partitions(context)
+        return StageResult(parts, resp.get("exceptions", []),
+                           resp.get("metrics", {}))
+
+    def _run_task_local(self, stage, tspec, context) -> StageResult:
+        """Degraded path: run one failed task's share in-process."""
+        from ..api.dataset import _source_partitions
+        from ..io.tuplexfmt import TuplexFileSourceOperator
+
+        if tspec.get("files") is not None:
+            sub = _clone_stage_for_files(stage, tspec["files"])
+            parts = _source_partitions(context, sub, lazy=False)
+            return LocalBackend.execute(self, sub, parts)
+        src = TuplexFileSourceOperator(self.options, tspec["indir"])
+        return LocalBackend.execute(self, stage,
+                                    src.load_partitions(context))
+
+
+def _clone_stage_for_files(stage, files):
+    """Shallow stage clone whose source reads only `files` (driver-side
+    degrade path for a failed file-split task)."""
+    import copy
+
+    sub = copy.copy(stage)
+    sub.source = copy.copy(stage.source)
+    sub.source.files = list(files)
+    return sub

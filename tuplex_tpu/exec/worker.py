@@ -1,0 +1,88 @@
+"""Serverless worker entry point (reference: awslambda/src/lambda_main.cc —
+the Lambda-side handler that parses the InvocationRequest, JIT-compiles the
+shipped stage, processes its input split, and writes output parts).
+
+Run as ``python -m tuplex_tpu.exec.worker <request.pkl>``. The request
+carries the stage spec (UDF sources + schemas), this task's input (either
+a file-split subset or a staged-partition directory), the output directory,
+and the full option set. The worker rebuilds the stage, executes it through
+the ordinary LocalBackend (fast path + general tier + interpreter resolve —
+the full dual-mode ladder, unlike the reference Lambda which defers the
+slow path to the driver), and writes native-format output parts plus a
+pickled response (metrics, exceptions).
+
+Platform: ``TUPLEX_WORKER_PLATFORM`` (set by the driver from
+``tuplex.aws.workerPlatform``) picks the jax platform POST-import — on
+machines where a TPU plugin force-registers itself, only a late
+``jax.config.update`` wins over the environment.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) != 1:
+        print("usage: python -m tuplex_tpu.exec.worker <request.pkl>",
+              file=sys.stderr)
+        return 2
+    plat = os.environ.get("TUPLEX_WORKER_PLATFORM", "")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+    with open(argv[0], "rb") as fp:
+        req = pickle.load(fp)
+
+    from ..core.options import ContextOptions
+    from ..exec.local import LocalBackend
+    from ..io.tuplexfmt import (TuplexFileSourceOperator,
+                                write_partitions_tuplex)
+    from .serverless import rebuild_stage
+
+    opts_dict = dict(req["options"])
+    # workers are leaves: never recurse into another fan-out, never serve UI
+    opts_dict["tuplex.backend"] = "local"
+    opts_dict["tuplex.webui.enable"] = "false"
+    options = ContextOptions(opts_dict)
+    backend = LocalBackend(options)
+
+    stage = rebuild_stage(req["stage"], options, files=req.get("files"))
+
+    class _Ctx:   # minimal context for source loading (duck-typed)
+        options_store = options
+
+        def __init__(self):
+            self.backend = backend
+
+    ctx = _Ctx()
+    if req.get("indir"):
+        src = TuplexFileSourceOperator(options, req["indir"])
+        partitions = src.load_partitions(ctx)
+    else:
+        from ..api.dataset import _source_partitions
+
+        partitions = _source_partitions(ctx, stage, lazy=False)
+
+    result = backend.execute(stage, partitions)
+
+    write_partitions_tuplex(req["outdir"], result.partitions,
+                            backend=backend)
+    resp = {"ok": True,
+            "rows": sum(p.num_rows for p in result.partitions),
+            "metrics": result.metrics,
+            "exceptions": result.exceptions,
+            "failure_log": list(backend.failure_log)}
+    tmp = os.path.join(os.path.dirname(argv[0]), ".response.tmp")
+    with open(tmp, "wb") as fp:
+        pickle.dump(resp, fp)
+    os.replace(tmp, os.path.join(os.path.dirname(argv[0]), "response.pkl"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -292,3 +292,22 @@ def capture_globals(func: types.FunctionType) -> dict[str, Any]:
             except ValueError:
                 pass
     return out
+
+
+def udf_from_source(source: str, name: str, globs: dict[str, Any]):
+    """Rebuild a UDF callable from its normalized source + captured globals
+    (worker side of the serverless fan-out — the reference ships LLVM
+    bitcode in its InvocationRequest, Lambda.proto:40-88; we ship source and
+    re-derive everything through the same reflection path). Seeds the source
+    memo so get_udf_source() on the rebuilt function round-trips without a
+    file behind it."""
+    ns = dict(globs)
+    if not source:
+        raise ValueError(f"UDF {name!r} has no retrievable source")
+    if source.startswith("lambda"):
+        func = eval(compile(source, "<tuplex-udf>", "eval"), ns)
+    else:
+        exec(compile(source, "<tuplex-udf>", "exec"), ns)
+        func = ns[name]
+    _source_memo[func.__code__] = source
+    return func
