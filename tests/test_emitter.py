@@ -746,3 +746,46 @@ def test_random_choice_static_seq():
     got = run_compiled(lambda x: random.choice(("lo", "mid", "hi")), [0] * 99)
     assert set(got) <= {"lo", "mid", "hi"}
     assert len(set(got)) > 1
+
+
+def test_str_pad_methods():
+    vals = ["abc", "", "x", "hello world", "exact"]
+    check(lambda s: s.center(9), vals)
+    check(lambda s: s.center(8), vals)
+    check(lambda s: s.center(10, "*"), vals)
+    check(lambda s: s.ljust(7), vals)
+    check(lambda s: s.rjust(7, "0"), vals)
+    check(lambda s: s.center(0), vals)
+
+
+def test_str_split_whitespace_mode():
+    vals = ["a b  c", "one", "  lead", "trail  ", "", "   ", "x\ty z"]
+    check(lambda s: s.split()[0], vals)          # IndexError on empties
+    check(lambda s: s.split()[1], vals)
+    check(lambda s: len(s.split()), vals)
+    check(lambda s: "yes" if s.split() else "no", vals)
+
+
+def test_str_split_maxsplit():
+    vals = ["a,b,c,d", "one", "x,y", "", "a,,b"]
+    check(lambda s: s.split(",", 1)[0], vals)
+    check(lambda s: s.split(",", 1)[1], vals)    # remainder keeps commas
+    check(lambda s: s.split(",", 2)[2], vals)
+    check(lambda s: len(s.split(",", 1)), vals)
+    wv = ["a b  c d", " x ", ""]
+    check(lambda s: s.split(None, 1)[1], wv)     # ws remainder
+    check(lambda s: len(s.split(None, 2)), wv)
+
+
+def test_str_pad_unicode_rows_route_to_interpreter():
+    # byte-width padding diverges from python's char-width for multibyte
+    # rows: those must fall back, and a multibyte fill char must not ship
+    vals = ["héllo", "ascii", "日本語"]
+    check(lambda s: s.center(8), vals)
+    check(lambda s: s.ljust(8), vals)
+    check(lambda s: s.rjust(8, "0"), vals)
+    import pytest as _pytest
+
+    from tuplex_tpu.core.errors import NotCompilable as _NC
+    with _pytest.raises(_NC):
+        run_compiled(lambda s: s.ljust(5, "é"), ["x"])

@@ -929,12 +929,28 @@ class Frame:
     _SPLIT_INDEX_CAP = 32
 
     def _split_item(self, sv: CV, k: int) -> CV:
-        """s.split(sep)[k] — k-th piece via k unrolled finds; rows with
+        """s.split(sep[, maxsplit])[k] — k-th piece via k unrolled finds
+        (sep mode) or token-bound kernels (whitespace mode); rows with
         fewer pieces raise IndexError (python semantics)."""
-        sb, sl, sep = sv.sbytes, sv.slen, sv.names[0]
-        m = len(sep)
+        sb, sl = sv.sbytes, sv.slen
+        sep, maxsplit = sv.names
         if k < 0:
             raise NotCompilable("split negative index")
+        if maxsplit is not None and k > maxsplit:
+            # len(result) <= maxsplit+1 always: IndexError on every row
+            self.raise_where(jnp.ones(self.ctx.b, dtype=bool),
+                             ExceptionCode.INDEXERROR)
+            return CV(t=T.STR, sbytes=jnp.zeros_like(sb),
+                      slen=jnp.zeros_like(sl))
+        if sep is None:
+            start, stop, missing = S.ws_token_bounds(sb, sl, k)
+            if maxsplit is not None and k == maxsplit:
+                # remainder piece: from token k's start to end of string
+                stop = jnp.where(missing, stop, sl)
+            self.raise_where(missing, ExceptionCode.INDEXERROR)
+            fb, fl = S.slice_(sb, sl, start, stop)
+            return CV(t=T.STR, sbytes=fb, slen=fl)
+        m = len(sep)
         if k > self._SPLIT_INDEX_CAP:
             raise NotCompilable(f"split index {k} beyond unroll cap")
         start = jnp.zeros(self.ctx.b, dtype=jnp.int32)
@@ -945,6 +961,8 @@ class Frame:
             start = jnp.where(pos < 0, start, pos + m)
         nxt = S.find_const(sb, sl, sep, start=start)
         stop = jnp.where(nxt < 0, sl, nxt)
+        if maxsplit is not None and k == maxsplit:
+            stop = sl   # remainder keeps later separators
         self.raise_where(missing, ExceptionCode.INDEXERROR)
         fb, fl = S.slice_(sb, sl, start, stop)
         return CV(t=T.STR, sbytes=fb, slen=fl)
@@ -986,7 +1004,10 @@ class Frame:
     # ===================================================================
     def truthy(self, v: CV):
         if v.kind == "split":
-            # split() always yields at least one piece
+            if v.names[0] is None:
+                # whitespace mode CAN yield zero pieces ("".split() == [])
+                return S.ws_token_count(v.sbytes, v.slen) > 0
+            # sep mode always yields at least one piece
             return jnp.ones(self.ctx.b, dtype=bool)
         if v.kind == "match":
             # a match object is truthy exactly when the match exists (the
@@ -1432,17 +1453,23 @@ class Frame:
             return self._format_method(recv.const, args)
         if name == "split":
             self._ascii_guard(rb, rl)
-            if len(args) > 1:
-                raise NotCompilable("str.split maxsplit")
-            if not args:
-                raise NotCompilable("str.split() whitespace mode")
-            sep = need_const_str(0)
-            if sep == "":
-                raise NotCompilable("str.split empty separator")
+            if len(args) > 2:
+                raise NotCompilable("str.split arity")
+            maxsplit = None
+            if len(args) == 2:
+                if not (args[1].is_const and isinstance(args[1].const, int)):
+                    raise NotCompilable("str.split dynamic maxsplit")
+                maxsplit = args[1].const if args[1].const >= 0 else None
+            if not args or (args[0].is_const and args[0].const is None):
+                sep = None     # whitespace mode: runs of ws, ends stripped
+            else:
+                sep = need_const_str(0)
+                if sep == "":
+                    raise NotCompilable("str.split empty separator")
             # LAZY view (reference: split codegen'd lazily too,
             # FunctionRegistry): only [const_int] and len() force pieces —
             # the result's ARITY is data-dependent, so it can't be a tuple
-            return CV(t=T.PYOBJECT, kind="split", names=(sep,),
+            return CV(t=T.PYOBJECT, kind="split", names=(sep, maxsplit),
                       sbytes=rb, slen=rl)
         if name == "join":
             if not (recv.is_const and isinstance(recv.const, str)):
@@ -1461,8 +1488,23 @@ class Frame:
                 out = it if out is None else self._str_concat(
                     self._str_concat(out, sep_cv), it)
             return out if out is not None else const_cv("")
-        if name == "center":
-            raise NotCompilable("str.center")
+        if name in ("center", "ljust", "rjust"):
+            # width semantics are per CHARACTER: multibyte rows must take
+            # the interpreter path like the other byte-position methods
+            self._ascii_guard(rb, rl)
+            if not (args and args[0].is_const
+                    and isinstance(args[0].const, int)):
+                raise NotCompilable(f"str.{name} dynamic width")
+            fill = " "
+            if len(args) > 1:
+                if not (args[1].is_const and isinstance(args[1].const, str)
+                        and len(args[1].const.encode()) == 1):
+                    raise NotCompilable(f"str.{name} fill char")
+                fill = args[1].const
+            kern = {"center": S.center, "ljust": S.pad_right,
+                    "rjust": S.pad_left}[name]
+            fb, fl = kern(rb, rl, args[0].const, fill)
+            return CV(t=T.STR, sbytes=fb, slen=fl)
         if name == "zfill":
             if not (args and args[0].is_const and isinstance(args[0].const, int)):
                 raise NotCompilable("str.zfill dynamic width")
@@ -1632,8 +1674,15 @@ class Frame:
     def _builtin_len(self, args: list[CV]) -> CV:
         if args and args[0].kind == "split":
             sv = args[0]
-            cnt = S.count_const(sv.sbytes, sv.slen, sv.names[0])
-            return CV(t=T.I64, data=cnt.astype(jnp.int64) + 1)
+            sep, maxsplit = sv.names
+            if sep is None:
+                cnt = S.ws_token_count(sv.sbytes, sv.slen)
+            else:
+                cnt = S.count_const(sv.sbytes, sv.slen, sep) \
+                    .astype(jnp.int64) + 1
+            if maxsplit is not None:
+                cnt = jnp.minimum(cnt, maxsplit + 1)
+            return CV(t=T.I64, data=cnt.astype(jnp.int64))
         v = args[0]
         if v.is_const:
             try:

@@ -769,3 +769,52 @@ def pad_right(bytes_, lens, width: int, fillchar: str = " "):
     out = jnp.where(in_pad, fill, bytes_)
     inside = pos < out_len[:, None]
     return jnp.where(inside, out, 0).astype(jnp.uint8), out_len.astype(jnp.int32)
+
+
+def center(bytes_, lens, width: int, fillchar: str = " "):
+    """str.center(width[, fillchar]) with CPython's left-margin rule
+    (marg // 2 + (marg & width & 1))."""
+    n, w = bytes_.shape
+    wout = max(w, width)
+    fill = const_bytes(fillchar)[0]
+    marg = jnp.maximum(width - lens, 0)
+    left = marg // 2 + (marg & width & 1)
+    out_len = jnp.maximum(lens, width)
+    pos = jnp.arange(wout, dtype=jnp.int32)[None, :]
+    src_idx = pos - left[:, None]
+    in_body = (src_idx >= 0) & (src_idx < lens[:, None])
+    padded = jnp.pad(bytes_, ((0, 0), (0, max(0, wout - w + 1))))
+    gathered = jnp.take_along_axis(padded, jnp.clip(src_idx, 0, w), axis=1)[:, :wout]
+    inside = pos < out_len[:, None]
+    out = jnp.where(in_body, gathered, jnp.where(inside, fill, 0))
+    return out.astype(jnp.uint8), out_len.astype(jnp.int32)
+
+
+def _ws_token_marks(bytes_, lens):
+    """(starts, nonws) masks for whitespace-separated tokens."""
+    inside = _pos_mask(bytes_.shape[1], lens)
+    nonws = inside & ~_is_space(bytes_)
+    prev = jnp.pad(nonws[:, :-1], ((0, 0), (1, 0)))
+    return nonws & ~prev, nonws
+
+
+def ws_token_count(bytes_, lens):
+    """Number of whitespace-separated tokens per row (len(s.split()))."""
+    starts, _ = _ws_token_marks(bytes_, lens)
+    return jnp.sum(starts, axis=1).astype(jnp.int64)
+
+
+def ws_token_bounds(bytes_, lens, k: int):
+    """(start, stop, missing) of the k-th whitespace-separated token.
+    start==w sentinel rows are reported via `missing`."""
+    n, w = bytes_.shape
+    starts, nonws = _ws_token_marks(bytes_, lens)
+    ordn = jnp.cumsum(starts, axis=1)
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    cand = jnp.where(starts & (ordn == k + 1), pos, w)
+    start = jnp.min(cand, axis=1).astype(jnp.int32)
+    missing = start >= w
+    after = pos >= start[:, None]
+    cand2 = jnp.where(after & ~nonws, pos, w)
+    stop = jnp.minimum(jnp.min(cand2, axis=1).astype(jnp.int32), lens)
+    return start, stop, missing
