@@ -789,3 +789,65 @@ def test_str_pad_unicode_rows_route_to_interpreter():
     from tuplex_tpu.core.errors import NotCompilable as _NC
     with _pytest.raises(_NC):
         run_compiled(lambda s: s.ljust(5, "é"), ["x"])
+
+
+def test_dict_methods_compile():
+    # reference: FunctionRegistry dict pop/popitem codegen
+    check(lambda x: {"a": x, "b": x * 2}.pop("a"), [1, 5])
+    check(lambda x: {"a": x}.popitem(), [1, 2])
+    check(lambda x: {"a": x, "b": 2}.get("b"), [7])
+    check(lambda x: {"a": x}.get("zz", -1), [7])
+
+    def f(x):
+        d = {"a": x, "b": x + 1}
+        v = d.pop("a")
+        return (v, d["b"], len(d.keys()))
+    check(f, [3, 10])
+
+
+def test_math_binary_and_isclose():
+    import math
+
+    check(lambda x: math.fmod(x, 3.0), [7.5, -7.5, 0.0])
+    check(lambda x: math.hypot(x, 4.0), [3.0, 0.0])
+    check(lambda x: math.copysign(x, -1.0), [3.0, -2.0])
+    check(lambda x: math.atan2(x, 1.0), [1.0, -1.0])
+    check(lambda x: math.isclose(x, 1.0), [1.0, 1.0 + 1e-12, 1.1])
+
+
+def test_dict_pop_alias_and_receiver_safety():
+    # aliased dicts and subscripted receivers must fall back (a dropped
+    # mutation would silently diverge from CPython); the emitter refuses,
+    # and the PRODUCT path then gets the right answer on the interpreter
+    import pytest as _pytest
+
+    import tuplex_tpu
+    from tuplex_tpu.core.errors import NotCompilable as _NC
+
+    def aliased(x):
+        d = {"a": x, "b": 1}
+        e = d
+        d.pop("a")
+        return len(e.keys())
+
+    def sub_receiver(x):
+        t = ({"a": x, "b": 1},)
+        t[0].pop("a")
+        return len(t[0])
+
+    with _pytest.raises(_NC):
+        run_compiled(aliased, [5])
+    with _pytest.raises(_NC):
+        run_compiled(sub_receiver, [5])
+    ctx = tuplex_tpu.Context()
+    assert ctx.parallelize([5]).map(aliased).collect() == [1]
+    assert ctx.parallelize([5]).map(sub_receiver).collect() == [1]
+
+
+def test_math_fmod_zero_and_isclose_inf():
+    import math
+
+    check(lambda x: math.fmod(10.0, x), [3.0, 0.0, -2.0])  # ValueError row
+    check(lambda x: math.isclose(x / 0.5 * 0.5, x), [1e308, 3.3])
+    vals = [float("inf"), 1.0]
+    check(lambda x: math.isclose(x, float("inf")), vals)

@@ -108,6 +108,7 @@ class Emitter:
                 raise NotCompilable(
                     f"UDF takes {len(params)} args, got {len(args)}")
         frame = Frame(self, dict(zip(params, args)))
+        frame.udf_tree = tree
         if isinstance(tree, ast.Lambda):
             return frame.eval(tree.body)
         assert isinstance(tree, ast.FunctionDef)
@@ -791,6 +792,10 @@ class Frame:
                         except NotCompilable:
                             pass
                     return self._module_fn(fn, args)
+            if recv is not None and recv.elts is not None \
+                    and recv.names is not None:
+                args = [self.eval(a) for a in node.args]
+                return self._dict_method(node, recv, node.func.attr, args)
             raise NotCompilable(f"method {node.func.attr}")
         if not isinstance(node.func, ast.Name):
             raise NotCompilable("computed call target")
@@ -1512,6 +1517,88 @@ class Frame:
             return CV(t=T.STR, sbytes=fb, slen=fl)
         raise NotCompilable(f"str.{name}")
 
+    # -- dict methods (named-row CVs; reference: FunctionRegistry dict
+    # pop/popitem codegen) --------------------------------------------------
+    def _dict_method(self, node, recv: CV, name: str, args: list[CV]) -> CV:
+        keys = list(recv.names or ())
+        if name == "get":
+            if not (args and args[0].is_const
+                    and isinstance(args[0].const, str)):
+                raise NotCompilable("dict.get dynamic key")
+            if args[0].const in keys:
+                return recv.elts[keys.index(args[0].const)]
+            return args[1] if len(args) > 1 else const_cv(None)
+        if name == "keys":
+            return tuple_cv([const_cv(k) for k in keys])
+        if name == "values":
+            return tuple_cv(list(recv.elts))
+        if name == "items":
+            return tuple_cv([tuple_cv([const_cv(k), v])
+                             for k, v in zip(keys, recv.elts)])
+        if name in ("pop", "popitem"):
+            if name == "pop":
+                if not (args and args[0].is_const
+                        and isinstance(args[0].const, str)):
+                    raise NotCompilable("dict.pop dynamic key")
+                key = args[0].const
+                if key not in keys:
+                    if len(args) > 1:
+                        return args[1]
+                    raise NotCompilable(f"dict.pop missing key {key!r}")
+                idx = keys.index(key)
+                ret: CV = recv.elts[idx]
+            else:
+                if args:
+                    raise NotCompilable("dict.popitem arity")
+                if not keys:
+                    raise NotCompilable("popitem on empty dict")
+                idx = len(keys) - 1
+                ret = tuple_cv([const_cv(keys[idx]), recv.elts[idx]])
+            rest = tuple_cv([e for j, e in enumerate(recv.elts) if j != idx],
+                            names=[k for j, k in enumerate(keys) if j != idx])
+            # mutation is only sound on receivers we can fully account for:
+            # a plain un-aliased name (rebind) or a fresh temporary whose
+            # value nothing else can observe. Anything else (subscript/
+            # attribute receivers, aliased names) must fall back, or the
+            # dropped mutation silently diverges from CPython
+            tgt = node.func.value
+            if isinstance(tgt, ast.Name):
+                if self._name_escapes(tgt.id):
+                    raise NotCompilable(f"dict.{name} on aliased dict")
+                if tgt.id in self.env:
+                    self._assign_target(tgt, rest)
+            elif not isinstance(tgt, (ast.Dict, ast.DictComp, ast.Call)):
+                raise NotCompilable(f"dict.{name} on non-name receiver")
+            return ret
+        raise NotCompilable(f"dict.{name}")
+
+    def _name_escapes(self, name: str) -> bool:
+        """Conservative alias analysis over the UDF AST: may `name`'s value
+        be observable through ANOTHER binding? True for any bare-Name read
+        that isn't the receiver of a subscript/attribute access — e.g.
+        `e = d`, `(d,)`, `f(d)`, `return d`. Mutating through the name is
+        only sound when it never escapes (value-semantics env can't model
+        shared mutation)."""
+        tree = getattr(self, "udf_tree", None)
+        if tree is None:
+            return True   # no tree to analyze: assume the worst
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Name) and node.id == name
+                    and isinstance(node.ctx, ast.Load)):
+                continue
+            parent = getattr(node, "_tpx_parent", None)
+            if parent is None:
+                # annotate lazily once per tree
+                for p in ast.walk(tree):
+                    for ch in ast.iter_child_nodes(p):
+                        ch._tpx_parent = p  # type: ignore[attr-defined]
+                parent = getattr(node, "_tpx_parent", None)
+            if isinstance(parent, (ast.Subscript, ast.Attribute)) and \
+                    parent.value is node:
+                continue   # d[...] / d.method(...): receiver use, no escape
+            return True
+        return False
+
     # -- comparisons --------------------------------------------------------
     def _compare(self, op: ast.cmpop, a: CV, b: CV):
         # None comparisons: x is None / x == None
@@ -1810,12 +1897,36 @@ class Frame:
             self._ascii_guard(rb, rl)  # unicode whitespace divergence
             fb, fl = S.capwords(rb, rl)
             return CV(t=T.STR, sbytes=fb, slen=fl)
-        if mod == "math" and name == "pow":
-            a = self._require_numeric(args[0], "math.pow")
-            b = self._require_numeric(args[1], "math.pow")
-            return CV(t=T.F64, data=jnp.power(self._cast(a.data, T.F64),
-                                              self._cast(b.data, T.F64)))
+        if mod == "math" and name in self._MATH_BINARY:
+            jfn = self._MATH_BINARY[name]
+            a = self._require_numeric(args[0], f"math.{name}")
+            b = self._require_numeric(args[1], f"math.{name}")
+            bd = self._cast(b.data, T.F64)
+            if name == "fmod":
+                # math.fmod(x, 0.0) raises ValueError in CPython; jnp.fmod
+                # would silently emit NaN
+                self.raise_where(bd == 0.0, ExceptionCode.VALUEERROR)
+            return CV(t=T.F64, data=jfn(self._cast(a.data, T.F64), bd))
+        if mod == "math" and name == "isclose":
+            if len(args) != 2:
+                raise NotCompilable("math.isclose arity")
+            a = self._cast(self._require_numeric(args[0], "isclose").data,
+                           T.F64)
+            c = self._cast(self._require_numeric(args[1], "isclose").data,
+                           T.F64)
+            tol = 1e-09 * jnp.maximum(jnp.abs(a), jnp.abs(c))
+            # CPython order: a == b short-circuits True (equal infinities
+            # are close); any remaining infinity is False (the formula's
+            # inf tolerance would otherwise accept everything)
+            finite = ~(jnp.isinf(a) | jnp.isinf(c))
+            return CV(t=T.BOOL,
+                      data=(a == c) | (finite & (jnp.abs(a - c) <= tol)))
         raise NotCompilable(f"module fn {mod}.{name}")
+
+    _MATH_BINARY = {
+        "pow": jnp.power, "fmod": jnp.fmod, "hypot": jnp.hypot,
+        "copysign": jnp.copysign, "atan2": jnp.arctan2,
+    }
 
 
 # ---------------------------------------------------------------------------
