@@ -137,3 +137,19 @@ def test_device_view_one_shot():
     assert C.stage_partition(p) is view
     assert p.device_batch is None
     assert C.stage_partition(p) is not view
+
+
+def test_dispatch_with_donation(monkeypatch):
+    # donation marks stage inputs donatable; results must stay exact and
+    # retries/overflow re-runs must still work (they re-stage from host)
+    monkeypatch.setenv("TUPLEX_DONATE", "1")
+    import tuplex_tpu
+
+    ctx = tuplex_tpu.Context()
+    got = (ctx.parallelize([(i, f"s{i}") for i in range(5000)],
+                           columns=["a", "s"])
+           .map(lambda x: {"v": x["a"] * 3, "s": x["s"].upper()})
+           .filter(lambda x: x["v"] % 2 == 0)
+           .collect())
+    want = [(i * 3, f"S{i}") for i in range(5000) if (i * 3) % 2 == 0]
+    assert got == want

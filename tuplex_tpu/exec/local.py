@@ -115,9 +115,18 @@ class LocalBackend:
 
     def _jit_stage_fn(self, raw_fn):
         """Compile a stage fn for dispatch (overridden by MultiHostBackend
-        to row-shard over a mesh)."""
+        to row-shard over a mesh). Input buffers are donated off-CPU: the
+        staged batch is dead once the kernel reads it (consumers re-stage
+        from host leaves or a one-shot handoff view), so XLA may reuse its
+        HBM for the outputs (reference analog: partitions freed/recycled
+        as tasks retire, Partition ref-counting)."""
         import jax
 
+        from ..runtime.jaxcfg import donation_enabled
+
+        if donation_enabled() and self.options.get_bool(
+                "tuplex.tpu.donateBuffers", True):
+            return jax.jit(raw_fn, donate_argnums=0)
         return jax.jit(raw_fn)
 
     # ------------------------------------------------------------------

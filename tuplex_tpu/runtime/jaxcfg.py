@@ -127,3 +127,18 @@ def stmt_barriers_enabled() -> bool:
     if mode in ("0", "1"):
         return mode == "1"
     return fusion_barriers_enabled()
+
+
+def donation_enabled() -> bool:
+    """Whether stage dispatch donates its input device buffers to XLA
+    (halves per-stage HBM residency: the staged input is dead the moment
+    the kernel reads it — every consumer re-stages from host leaves or a
+    one-shot handoff view). Off on CPU, where XLA does not support
+    donation and would warn per call. TUPLEX_DONATE=0/1 overrides (tests
+    force it on under the CPU platform to exercise the path)."""
+    import os
+
+    mode = os.environ.get("TUPLEX_DONATE", "auto")
+    if mode in ("0", "1"):
+        return mode == "1"
+    return jax.default_backend() not in ("cpu",)
