@@ -148,15 +148,28 @@ class DataSet:
 
         return plan_to_dot(self._op)
 
-    def tocsv(self, path: str, **kwargs) -> None:
+    def tocsv(self, path: str, part_size: int = 0, num_rows: int = -1,
+              num_parts: int = 0, part_name_generator=None,
+              null_value=None, header=True, **kwargs) -> None:
         """Stream results to CSV from columnar buffers — normal-case rows
         never box into python tuples (reference: buildWithCSVRowWriter,
-        PipelineBuilder.h:238; round 1 collected the whole dataset first)."""
+        PipelineBuilder.h:238; round 1 collected the whole dataset first).
+
+        Signature parity with the reference (dataset.py:500-509):
+        `num_parts` splits the output evenly across part files (last part
+        smallest), `part_size` rotates parts on a byte budget,
+        `part_name_generator(i)` names them, `num_rows` limits output,
+        `null_value` renders None cells, `header` may be a bool or an
+        explicit list of column names."""
         from ..io.csvsink import write_partitions_csv
 
         partitions = self._execute_partitions(limit=-1)
         write_partitions_csv(path, partitions, self.columns,
                              backend=self._context.backend,
+                             part_size=part_size, num_rows=num_rows,
+                             num_parts=num_parts,
+                             part_name_generator=part_name_generator,
+                             null_value=null_value, header=header,
                              **kwargs)
         self._finish_file_job(partitions)
 

@@ -90,14 +90,44 @@ def _leaf_to_arrow(part: C.Partition, ci: int, ct: T.Type):
     return None
 
 
+def _part_path(path: str, idx: int, multi: bool,
+               part_name_generator=None) -> str:
+    """Output path for part `idx` (reference: defaultPartNameGenerator /
+    user part_name_generator, dataset.py tocsv). Multi-part output ALWAYS
+    treats `path` as a directory — no filename heuristics that could
+    disagree with the single-file resolver. A raising generator propagates:
+    the reference documents it "should not raise", and silently mixing
+    naming schemes would hide the user's bug."""
+    if not multi:
+        return _resolve_path(path)
+    name = f"part{idx}.csv" if part_name_generator is None \
+        else str(part_name_generator(idx))
+    if VirtualFileSystem._scheme(path) == "file":
+        root = VirtualFileSystem._strip(path)
+        os.makedirs(root or ".", exist_ok=True)
+        return os.path.join(root, name)
+    return path.rstrip("/") + "/" + name
+
+
 def write_partitions_csv(path: str, partitions: list,
                          columns: Optional[Sequence[str]] = None,
-                         delimiter: str = ",", backend=None) -> None:
-    """Stream partitions to ONE csv file without materializing python rows."""
+                         delimiter: str = ",", backend=None,
+                         part_size: int = 0, num_rows: int = -1,
+                         num_parts: int = 0, part_name_generator=None,
+                         null_value: Optional[str] = None,
+                         header=True) -> None:
+    """Stream partitions to one or more csv part files without
+    materializing python rows (reference: FileOutputOperator splitting —
+    num_parts splits evenly with the last part smallest, part_size rotates
+    parts on a byte budget; dataset.py:500-509 signature parity)."""
+    import io as _io
+
     import pyarrow as pa
     import pyarrow.csv as pacsv
 
-    import io as _io
+    if isinstance(header, (list, tuple)):
+        columns = list(header)
+        header = True
 
     def header_bytes(cols) -> bytes:
         txt = _io.StringIO()
@@ -105,42 +135,153 @@ def write_partitions_csv(path: str, partitions: list,
                    lineterminator="\r\n").writerow(list(cols))
         return txt.getvalue().encode("utf-8")
 
-    path = _resolve_path(path)
     opts = pacsv.WriteOptions(include_header=False, delimiter=delimiter)
-    with VirtualFileSystem.open_write(path) as sink:
-        header_written = False
-        if columns:
-            # known upfront: empty results still get a header-only file
-            sink.write(header_bytes(columns))
-            header_written = True
-        for part in partitions:
+
+    parts = list(partitions)
+    total = sum(p.num_rows for p in parts)
+    if num_rows >= 0:
+        total = min(total, num_rows)
+    multi = num_parts > 0 or part_size > 0
+    # rows per part: even split for num_parts (last part smallest);
+    # part_size rotates on the running byte budget instead
+    rows_per_part = -(-total // num_parts) if num_parts > 0 else None
+
+    state = {"sink": None, "cm": None, "idx": 0, "rows": 0, "bytes": 0,
+             "written": 0}
+
+    def close_current():
+        if state["cm"] is not None:
+            state["cm"].__exit__(None, None, None)   # finalizes VFS uploads
+            state["cm"] = state["sink"] = None
+
+    def open_next(cols):
+        close_current()
+        p = _part_path(path, state["idx"], multi, part_name_generator)
+        state["cm"] = VirtualFileSystem.open_write(p)
+        state["sink"] = state["cm"].__enter__()
+        state["idx"] += 1
+        state["rows"] = 0
+        state["bytes"] = 0
+        if header and cols is not None:
+            state["sink"].write(header_bytes(cols))
+
+    def emit(payload: bytes, nrows: int, cols):
+        if state["sink"] is None:
+            open_next(cols)
+        elif multi and state["rows"] > 0 and (
+                (rows_per_part is not None and
+                 state["rows"] + nrows > rows_per_part) or
+                (rows_per_part is None and part_size > 0 and
+                 state["bytes"] + len(payload) > part_size)):
+            open_next(cols)
+        state["sink"].write(payload)
+        state["rows"] += nrows
+        state["bytes"] += len(payload)
+        state["written"] += nrows
+
+    first_cols = columns
+    try:
+        for part in parts:
             if backend is not None:
                 backend.mm.touch(part)
             if part.num_rows == 0:
                 continue
-            cols = columns or part.user_columns or \
+            if num_rows >= 0 and state["written"] >= num_rows:
+                break
+            take = part.num_rows
+            if num_rows >= 0:
+                take = min(take, num_rows - state["written"])
+            cols = first_cols or part.user_columns or \
                 [f"_{i}" for i in range(len(part.schema.types))]
-            if not header_written:
-                header_written = True
-                sink.write(header_bytes(cols))
+            # num_parts rotation points are GLOBAL row multiples: chunk
+            # this partition exactly at them so a dataset spanning many
+            # partitions still yields exactly num_parts files
+            sizes = None
+            if rows_per_part is not None:
+                sizes, pos = [], state["written"]
+                end = pos + take
+                while pos < end:
+                    nb = (pos // rows_per_part + 1) * rows_per_part
+                    sizes.append(min(nb, end) - pos)
+                    pos = min(nb, end)
+            payloads = _part_payloads(part, take, delimiter, null_value,
+                                      opts, sizes, part_size)
+            for payload, nrows in payloads:
+                emit(payload, nrows, cols)
+        if state["sink"] is None:
+            # empty result: still produce one (possibly header-only) file
+            open_next(first_cols)
+    finally:
+        close_current()
+
+
+def _chunk_sizes(part, take: int, sizes, part_size: int) -> list[int]:
+    """Chunk plan for one partition: explicit global num_parts boundaries
+    when given, else a byte-budget granularity for part_size, else one
+    chunk."""
+    if sizes is not None:
+        return sizes
+    if part_size and part_size > 0:
+        # rotation granularity from the columnar size as a bytes/row proxy
+        # (csv rendering inflates numerics but the order is right)
+        nbytes = 0
+        for leaf in part.leaves.values():
+            arr = getattr(leaf, "bytes", None)
+            if arr is None:
+                arr = getattr(leaf, "data", None)
+            if arr is not None:
+                nbytes += arr.nbytes
+        est = max(8, nbytes // max(1, part.num_rows))
+        chunk = max(16, min(take, part_size // est))
+        return [min(chunk, take - o) for o in range(0, take, chunk)]
+    return [take]
+
+
+def _part_payloads(part, take: int, delimiter: str,
+                   null_value: Optional[str], opts,
+                   sizes, part_size):
+    """Yield (csv_bytes, n_rows) chunks for one partition, split exactly at
+    part-rotation points so `emit` only ever rotates between chunks."""
+    import io as _io
+
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    import pyarrow.csv as pacsv
+
+    chunks = _chunk_sizes(part, take, sizes, part_size)
+    arrays = None
+    if not part.fallback:
+        arrays = [_leaf_to_arrow(part, ci, ct)
+                  for ci, ct in enumerate(part.schema.types)]
+        if any(a is None for a in arrays):
             arrays = None
-            if not part.fallback:
-                arrays = [_leaf_to_arrow(part, ci, ct)
-                          for ci, ct in enumerate(part.schema.types)]
-                if any(a is None for a in arrays):
-                    arrays = None
-            if arrays is None:
-                # boxed / nested partitions (rare): python formatting keeps
-                # row order exact
-                txt = _io.StringIO()
-                w = csv.writer(txt, delimiter=delimiter,
-                               lineterminator="\r\n")
-                for r in C.partition_to_pylist(part):
-                    w.writerow(list(r) if isinstance(r, tuple) else [r])
-                sink.write(txt.getvalue().encode("utf-8"))
-                continue
-            table = pa.table(dict(zip([str(i) for i in range(len(arrays))],
-                                      arrays)))
-            buf = pa.BufferOutputStream()
-            pacsv.write_csv(table, buf, opts)
-            sink.write(buf.getvalue().to_pybytes())
+    if arrays is None:
+        # boxed / nested partitions (rare): python formatting keeps row
+        # order exact — same chunk plan as the columnar path
+        rows = C.partition_to_pylist(part)[:take]
+        off = 0
+        for n in chunks:
+            txt = _io.StringIO()
+            w = csv.writer(txt, delimiter=delimiter, lineterminator="\r\n")
+            for r in rows[off: off + n]:
+                cells = list(r) if isinstance(r, tuple) else [r]
+                if null_value is not None:
+                    cells = [null_value if c is None else c for c in cells]
+                w.writerow(cells)
+            yield txt.getvalue().encode("utf-8"), n
+            off += n
+        return
+    if take < part.num_rows:
+        arrays = [a.slice(0, take) for a in arrays]
+    if null_value is not None:
+        arrays = [pc.fill_null(pc.cast(a, pa.string()), null_value)
+                  if a.null_count else a for a in arrays]
+    names = [str(i) for i in range(len(arrays))]
+    off = 0
+    for n in chunks:
+        table = pa.table(dict(zip(names,
+                                  [a.slice(off, n) for a in arrays])))
+        buf = pa.BufferOutputStream()
+        pacsv.write_csv(table, buf, opts)
+        yield buf.getvalue().to_pybytes(), n
+        off += n
