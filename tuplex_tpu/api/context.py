@@ -72,15 +72,18 @@ class Context:
         raise TuplexException(f"unknown backend {name!r}")
 
     # ------------------------------------------------------------------
-    def parallelize(self, data: Sequence[Any],
+    def parallelize(self, value_list: Sequence[Any],
                     columns: Optional[Sequence[str]] = None,
-                    schema: Optional[T.RowType] = None) -> "DataSet":
-        """Create a DataSet from python values (reference: context.py
+                    schema: Optional[T.RowType] = None,
+                    auto_unpack: bool = True) -> "DataSet":
+        """Create a DataSet from python values (reference: context.py:246
         parallelize → PythonContext.cc:823-919 fast transfer + fallback
-        partitions for non-conforming rows)."""
+        partitions for non-conforming rows). `auto_unpack=False` keeps dict
+        rows as boxed dictionary values instead of spreading them into
+        named columns."""
         from .dataset import DataSet
 
-        data = list(data)
+        data = list(value_list)
         if not data:
             raise TuplexException("parallelize: empty input")
         max_rows = self.options_store.get_int(
@@ -88,11 +91,14 @@ class Context:
         threshold = self.options_store.get_float(
             "tuplex.normalcaseThreshold", 0.9)
         if schema is None:
-            schema = _infer_row_schema(data[:max_rows], columns, threshold)
+            schema = _infer_row_schema(
+                data[:max_rows], columns, threshold,
+                auto_unpack=auto_unpack)
         elif columns:
             schema = T.row_of(columns, schema.types)
 
-        if C.user_columns(schema) and any(isinstance(v, dict) for v in data[:8]):
+        if auto_unpack and C.user_columns(schema) and \
+                any(isinstance(v, dict) for v in data[:8]):
             # dict rows were auto-unpacked into named columns: convert values
             # (rows missing keys stay boxed and go to the fallback path)
             keys = list(schema.columns)
@@ -106,20 +112,25 @@ class Context:
         return DataSet(self, op)
 
     def csv(self, pattern: str, columns=None, header=None, delimiter=None,
-            type_hints=None, null_values=None) -> "DataSet":
+            quotechar: Optional[str] = None, null_values=None,
+            type_hints=None) -> "DataSet":
         from ..io.csvsource import make_csv_operator
         from .dataset import DataSet
 
         op = make_csv_operator(self.options_store, pattern, columns=columns,
                                header=header, delimiter=delimiter,
-                               type_hints=type_hints, null_values=null_values)
+                               quotechar=quotechar, type_hints=type_hints,
+                               null_values=null_values)
         return DataSet(self, op)
 
-    def text(self, pattern: str) -> "DataSet":
+    def text(self, pattern: str, null_values=None) -> "DataSet":
+        """One row per line; lines equal to a null value load as None
+        (reference: context.py text → FileInputOperator text mode)."""
         from ..io.csvsource import make_text_operator
         from .dataset import DataSet
 
-        return DataSet(self, make_text_operator(self.options_store, pattern))
+        return DataSet(self, make_text_operator(self.options_store, pattern,
+                                                null_values=null_values))
 
     def orc(self, pattern: str, columns=None) -> "DataSet":
         from ..io.orcsource import make_orc_operator
@@ -137,11 +148,27 @@ class Context:
 
         return DataSet(self, make_tuplex_operator(self.options_store, path))
 
-    def options(self) -> dict:
-        return self.options_store.as_dict()
+    def options(self, nested: bool = False) -> dict:
+        flat = self.options_store.as_dict()
+        if not nested:
+            return flat
+        out: dict = {}
+        for k, v in flat.items():
+            cur = out
+            ks = k.split(".")
+            for piece in ks[:-1]:
+                nxt = cur.setdefault(piece, {})
+                if not isinstance(nxt, dict):   # leaf-then-group collision
+                    nxt = cur[piece] = {"": nxt}
+                cur = nxt
+            if isinstance(cur.get(ks[-1]), dict):
+                cur[ks[-1]][""] = v             # group-then-leaf collision
+            else:
+                cur[ks[-1]] = v
+        return out
 
-    def optionsToYAML(self, path: str) -> None:
-        with open(path, "w") as fp:
+    def optionsToYAML(self, file_path: str = "config.yaml") -> None:
+        with open(file_path, "w") as fp:
             for k, v in sorted(self.options_store.as_dict().items()):
                 fp.write(f"{k}: {v}\n")
 
@@ -151,10 +178,10 @@ class Context:
 
         return VirtualFileSystem.ls(pattern)
 
-    def cp(self, src: str, dst: str) -> None:
+    def cp(self, pattern: str, target_uri: str) -> None:
         from ..io.vfs import VirtualFileSystem
 
-        VirtualFileSystem.cp(src, dst)
+        VirtualFileSystem.cp(pattern, target_uri)
 
     def rm(self, pattern: str) -> None:
         from ..io.vfs import VirtualFileSystem
@@ -187,10 +214,11 @@ class Context:
             pass
 
 
-def _infer_row_schema(sample: list, columns, threshold: float) -> T.RowType:
+def _infer_row_schema(sample: list, columns, threshold: float,
+                      auto_unpack: bool = True) -> T.RowType:
     """Column-wise normal-case speculation (reference:
     PythonContext.cc:1023 inferType — majority type over the sample)."""
-    dicts = all(isinstance(v, dict) for v in sample)
+    dicts = auto_unpack and all(isinstance(v, dict) for v in sample)
     if dicts and sample:
         # auto-unpack string-keyed dicts into named columns (reference:
         # strDictParallelize, PythonContext.cc:617)

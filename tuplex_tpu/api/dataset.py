@@ -29,31 +29,32 @@ class DataSet:
         return DataSet(self._context, op)
 
     # -- transformations ----------------------------------------------------
-    def map(self, udf: Callable) -> "DataSet":
-        return self._derive(L.MapOperator(self._op, udf))
+    def map(self, ftor: Callable) -> "DataSet":
+        return self._derive(L.MapOperator(self._op, ftor))
 
-    def filter(self, udf: Callable) -> "DataSet":
-        return self._derive(L.FilterOperator(self._op, udf))
+    def filter(self, ftor: Callable) -> "DataSet":
+        return self._derive(L.FilterOperator(self._op, ftor))
 
-    def withColumn(self, column: str, udf: Callable) -> "DataSet":
-        return self._derive(L.WithColumnOperator(self._op, column, udf))
+    def withColumn(self, column: str, ftor: Callable) -> "DataSet":
+        return self._derive(L.WithColumnOperator(self._op, column, ftor))
 
-    def mapColumn(self, column: str, udf: Callable) -> "DataSet":
-        return self._derive(L.MapColumnOperator(self._op, column, udf))
+    def mapColumn(self, column: str, ftor: Callable) -> "DataSet":
+        return self._derive(L.MapColumnOperator(self._op, column, ftor))
 
     def selectColumns(self, columns: Sequence) -> "DataSet":
         if not isinstance(columns, (list, tuple)):
             columns = [columns]
         return self._derive(L.SelectColumnsOperator(self._op, columns))
 
-    def renameColumn(self, old, new: str) -> "DataSet":
-        return self._derive(L.RenameColumnOperator(self._op, old, new))
+    def renameColumn(self, key, newColumnName: str) -> "DataSet":
+        return self._derive(
+            L.RenameColumnOperator(self._op, key, newColumnName))
 
-    def resolve(self, exc_class: type, udf: Callable) -> "DataSet":
-        return self._derive(L.ResolveOperator(self._op, exc_class, udf))
+    def resolve(self, eclass: type, ftor: Callable) -> "DataSet":
+        return self._derive(L.ResolveOperator(self._op, eclass, ftor))
 
-    def ignore(self, exc_class: type) -> "DataSet":
-        return self._derive(L.IgnoreOperator(self._op, exc_class))
+    def ignore(self, eclass: type) -> "DataSet":
+        return self._derive(L.IgnoreOperator(self._op, eclass))
 
     def unique(self) -> "DataSet":
         from ..plan.aggregates import UniqueOperator
@@ -61,33 +62,35 @@ class DataSet:
         return self._derive(UniqueOperator(self._op))
 
     def aggregate(self, combine: Callable, aggregate: Callable,
-                  initial: Any) -> "DataSet":
+                  initial_value: Any) -> "DataSet":
         from ..plan.aggregates import AggregateOperator
 
         return self._derive(
-            AggregateOperator(self._op, combine, aggregate, initial))
+            AggregateOperator(self._op, combine, aggregate, initial_value))
 
     def aggregateByKey(self, combine: Callable, aggregate: Callable,
-                       initial: Any, key_columns: Sequence[str]) -> "DataSet":
+                       initial_value: Any,
+                       key_columns: Sequence[str]) -> "DataSet":
         from ..plan.aggregates import AggregateByKeyOperator
 
         return self._derive(AggregateByKeyOperator(
-            self._op, combine, aggregate, initial, key_columns))
+            self._op, combine, aggregate, initial_value, key_columns))
 
-    def join(self, other: "DataSet", left_column: str, right_column: str,
-             prefixes=None, suffixes=None) -> "DataSet":
+    def join(self, dsRight: "DataSet", leftKeyColumn: str,
+             rightKeyColumn: str, prefixes=None, suffixes=None) -> "DataSet":
         from ..plan.joins import JoinOperator
 
         return self._derive(JoinOperator(
-            self._op, other._op, left_column, right_column, "inner",
+            self._op, dsRight._op, leftKeyColumn, rightKeyColumn, "inner",
             prefixes, suffixes))
 
-    def leftJoin(self, other: "DataSet", left_column: str, right_column: str,
-                 prefixes=None, suffixes=None) -> "DataSet":
+    def leftJoin(self, dsRight: "DataSet", leftKeyColumn: str,
+                 rightKeyColumn: str, prefixes=None,
+                 suffixes=None) -> "DataSet":
         from ..plan.joins import JoinOperator
 
         return self._derive(JoinOperator(
-            self._op, other._op, left_column, right_column, "left",
+            self._op, dsRight._op, leftKeyColumn, rightKeyColumn, "left",
             prefixes, suffixes))
 
     def cache(self, store_specialized: bool = True) -> "DataSet":
@@ -173,12 +176,18 @@ class DataSet:
                              **kwargs)
         self._finish_file_job(partitions)
 
-    def toorc(self, path: str, **kwargs) -> None:
+    def toorc(self, path: str, part_size: int = 0, num_rows: int = -1,
+              num_parts: int = 0, part_name_generator=None) -> None:
+        """Write ORC with the same splitting controls as tocsv (reference:
+        dataset.py:554 toorc signature)."""
         from ..io.orcsource import write_partitions_orc
 
         partitions = self._execute_partitions(limit=-1)
         write_partitions_orc(path, partitions, self.columns,
-                             backend=self._context.backend)
+                             backend=self._context.backend,
+                             part_size=part_size, num_rows=num_rows,
+                             num_parts=num_parts,
+                             part_name_generator=part_name_generator)
         self._finish_file_job(partitions)
 
     def totuplex(self, path: str) -> None:

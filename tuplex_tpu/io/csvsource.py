@@ -152,7 +152,8 @@ class CSVStatistic:
                  header: Optional[bool] = None,
                  null_values: Optional[Sequence[str]] = None,
                  columns: Optional[Sequence[str]] = None,
-                 type_hints: Optional[dict] = None):
+                 type_hints: Optional[dict] = None,
+                 quotechar: str = '"'):
         text = sample_bytes.decode("utf-8", errors="replace")
         # drop a possibly-truncated last line
         if not sample_bytes.endswith(b"\n") and "\n" in text:
@@ -160,8 +161,10 @@ class CSVStatistic:
         self.null_values = tuple(null_values) if null_values is not None \
             else DEFAULT_NULL_VALUES
         self.delimiter = delimiter or sniff_delimiter(text)
+        self.quotechar = quotechar or '"'
         rows = list(_pycsv.reader(_io.StringIO(text),
-                                  delimiter=self.delimiter))
+                                  delimiter=self.delimiter,
+                                  quotechar=self.quotechar))
         rows = [r for r in rows if r]
         if not rows:
             raise TuplexException("empty CSV sample")
@@ -287,6 +290,7 @@ class CSVSourceOperator(L.LogicalOperator):
 
             parse_opts = pacsv.ParseOptions(
                 delimiter=stat.delimiter,
+                quote_char=getattr(stat, "quotechar", '"'),
                 invalid_row_handler=on_invalid)
             with pacsv.open_csv(_csv_input(path), read_options=read_opts,
                                 parse_options=parse_opts,
@@ -331,6 +335,7 @@ class CSVSourceOperator(L.LogicalOperator):
             autogenerate_column_names=False)
         parse_opts = pacsv.ParseOptions(
             delimiter=stat.delimiter,
+            quote_char=getattr(stat, "quotechar", '"'),
             invalid_row_handler=on_invalid)
         conv_opts = pacsv.ConvertOptions(
             column_types={c: pa.string() for c in stat.columns},
@@ -382,7 +387,9 @@ def _bad_rows_partition(bad_rows: list, stat: "CSVStatistic",
     vals = []
     for _, text in bad_rows:
         try:
-            cells = next(_pycsv.reader([text], delimiter=stat.delimiter))
+            cells = next(_pycsv.reader(
+                [text], delimiter=stat.delimiter,
+                quotechar=getattr(stat, "quotechar", '"')))
         except Exception:
             cells = [text]
         vals.append(tuple(cells[i] if i < len(cells) else None
@@ -402,7 +409,8 @@ def _scan_bad_records(path: str, stat: "CSVStatistic"
         text = fp.read().decode("utf-8", errors="replace")
     ordinal = 0
     skip_header = stat.has_header
-    for rec in _pycsv.reader(_io.StringIO(text), delimiter=stat.delimiter):
+    for rec in _pycsv.reader(_io.StringIO(text), delimiter=stat.delimiter,
+                             quotechar=getattr(stat, "quotechar", '"')):
         if not rec:
             continue  # blank line: Arrow skips it too
         if skip_header:
@@ -516,15 +524,25 @@ class TextSourceOperator(L.LogicalOperator):
     """One row per line (reference: logical FileInputOperator text mode +
     physical/TextReader.cc)."""
 
-    def __init__(self, options, pattern: str, files: list[str]):
+    def __init__(self, options, pattern: str, files: list[str],
+                 null_values: Optional[Sequence[str]] = None):
         super().__init__([])
         self.pattern = pattern
         self.files = files
-        self._schema = T.row_of(["_0"], [T.STR])
+        self.null_values = tuple(null_values) if null_values else ()
+        self._schema = T.row_of(
+            ["_0"], [T.option(T.STR) if self.null_values else T.STR])
         self._sample_lines: Optional[list[str]] = None
 
+    def _null_map(self, lines):
+        if not self.null_values:
+            return lines
+        nv = set(self.null_values)
+        return [None if ln in nv else ln for ln in lines]
+
     def source_key(self):
-        return files_fingerprint(self.files, extra=self.pattern)
+        return files_fingerprint(self.files,
+                                 extra=(self.pattern, self.null_values))
 
     def schema(self) -> T.RowType:
         return self._schema
@@ -538,7 +556,8 @@ class TextSourceOperator(L.LogicalOperator):
                                                       errors="replace")
                 lines = chunk.splitlines()[:1000]
             self._sample_lines = lines
-        return [Row((ln,), None) for ln in self._sample_lines]
+        return [Row((ln,), None)
+                for ln in self._null_map(self._sample_lines)]
 
     def load_partitions(self, context, projection=None) -> list[C.Partition]:
         parts = []
@@ -546,7 +565,7 @@ class TextSourceOperator(L.LogicalOperator):
         for f in self.files:
             with VirtualFileSystem.open_read(f, "rb") as fp:
                 text = fp.read().decode("utf-8", errors="replace")
-            lines = text.splitlines()
+            lines = self._null_map(text.splitlines())
             psize = context.options_store.get_size(
                 "tuplex.partitionSize", 32 << 20)
             rows_pp = max(256, psize // 64)
@@ -572,7 +591,10 @@ def _file_sig(path: str):
 
 
 def make_csv_operator(options, pattern: str, columns=None, header=None,
-                      delimiter=None, type_hints=None, null_values=None):
+                      delimiter=None, type_hints=None, null_values=None,
+                      quotechar: Optional[str] = None):
+    if quotechar is None:
+        quotechar = options.get_str("tuplex.csv.quotechar", '"') or '"'
     files = VirtualFileSystem.glob_input(pattern)
     if not files:
         raise TuplexException(f"no files match {pattern!r}")
@@ -585,7 +607,8 @@ def make_csv_operator(options, pattern: str, columns=None, header=None,
     sig = _file_sig(files[0])
     skey = None
     if sig is not None:
-        skey = (sig, max_sample, delimiter, header, tuple(null_values),
+        skey = (sig, max_sample, delimiter, header, quotechar,
+                tuple(null_values),
                 tuple(columns) if columns else None,
                 tuple(sorted(type_hints.items())) if type_hints else None,
                 options.get_float("tuplex.normalcaseThreshold", 0.9),
@@ -601,7 +624,7 @@ def make_csv_operator(options, pattern: str, columns=None, header=None,
         sample = fp.read(max_sample)
     stat = CSVStatistic(sample, options, delimiter=delimiter, header=header,
                         null_values=null_values, columns=columns,
-                        type_hints=type_hints)
+                        type_hints=type_hints, quotechar=quotechar)
     if skey is not None:
         if len(_STAT_CACHE) >= _STAT_CACHE_CAP:
             _STAT_CACHE.pop(next(iter(_STAT_CACHE)))
@@ -616,8 +639,9 @@ def _decoded_schema(stat: CSVStatistic) -> T.RowType:
     return T.row_of(stat.columns, stat.types)
 
 
-def make_text_operator(options, pattern: str):
+def make_text_operator(options, pattern: str, null_values=None):
     files = VirtualFileSystem.glob_input(pattern)
     if not files:
         raise TuplexException(f"no files match {pattern!r}")
-    return TextSourceOperator(options, pattern, files)
+    return TextSourceOperator(options, pattern, files,
+                              null_values=null_values)

@@ -186,9 +186,14 @@ def write_orc(path: str, rows: list, columns: Optional[Sequence[str]] = None
 
 def write_partitions_orc(path: str, partitions: list,
                          columns: Optional[Sequence[str]] = None,
-                         backend=None) -> None:
+                         backend=None, part_size: int = 0,
+                         num_rows: int = -1, num_parts: int = 0,
+                         part_name_generator=None) -> None:
     """Stream partitions to ORC from columnar buffers (no boxing for
-    normal-case rows); boxed/nested partitions fall back to write_orc."""
+    normal-case rows); boxed/nested partitions fall back to write_orc.
+    Splitting parity with tocsv (reference: FileOutputOperator): num_parts
+    slices the Arrow table at exact global row multiples (zero-copy),
+    part_size rotates on a byte budget, num_rows limits output."""
     import os
 
     import pyarrow as pa
@@ -197,9 +202,21 @@ def write_partitions_orc(path: str, partitions: list,
     from ..runtime import columns as C
     from .csvsink import _leaf_to_arrow
 
-    if path.endswith("/") or os.path.isdir(path):
+    multi = num_parts > 0 or part_size > 0
+    part_root = None
+    if multi:
+        part_root = path.rstrip("/")
+        os.makedirs(part_root, exist_ok=True)
+    elif path.endswith("/") or os.path.isdir(path):
         os.makedirs(path, exist_ok=True)
         path = os.path.join(path, "part0.orc")
+
+    def part_file(idx: int) -> str:
+        if not multi:
+            return path
+        name = f"part{idx}.orc" if part_name_generator is None \
+            else str(part_name_generator(idx))
+        return os.path.join(part_root, name)
     tables = []
     boxed_rows: list = []
     names = None
@@ -227,7 +244,46 @@ def write_partitions_orc(path: str, partitions: list,
             if backend is not None:
                 backend.mm.touch(part)   # earlier touches may have spilled it
             rows.extend(C.partition_to_pylist(part))
-        write_orc(path, rows, columns)
+        if num_rows >= 0:
+            rows = rows[:num_rows]
+        if not multi:
+            write_orc(path, rows, columns)
+            return
+        if num_parts > 0:
+            n_parts = num_parts
+        else:
+            # estimate bytes/row from a sample of the boxed rows so the
+            # byte budget is honored like the columnar paths
+            probe = rows[:64]
+            est = max(8, sum(len(str(r)) for r in probe)
+                      // max(1, len(probe)))
+            n_parts = max(1, -(-len(rows) * est // part_size))
+        per = -(-max(len(rows), 1) // n_parts)
+        widx = 0
+        for i in range(n_parts):
+            chunk = rows[i * per:(i + 1) * per]
+            if not chunk:
+                continue   # ORC cannot type an empty untyped table
+            write_orc(part_file(widx), chunk, columns)
+            widx += 1
         return
-    paorc.write_table(pa.concat_tables(tables, promote_options="default"),
-                      path)
+    table = pa.concat_tables(tables, promote_options="default")
+    if num_rows >= 0:
+        table = table.slice(0, num_rows)
+    if not multi:
+        paorc.write_table(table, path)
+        return
+    if num_parts > 0:
+        per = -(-table.num_rows // num_parts)
+        n_parts = num_parts
+    else:
+        per_bytes = max(1, table.nbytes // max(1, table.num_rows))
+        per = max(16, part_size // per_bytes)
+        n_parts = -(-table.num_rows // per)
+    widx = 0
+    for i in range(n_parts):
+        chunk = table.slice(i * per, per)
+        if chunk.num_rows == 0 and i > 0:
+            continue   # short datasets: never emit trailing empty parts
+        paorc.write_table(chunk, part_file(widx))
+        widx += 1
