@@ -107,3 +107,13 @@ def test_toorc_tiny_dataset_skips_empty_parts(tmp_path):
         rows += list(zip(t.column("x").to_pylist(),
                          t.column("s").to_pylist()))
     assert rows == [(1, "a"), (2, "b")]
+
+
+def test_lambda_context_uses_serverless(tmp_path):
+    from tuplex_tpu.exec.serverless import ServerlessBackend
+
+    c = tuplex_tpu.LambdaContext({"tuplex.aws.maxConcurrency": 2,
+                                  "tuplex.aws.scratchDir": str(tmp_path)})
+    assert isinstance(c.backend, ServerlessBackend)
+    got = c.parallelize([1, 2, 3]).map(lambda x: x * 10).collect()
+    assert got == [10, 20, 30]

@@ -249,10 +249,12 @@ def _infer_row_schema(sample: list, columns, threshold: float,
 
 class LambdaContext(Context):
     """Distributed-by-default Context (reference: python/tuplex/__init__.py
-    exports LambdaContext preset to the serverless backend; here the
-    distributed seam is the mesh backend)."""
+    exports LambdaContext preset to the serverless backend). Now that the
+    serverless fan-out exists (`exec/serverless.py` — the AWSLambdaBackend
+    analog) it is the honest default here too; pass
+    ``tuplex.backend=multihost`` for SPMD-mesh distribution instead."""
 
     def __init__(self, conf=None, **kwargs):
         merged = dict(conf or {})
-        merged.setdefault("tuplex.backend", "multihost")
+        merged.setdefault("tuplex.backend", "serverless")
         super().__init__(merged, **kwargs)
