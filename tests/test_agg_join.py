@@ -612,3 +612,45 @@ def test_fused_fold_with_dirty_rows(tmp_path):
             exc += 1
     assert got == [want]
     assert sum(ds.exception_counts().values()) == exc
+
+
+def test_mesh_failure_degrades_to_single_device_compiled():
+    # elastic tier: a broken mesh dispatch must step down to a NON-mesh
+    # compiled fn (not the interpreter) and stay there for later partitions
+    import tuplex_tpu
+    from tuplex_tpu.exec.multihost import MultiHostBackend
+
+    ctx = tuplex_tpu.Context({"tuplex.backend": "multihost",
+                              "tuplex.partitionSize": "64KB"})
+    backend = ctx.backend
+    assert isinstance(backend, MultiHostBackend)
+    orig = MultiHostBackend._jit_stage_fn
+    calls = {"n": 0}
+
+    def poisoned(self, raw_fn):
+        inner = orig(self, raw_fn)
+
+        def flaky(arrays):
+            calls["n"] += 1
+            if calls["n"] > 1:
+                # mesh 'lost' after the first partition (a trace-time
+                # failure would mark the stage not-compilable instead)
+                raise RuntimeError("mesh lost")
+            return inner(arrays)
+        return flaky
+
+    MultiHostBackend._jit_stage_fn = poisoned
+    try:
+        got = (ctx.parallelize([(i, f"s{i}") for i in range(4000)],
+                               columns=["a", "s"])
+               .map(lambda x: (x["a"] * 2, x["s"].upper()))
+               .collect())
+    finally:
+        MultiHostBackend._jit_stage_fn = orig
+    assert got == [(i * 2, f"S{i}") for i in range(4000)]
+    actions = [e["action"] for e in backend.failure_log]
+    assert "elastic" in actions
+    # later partitions ride the degraded compiled fn: exactly ONE elastic
+    # degrade, no interpreter entries
+    assert "interpreter" not in actions
+    assert actions.count("elastic") == 1

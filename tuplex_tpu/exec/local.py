@@ -42,6 +42,16 @@ class ExceptionRecord:
         return f"<{self.exc_name} at op#{self.op_id}: {self.row!r}>"
 
 
+class _DispatchFailed:
+    """Sentinel riding the dispatch window when the device call itself
+    raised synchronously (wedged runtime, lost mesh) — the collect side
+    re-raises it into the same retry -> elastic -> interpreter ladder as
+    async failures surfacing at device_get."""
+
+    def __init__(self, err: Exception):
+        self.err = err
+
+
 @dataclass
 class StageResult:
     partitions: list[C.Partition]
@@ -200,7 +210,7 @@ class LocalBackend:
         from ..utils.signals import check_interrupted
 
         def collect_one():
-            nonlocal emitted_total
+            nonlocal emitted_total, device_fn, use_comp, skey
             part, outs, dispatch_s = window.popleft()
             if limit >= 0 and emitted_total >= limit:
                 return  # limit met: drop already-dispatched work unprocessed
@@ -210,6 +220,8 @@ class LocalBackend:
             self.mm.pin(part)
             try:
                 try:
+                    if isinstance(outs, _DispatchFailed):
+                        raise outs.err
                     outp, excs, m = self._collect_partition(
                         stage, part, outs, dispatch_s,
                         intermediate=intermediate)
@@ -237,18 +249,67 @@ class LocalBackend:
                             stage, part, outs2, d2,
                             intermediate=intermediate)
                     except Exception as e2:
-                        self.failure_log.append({
-                            "stage": skey[:16],
-                            "start_index": part.start_index,
-                            "rows": part.num_rows, "attempt": 2,
-                            "error": f"{type(e2).__name__}: {e2}",
-                            "action": "interpreter"})
-                        get_logger("exec").warning(
-                            "retry failed (%s: %s); partition runs on the "
-                            "interpreter", type(e2).__name__, e2)
-                        outp, excs, m = self._collect_partition(
-                            stage, part, None, 0.0,
-                            intermediate=intermediate)
+                        efn = self._elastic_stage_fn(stage, skey, in_schema)
+                        outp = None
+                        if efn is not None:
+                            # elastic tier: the distributed dispatch is
+                            # broken (lost device / wedged collective) —
+                            # degrade to a non-mesh COMPILED fn for this
+                            # and all later partitions of the stage
+                            # (reference analog: Lambda re-invokes failed
+                            # tasks on fresh workers)
+                            self.failure_log.append({
+                                "stage": skey[:16],
+                                "start_index": part.start_index,
+                                "rows": part.num_rows, "attempt": 2,
+                                "error": f"{type(e2).__name__}: {e2}",
+                                "action": "elastic"})
+                            ekey = skey + "/elastic"
+                            try:
+                                _, outs3, d3 = self._dispatch_partition(
+                                    part, efn, ekey, False, stage)
+                                if outs3 is None:
+                                    # elastic fn couldn't trace either:
+                                    # demote the whole stage cleanly
+                                    self._not_compilable.add(skey)
+                                else:
+                                    outp, excs, m = \
+                                        self._collect_partition(
+                                            stage, part, outs3, d3,
+                                            intermediate=intermediate)
+                                    # later partitions ride the elastic fn
+                                    # UNDER ITS OWN bookkeeping key (the
+                                    # mesh fn's traced-spec records must
+                                    # not vouch for a different fn)
+                                    device_fn, use_comp = efn, False
+                                    skey = ekey
+                                    get_logger("exec").warning(
+                                        "mesh dispatch failed twice "
+                                        "(%s: %s); stage degraded to "
+                                        "single-device execution",
+                                        type(e2).__name__, e2)
+                            except Exception as e3:
+                                self.failure_log.append({
+                                    "stage": skey[:16],
+                                    "start_index": part.start_index,
+                                    "rows": part.num_rows, "attempt": 3,
+                                    "error":
+                                        f"{type(e3).__name__}: {e3}",
+                                    "action": "elastic-failed"})
+                                outp = None
+                        if outp is None:
+                            self.failure_log.append({
+                                "stage": skey[:16],
+                                "start_index": part.start_index,
+                                "rows": part.num_rows, "attempt": 2,
+                                "error": f"{type(e2).__name__}: {e2}",
+                                "action": "interpreter"})
+                            get_logger("exec").warning(
+                                "retry failed (%s: %s); partition runs on "
+                                "the interpreter", type(e2).__name__, e2)
+                            outp, excs, m = self._collect_partition(
+                                stage, part, None, 0.0,
+                                intermediate=intermediate)
             finally:
                 self.mm.unpin(part)
             self.mm.register(outp)
@@ -283,8 +344,14 @@ class LocalBackend:
                 device_fn, use_comp = self._build_stage_fn(
                     stage, in_schema, skey, False)
             self.mm.touch(part)
-            window.append(self._dispatch_partition(part, device_fn, skey,
-                                                    use_comp, stage))
+            try:
+                window.append(self._dispatch_partition(part, device_fn,
+                                                       skey, use_comp,
+                                                       stage))
+            except Exception as e:
+                # synchronous dispatch failure: enqueue for the collect
+                # side's degrade ladder instead of killing the job
+                window.append((part, _DispatchFailed(e), 0.0))
             if len(window) >= window_size:
                 collect_one()
         while window:
@@ -339,6 +406,13 @@ class LocalBackend:
                 arrays=arrays, n=m, b=b2, schema=outp.schema)
         except Exception:   # pragma: no cover - purely an optimization
             outp.device_batch = None
+
+    # ------------------------------------------------------------------
+    def _elastic_stage_fn(self, stage, skey: str, in_schema):
+        """Compiled fallback when the PRIMARY dispatch path is broken, or
+        None (single-device backends have nothing between retry and the
+        interpreter; the mesh backend degrades to a non-mesh executable)."""
+        return None
 
     # ------------------------------------------------------------------
     def _build_stage_fn(self, stage, in_schema, skey: str, use_comp: bool):

@@ -45,6 +45,24 @@ class MultiHostBackend(LocalBackend):
         self.mesh = M.make_mesh(n)
         self.n_devices = n
 
+    def _elastic_stage_fn(self, stage, skey, in_schema):
+        """Elastic degrade: the mesh dispatch failed twice (lost device,
+        wedged collective) — keep the COMPILED path alive on one device
+        instead of dropping all the way to the interpreter (reference
+        analog: AWSLambdaBackend re-invoking failed tasks on new workers;
+        SPMD can't shrink mid-job, so the graceful step down is
+        single-device)."""
+        import jax
+
+        try:
+            raw = stage.build_device_fn(
+                in_schema, compaction=False,
+                fused_fold=self.supports_fused_fold)
+        except Exception:
+            return None
+        return self.jit_cache.get_or_build(
+            ("elastic", skey), lambda: jax.jit(raw))
+
     def _jit_stage_fn(self, raw_fn):
         """Row-shard over ALL mesh devices. Non-pow2 meshes work too: the
         batch pads up to a multiple of the mesh size before dispatch (padded
