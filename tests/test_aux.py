@@ -197,3 +197,52 @@ def test_stdlib_completer_fallback():
     # token-level fallback must work inside call contexts (readline hands
     # us 'alp' for 'len(alp')
     assert "alpha_beta" in jc._stdlib_complete("alp")
+
+
+def test_sample_exception_previews_recorded(tmp_path):
+    # reference: SampleProcessor runs sample rows through real UDFs so the
+    # webui can preview exceptions BEFORE execution; our plan-time tracing
+    # records the same per-operator previews into the job_start event
+    import json
+
+    import tuplex_tpu
+
+    c = tuplex_tpu.Context({"tuplex.webui.enable": True,
+                            "tuplex.logDir": str(tmp_path)})
+    got = (c.parallelize([1, 2, 0, 4])
+           .map(lambda x: 12 // x)
+           .resolve(ZeroDivisionError, lambda x: -1)
+           .collect())
+    assert got == [12, 6, -1, 3]
+    events = [json.loads(ln) for ln in
+              open(tmp_path / "tuplex_history.jsonl")]
+    starts = [e for e in events if e["event"] == "job_start"]
+    pv = [p for e in starts for p in e.get("sample_exception_previews", [])]
+    assert any(p["exc"] == "ZeroDivisionError" for p in pv), pv
+
+
+def test_sample_previews_dedup_mapcolumn_and_memo(tmp_path):
+    import json
+
+    import tuplex_tpu
+
+    # mapColumn failures preview too, entries dedup, and a rebuilt
+    # identical pipeline (cross-job memo hit) still carries previews
+    def run(logdir):
+        c = tuplex_tpu.Context({"tuplex.webui.enable": True,
+                                "tuplex.logDir": str(logdir)})
+        got = (c.parallelize([{"a": 1}, {"a": 0}, {"a": 0}])
+               .mapColumn("a", lambda v: 10 // v)
+               .resolve(ZeroDivisionError, lambda v: -1)
+               .collect())
+        assert got == [10, -1, -1]
+        events = [json.loads(ln) for ln in
+                  open(logdir / "tuplex_history.jsonl")]
+        return [p for e in events if e["event"] == "job_start"
+                for p in e.get("sample_exception_previews", [])]
+
+    pv = run(tmp_path)
+    assert any(p["exc"] == "ZeroDivisionError" and
+               p["op"] == "MapColumnOperator" for p in pv), pv
+    # duplicates collapse: both zero rows produce identical entries -> one
+    assert len([p for p in pv if p["exc"] == "ZeroDivisionError"]) == 1

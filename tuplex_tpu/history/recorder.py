@@ -39,8 +39,19 @@ class JobRecorder:
 
     def job_started(self, action: str, plan: list) -> None:
         self._new_job()  # each action is its own job in the dashboard
+        previews = []
+        for st in plan:
+            for op in getattr(st, "ops", []) or []:
+                for exc_name, row_repr in getattr(
+                        op, "sample_exceptions", [])[
+                            : self.exception_display_limit]:
+                    previews.append({"op": type(op).__name__, "op_id": op.id,
+                                     "exc": exc_name, "row": row_repr})
         self._write({"event": "job_start", "action": action,
-                     "stages": [type(s).__name__ for s in plan]})
+                     "stages": [type(s).__name__ for s in plan],
+                     # sample-time exception previews (reference:
+                     # SampleProcessor feeding the webui BEFORE execution)
+                     "sample_exception_previews": previews})
 
     def stage_done(self, stage, metrics: dict, exceptions: list) -> None:
         self._stage_no += 1

@@ -28,6 +28,23 @@ _cross_job_samples: dict[str, list] = {}
 _cross_job_schemas: dict[str, Any] = {}
 
 
+SAMPLE_EXC_CAP = 16   # recorder slices to tuplex.webui.exceptionDisplayLimit
+
+
+def record_sample_exc(op: "LogicalOperator", e: Exception, row) -> None:
+    """Sample-time exception preview (reference: SampleProcessor running
+    sample rows through real UDFs to give the webui per-operator exception
+    previews, include/physical/SampleProcessor.h:26-103). Deduplicated and
+    capped, attached to the operator, surfaced via the job recorder (the
+    same row fails in both schema inference AND sampling — one entry)."""
+    lst = getattr(op, "sample_exceptions", None)
+    if lst is None:
+        lst = op.sample_exceptions = []
+    entry = (type(e).__name__, repr(getattr(row, "values", row))[:200])
+    if len(lst) < SAMPLE_EXC_CAP and entry not in lst:
+        lst.append(entry)
+
+
 def apply_udf_python(udf: UDFSource, row: Row) -> Any:
     """Interpreter-path calling convention shared by sampling and the
     fallback pipeline (reference: PythonPipelineBuilder's generated Row class,
@@ -113,14 +130,20 @@ class LogicalOperator:
         memo = getattr(self, "_sample_memo", None)
         if memo is None:
             ck = self.chain_key()
-            if ck is not None:
-                memo = _cross_job_samples.get(ck)
-            if memo is None:
+            hit = _cross_job_samples.get(ck) if ck is not None else None
+            if hit is not None:
+                memo, excs = hit
+                if excs:   # previews travel with the memo: a rebuilt
+                    # identical pipeline skips the UDF re-runs but must
+                    # still show its sample exceptions
+                    self.sample_exceptions = list(excs)
+            else:
                 memo = self.sample()
                 if ck is not None:
                     if len(_cross_job_samples) > 256:
                         _cross_job_samples.clear()
-                    _cross_job_samples[ck] = memo
+                    _cross_job_samples[ck] = (
+                        memo, list(getattr(self, "sample_exceptions", [])))
             self._sample_memo = memo
         return memo
 
@@ -185,8 +208,8 @@ class MapOperator(UDFOperator):
         for r in self.parent.cached_sample():
             try:
                 outs.append(apply_udf_python(self.udf, r))
-            except Exception:
-                pass
+            except Exception as e:
+                record_sample_exc(self, e, r)
         if not outs:
             # UDF failed on EVERY sample row: job still runs, all rows become
             # exception rows (schema degrades to pyobject)
@@ -213,7 +236,8 @@ class MapOperator(UDFOperator):
         for r in self.parent.cached_sample():
             try:
                 v = apply_udf_python(self.udf, r)
-            except Exception:
+            except Exception as e:
+                record_sample_exc(self, e, r)
                 continue
             if isinstance(v, dict):
                 out.append(Row(list(v.values()), list(v.keys())))
@@ -235,8 +259,8 @@ class FilterOperator(UDFOperator):
             try:
                 if apply_udf_python(self.udf, r):
                     out.append(r)
-            except Exception:
-                pass
+            except Exception as e:
+                record_sample_exc(self, e, r)
         return out
 
 
@@ -257,8 +281,8 @@ class WithColumnOperator(UDFOperator):
         for r in self.parent.cached_sample():
             try:
                 outs.append(apply_udf_python(self.udf, r))
-            except Exception:
-                pass
+            except Exception as e:
+                record_sample_exc(self, e, r)
         nc = T.PYOBJECT if not outs else T.normal_case_type(outs)[0]
         cols = list(ps.columns)
         types = list(ps.types)
@@ -275,7 +299,8 @@ class WithColumnOperator(UDFOperator):
         for r in self.parent.cached_sample():
             try:
                 v = apply_udf_python(self.udf, r)
-            except Exception:
+            except Exception as e:
+                record_sample_exc(self, e, r)
                 continue
             d = dict(zip(r.columns, r.values))
             d[self.column] = v
@@ -299,8 +324,8 @@ class MapColumnOperator(UDFOperator):
         for r in self.parent.cached_sample():
             try:
                 outs.append(self.udf.func(r.values[ci]))
-            except Exception:
-                pass
+            except Exception as e:
+                record_sample_exc(self, e, r)
         nc = T.PYOBJECT if not outs else T.normal_case_type(outs)[0]
         types = list(ps.types)
         types[ci] = nc
@@ -313,7 +338,8 @@ class MapColumnOperator(UDFOperator):
         for r in self.parent.cached_sample():
             try:
                 v = self.udf.func(r.values[ci])
-            except Exception:
+            except Exception as e:
+                record_sample_exc(self, e, r)
                 continue
             vals = list(r.values)
             vals[ci] = v
