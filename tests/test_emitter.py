@@ -333,10 +333,11 @@ def test_format_review_regressions():
     # interpreter (harness treats whole-op NotCompilable as error)
     import pytest as _pytest
 
-    with _pytest.raises(NotCompilable):
-        run_compiled(lambda x: "{:.2f}".format(x), [1.5])
+    check(lambda x: "{:.2f}".format(x), [1.5, -2.0])   # now compiles
     with _pytest.raises(NotCompilable):
         run_compiled(lambda x: "{0} {}".format(x, x), [1])
+    with _pytest.raises(NotCompilable):
+        run_compiled(lambda x: "{:.2e}".format(x), [1.5])   # e-notation
 
 
 def test_ambiguous_closure_lambdas_fall_back():
@@ -851,3 +852,56 @@ def test_math_fmod_zero_and_isclose_inf():
     check(lambda x: math.isclose(x / 0.5 * 0.5, x), [1e308, 3.3])
     vals = [float("inf"), 1.0]
     check(lambda x: math.isclose(x, float("inf")), vals)
+
+
+def test_list_literals_and_tuple_ops():
+    check(lambda x: [x, x + 1, 9][1], [5, 0])
+    check(lambda x: len([x, 1, 2]), [7])
+    check(lambda x: (x,) + (1, 2), [5])
+    check(lambda x: (x, 2) * 2, [3])
+    check(lambda x: sum([x, 2, 3]), [1, -1])
+
+
+def test_str_mult_and_string_minmax():
+    check(lambda x: "ab" * 3 + x, ["z"])
+    check(lambda s: s * 2, ["ab", ""])
+    check(lambda s: min(s, "m"), ["a", "z", "m"])
+    check(lambda s: max(s, "m", "q"), ["a", "z"])
+
+
+def test_float_formatting():
+    vals = [1.2345, -1.2345, 0.0, -0.5, 123.456, 2.675, 0.125, 1e14]
+    check(lambda x: f"{x:.2f}", vals)          # ties/huge route interp
+    check(lambda x: "%.3f" % x, vals)
+    check(lambda x: "v={:.1f}!".format(x), vals)
+    check(lambda x: "%08.2f" % x, [3.5, -3.5])
+    check(lambda x: f"{x:10.2f}", [3.5, -3.5])
+    check(lambda x: "%f" % x, [1.5, -0.25])
+
+
+def test_format_fix_regressions():
+    import pytest as _pytest
+
+    # -0.0 keeps its sign; large magnitudes stay compiled (no silent
+    # interpreter cliff past ~5e8); bare precision (g-format) rejects
+    check(lambda x: f"{x:.2f}", [-0.0, 0.0, 6_000_000.0, 123456789.5])
+    got = run_compiled(lambda x: "%.2f" % x, [6_000_000.25])
+    assert got == ["6000000.25"]   # compiled, not routed
+    with _pytest.raises(NotCompilable):
+        run_compiled(lambda x: f"{x:.2}", [1.5])
+
+    # Option tuples / dicts don't take the structural + fast path: the
+    # emitter refuses (no silent fabricated concat) and the PRODUCT runs
+    # the rows on the interpreter with exact TypeError semantics
+    def opt_tuple(x):
+        t = (x, 1) if x > 0 else None
+        return t + (2,)
+    with _pytest.raises(NotCompilable):
+        run_compiled(opt_tuple, [1, -1])
+    import tuplex_tpu
+    ctx = tuplex_tpu.Context()
+    got = (ctx.parallelize([1, -1]).map(opt_tuple)
+           .resolve(TypeError, lambda x: (0, 0, 0)).collect())
+    assert got == [(1, 1, 2), (0, 0, 0)]
+
+    check(lambda s: s * 100, ["ab"])   # doubling path

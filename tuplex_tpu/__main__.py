@@ -1,0 +1,8 @@
+"""``python -m tuplex_tpu`` — interactive shell with a ready Context and
+jedi tab-completion (reference: python/tuplex/utils/interactive_shell.py
+TuplexShell, launched by the `tuplex` console entry point)."""
+
+from .utils.repl import interactive_shell
+
+if __name__ == "__main__":
+    interactive_shell()

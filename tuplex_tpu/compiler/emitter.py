@@ -594,6 +594,11 @@ class Frame:
     def eval_Tuple(self, node: ast.Tuple) -> CV:
         return tuple_cv([self.eval(e) for e in node.elts])
 
+    def eval_List(self, node: ast.List) -> CV:
+        # list literals compile as tuples (indexing/len/iteration agree;
+        # mutation-by-method is not emitted, so value semantics hold)
+        return tuple_cv([self.eval(e) for e in node.elts])
+
     def eval_Dict(self, node: ast.Dict) -> CV:
         # string-keyed dict literals become named rows (reference: map with
         # dict output keeps column names, MapOperator.cc)
@@ -608,6 +613,19 @@ class Frame:
     def eval_BinOp(self, node: ast.BinOp) -> CV:
         left = self.eval(node.left)
         right = self.eval(node.right)
+        def _plain_tuple(cv):
+            # dict CVs (named) and Option tuples (valid mask) must NOT take
+            # the structural fast path: python + raises on dicts, and a
+            # None tuple needs its TypeError route
+            return cv.elts is not None and cv.names is None \
+                and cv.valid is None
+        if isinstance(node.op, ast.Add) and _plain_tuple(left) \
+                and _plain_tuple(right):
+            return tuple_cv(list(left.elts) + list(right.elts))
+        if isinstance(node.op, ast.Mult) and _plain_tuple(left) \
+            and right.is_const and isinstance(right.const, int) \
+                and not isinstance(right.const, bool):
+            return tuple_cv(list(left.elts) * max(0, right.const))
         return self._binop(node.op, left, right)
 
     def eval_UnaryOp(self, node: ast.UnaryOp) -> CV:
@@ -994,9 +1012,19 @@ class Frame:
             if isinstance(v, ast.Constant):
                 parts.append(const_cv(v.value))
             elif isinstance(v, ast.FormattedValue):
-                if v.format_spec is not None or v.conversion not in (-1, 115):
-                    raise NotCompilable("f-string format spec")
-                parts.append(self._to_str(self.eval(v.value)))
+                if v.conversion not in (-1, 115):
+                    raise NotCompilable("f-string conversion")
+                if v.format_spec is not None:
+                    fs = v.format_spec
+                    if not (isinstance(fs, ast.JoinedStr)
+                            and all(isinstance(x, ast.Constant)
+                                    for x in fs.values)):
+                        raise NotCompilable("dynamic f-string format spec")
+                    spec = "".join(str(x.value) for x in fs.values)
+                    parts.append(self._format_method(
+                        "{:" + spec + "}", [self.eval(v.value)]))
+                else:
+                    parts.append(self._to_str(self.eval(v.value)))
             else:
                 raise NotCompilable("f-string part")
         out = parts[0] if parts else const_cv("")
@@ -1212,7 +1240,31 @@ class Frame:
         if isinstance(op, ast.Mod):
             return self._str_format(a, b)
         if isinstance(op, ast.Mult):
-            raise NotCompilable("str * int")
+            sv, iv = (a, b) if (a.base is T.STR or (
+                a.is_const and isinstance(a.const, str))) else (b, a)
+            if not (iv.is_const and isinstance(iv.const, int)
+                    and not isinstance(iv.const, bool)):
+                raise NotCompilable("str * dynamic int")
+            n = max(0, iv.const)
+            if sv.is_const:
+                return const_cv(sv.const * n)
+            if n == 0:
+                return const_cv("")
+            # repeated doubling: O(log n) concats instead of n-1 chained
+            # kernels with quadratically growing intermediates
+            pows = {1: sv}
+            p2 = 1
+            while p2 * 2 <= n:
+                pows[p2 * 2] = self._str_concat(pows[p2], pows[p2])
+                p2 *= 2
+            out = None
+            rem = n
+            for k in sorted(pows, reverse=True):
+                while rem >= k:
+                    out = pows[k] if out is None else \
+                        self._str_concat(out, pows[k])
+                    rem -= k
+            return out
         raise NotCompilable(f"str operator {type(op).__name__}")
 
     def _str_concat(self, a: CV, b: CV) -> CV:
@@ -1234,7 +1286,7 @@ class Frame:
         # '%%' splits out first so "%%d" stays the literal '%d' instead of
         # consuming an argument (advisor finding, round 1 — CPython treats
         # '%%' as an escape wherever it appears)
-        pieces = _re.split(r"(%%|%0?\d*[dsf])", spec)
+        pieces = _re.split(r"(%%|%0?\d*(?:\.\d+)?[dsf])", spec)
         out: Optional[CV] = None
         ai = 0
         for piece in pieces:
@@ -1242,14 +1294,27 @@ class Frame:
                 continue
             if piece == "%%":
                 part = const_cv("%")
-            elif _re.fullmatch(r"%0?\d*[dsf]", piece):
+            elif _re.fullmatch(r"%0?\d*(?:\.\d+)?[dsf]", piece):
                 if ai >= len(arg_list):
                     raise NotCompilable("format arity")
                 arg = arg_list[ai]
                 ai += 1
                 kind = piece[-1]
                 pad_zero = piece.startswith("%0")
-                width = int(piece[1:-1].lstrip("0") or "0") if piece[1:-1] else 0
+                body = piece[1:-1]
+                prec = None
+                if "." in body:
+                    body, ps_ = body.split(".", 1)
+                    prec = int(ps_ or "0")
+                width = int(body.lstrip("0") or "0") if body else 0
+                if kind == "f":
+                    part = self._float_format(arg, 6 if prec is None
+                                              else prec, width, pad_zero)
+                    out = part if out is None else \
+                        self._str_concat(out, part)
+                    continue
+                if prec is not None:
+                    raise NotCompilable(f"format {piece!r}")
                 if kind == "d":
                     arg = self._require_numeric(arg, "%d")
                     fb, fl = S.format_i64(self._as_i64(arg), width=width,
@@ -1264,7 +1329,7 @@ class Frame:
                         fb, fl = S.pad_left(pb, pl, width, " ")
                         part = CV(t=T.STR, sbytes=fb, slen=fl)
                 else:
-                    raise NotCompilable("%f format")
+                    raise NotCompilable(f"format kind {kind!r}")
             else:
                 part = const_cv(piece)
             out = part if out is None else self._str_concat(out, part)
@@ -1289,7 +1354,8 @@ class Frame:
             elif piece == "}}":
                 part = const_cv("}")
             elif piece.startswith("{"):
-                m = _re.fullmatch(r"\{(\d*)(?::(0?)(\d*)([ds]?))?\}", piece)
+                m = _re.fullmatch(
+                    r"\{(\d*)(?::(0?)(\d*)(?:\.(\d+))?([dsf]?))?\}", piece)
                 if not m:
                     raise NotCompilable(f"format spec {piece!r}")
                 if m.group(1):
@@ -1307,7 +1373,18 @@ class Frame:
                 arg = args[idx]
                 zero = m.group(2) == "0"
                 width = int(m.group(3)) if m.group(3) else 0
-                kind = m.group(4) or ""
+                prec = int(m.group(4)) if m.group(4) else None
+                kind = m.group(5) or ""
+                if kind == "f":
+                    part = self._float_format(arg, 6 if prec is None
+                                              else prec, width, zero)
+                    out = part if out is None else \
+                        self._str_concat(out, part)
+                    continue
+                if prec is not None:
+                    # bare '{:.2}' is CPython general format (g-style
+                    # sig-digits; ValueError on ints) — not fixed-point
+                    raise NotCompilable(f"format spec {piece!r}")
                 is_int = (kind == "d") or (
                     kind == "" and ((arg.base is T.I64 and not arg.is_const)
                                     or (arg.is_const and
@@ -1337,6 +1414,23 @@ class Frame:
                 part = const_cv(piece)
             out = part if out is None else self._str_concat(out, part)
         return out if out is not None else const_cv("")
+
+    def _float_format(self, arg: CV, prec: int, width: int = 0,
+                      pad_zero: bool = False) -> CV:
+        """%.Nf / {:.Nf} fixed-point rendering; rounding ties and huge
+        magnitudes route to the interpreter (CPython renders from the
+        exact binary value — scaled integer math can double-round)."""
+        from ..core.errors import ExceptionCode
+
+        na = self._require_numeric(arg, "float format")
+        fb, fl, suspect = S.format_f64(self._cast(na.data, T.F64), prec)
+        self.raise_where(suspect, ExceptionCode.NORMALCASEVIOLATION)
+        if width > 0:
+            if pad_zero:
+                fb, fl = S.zfill(fb, fl, width)
+            else:
+                fb, fl = S.pad_left(fb, fl, width, " ")
+        return CV(t=T.STR, sbytes=fb, slen=fl)
 
     def _to_str(self, v: CV) -> CV:
         if v.is_const:
@@ -1855,6 +1949,14 @@ class Frame:
             if not items:
                 raise NotCompilable("min/max over non-static iterable")
             args = items
+        if any(a.base is T.STR or (a.is_const and isinstance(a.const, str))
+               for a in args):
+            want_min = fn is jnp.minimum
+            out = args[0]
+            for b in args[1:]:
+                lt = self._compare(ast.Lt(), b, out)   # raw [B] bool
+                out = merge_cv(self, lt if want_min else ~lt, b, out)
+            return out
         vs = [self._require_numeric(a, "min/max") for a in args]
         out_t = vs[0].base
         for v in vs[1:]:

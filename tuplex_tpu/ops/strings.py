@@ -818,3 +818,34 @@ def ws_token_bounds(bytes_, lens, k: int):
     cand2 = jnp.where(after & ~nonws, pos, w)
     stop = jnp.minimum(jnp.min(cand2, axis=1).astype(jnp.int32), lens)
     return start, stop, missing
+
+
+def format_f64(vals, prec: int):
+    """%.Nf fixed-point rendering (reference: FunctionRegistry float
+    formatting; the reference leans on snprintf — here the digits come from
+    scaled integer math). Returns (bytes, lens, suspect): `suspect` rows
+    (near-tie rounding where binary-vs-decimal double rounding could
+    diverge from CPython, |v| >= 1e15, or non-finite) must take the
+    interpreter path."""
+    scale_i = int(10 ** prec)
+    neg = jnp.signbit(vals)        # CPython renders -0.0 as "-0.00"
+    a = jnp.abs(vals)
+    scaled_f = a * float(scale_i)
+    scaled = jnp.rint(scaled_f).astype(jnp.int64)
+    frac = scaled_f - jnp.floor(scaled_f)
+    # tie window: a few ULPs of the scaled value (the one rounding the
+    # scaling multiply can introduce), NOT a relative 1e-9 — that would
+    # mark every value past ~5e8 suspect and silently de-compile them
+    tie = jnp.abs(frac - 0.5) <= 16 * 2.2e-16 * jnp.maximum(scaled_f, 1.0)
+    suspect = tie | (a >= 1e15) | ~jnp.isfinite(vals)
+    ip = scaled // scale_i
+    ib, il = format_i64(ip)
+    if prec > 0:
+        fp = scaled % scale_i
+        db, dl = broadcast_const(".", vals.shape[0])
+        fb, fl = format_i64(fp, width=prec, pad_zero=True)
+        ib, il = concat(*concat(ib, il, db, dl), fb, fl)
+    sb, sl_full = broadcast_const("-", vals.shape[0])
+    sl = jnp.where(neg, sl_full, 0)
+    ob, ol = concat(sb, sl, ib, il)
+    return ob, ol, suspect
