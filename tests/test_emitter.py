@@ -905,3 +905,43 @@ def test_format_fix_regressions():
     assert got == [(1, 1, 2), (0, 0, 0)]
 
     check(lambda s: s * 100, ["ab"])   # doubling path
+
+
+def test_sorted_static():
+    import pytest as _pytest
+
+    check(lambda x: sorted((x, 3, 1))[0], [2, 0, 5])
+    check(lambda s: sorted((s, "m", "a"))[1], ["z", "b"])
+    check(lambda x: sum(sorted([x, x - 1, 10])), [5, -2])
+    # returning the list itself keeps python's list type -> interpreter
+    with _pytest.raises(NotCompilable):
+        run_compiled(lambda x: sorted((x, 2.5)), [1, 9])
+    with _pytest.raises(NotCompilable):
+        run_compiled(lambda x: [x, 1], [5])
+
+
+def test_list_kind_survives_transformations():
+    import pytest as _pytest
+
+    import tuplex_tpu
+
+    # every rebuild path must keep list-ness so list RETURNS fall back;
+    # the product then yields real python lists via the interpreter
+    leaks = [
+        lambda x: [x, 1] if x > 0 else [x, 2],   # predicated merge
+        lambda x: [x + i for i in range(2)],     # list comprehension
+        lambda x: [x] + [1],                     # concatenation
+        lambda x: [x, 1, 2][0:2],                # slicing
+        lambda x: [x, 1] * 2,                    # repetition
+    ]
+    for f in leaks:
+        with _pytest.raises(NotCompilable):
+            run_compiled(f, [5, -3])
+    ctx = tuplex_tpu.Context()
+    for f in leaks:
+        got = ctx.parallelize([5, -3]).map(f).collect()
+        assert got == [f(5), f(-3)] and isinstance(got[0], list), (f, got)
+    # consumption of the same shapes STAYS compiled
+    check(lambda x: ([x, 1] if x > 0 else [x, 2])[1], [5, -3])
+    check(lambda x: sum([x + i for i in range(2)]), [5, -3])
+    check(lambda x: ([x] + [1])[0], [5])

@@ -26,6 +26,7 @@ stage builder hashing UDF source + captured globals.
 from __future__ import annotations
 
 import ast
+import dataclasses
 import math as _pymath
 from typing import Any, Callable, Optional
 
@@ -499,7 +500,10 @@ class Frame:
                     outs.append(self.eval(node.elt))
         finally:
             self.env = saved   # py3 comprehension scope: target doesn't leak
-        return tuple_cv(outs)
+        # listcomp results ARE python lists; genexp results are consumable
+        # only (returning either must fall back, not decode as a tuple)
+        kind = "list" if isinstance(node, ast.ListComp) else "genexp"
+        return tuple_cv(outs, kind=kind)
 
     def eval_DictComp(self, node: ast.DictComp) -> CV:
         """{k: v for ...} with trace-constant string keys becomes a named row
@@ -595,9 +599,10 @@ class Frame:
         return tuple_cv([self.eval(e) for e in node.elts])
 
     def eval_List(self, node: ast.List) -> CV:
-        # list literals compile as tuples (indexing/len/iteration agree;
-        # mutation-by-method is not emitted, so value semantics hold)
-        return tuple_cv([self.eval(e) for e in node.elts])
+        # list literals compile as tuples for CONSUMPTION (indexing/len/
+        # iteration/sum agree); kind="list" makes a list-valued RETURN
+        # fall back so result typing stays exactly python (list != tuple)
+        return tuple_cv([self.eval(e) for e in node.elts], kind="list")
 
     def eval_Dict(self, node: ast.Dict) -> CV:
         # string-keyed dict literals become named rows (reference: map with
@@ -621,11 +626,15 @@ class Frame:
                 and cv.valid is None
         if isinstance(node.op, ast.Add) and _plain_tuple(left) \
                 and _plain_tuple(right):
-            return tuple_cv(list(left.elts) + list(right.elts))
+            if (left.kind == "list") != (right.kind == "list"):
+                raise NotCompilable("list + tuple")   # TypeError in python
+            return tuple_cv(list(left.elts) + list(right.elts),
+                            kind=left.kind)
         if isinstance(node.op, ast.Mult) and _plain_tuple(left) \
             and right.is_const and isinstance(right.const, int) \
                 and not isinstance(right.const, bool):
-            return tuple_cv(list(left.elts) * max(0, right.const))
+            return tuple_cv(list(left.elts) * max(0, right.const),
+                            kind=left.kind)
         return self._binop(node.op, left, right)
 
     def eval_UnaryOp(self, node: ast.UnaryOp) -> CV:
@@ -1460,7 +1469,8 @@ class Frame:
                 hi = self._const_or_none(sl.upper)
                 if sl.step is not None:
                     raise NotCompilable("tuple slice step")
-                return tuple_cv(list(val.elts)[slice(lo, hi)])
+                return tuple_cv(list(val.elts)[slice(lo, hi)],
+                                kind=val.kind)
             raise NotCompilable(f"slice of {val.t}")
         if sl.step is not None:
             raise NotCompilable("string slice step")
@@ -1900,6 +1910,28 @@ class Frame:
             return CV(t=T.F64, data=r / (10.0 ** nd))
         return CV(t=T.I64, data=r.astype(jnp.int64))
 
+    def _builtin_sorted(self, args: list[CV]) -> CV:
+        """sorted() over a static iterable via a compare-exchange network
+        (vectorized bubble network: k(k-1)/2 predicated swaps — data-
+        dependent orderings can't reorder a traced program, so every lane
+        carries its own permutation through merge_cv)."""
+        if len(args) != 1:
+            raise NotCompilable("sorted arity")
+        items = self._cv_iter_items(args[0])
+        if items is None:
+            raise NotCompilable("sorted over non-static iterable")
+        vals = list(items)
+        k = len(vals)
+        if k > 8:
+            raise NotCompilable("sorted over >8 elements")
+        for i in range(k):
+            for j in range(k - 1 - i):
+                lt = self._compare(ast.Lt(), vals[j + 1], vals[j])
+                a, b = vals[j], vals[j + 1]
+                vals[j] = merge_cv(self, lt, b, a)
+                vals[j + 1] = merge_cv(self, lt, a, b)
+        return tuple_cv(vals, kind="list")
+
     def _builtin_sum(self, args: list[CV]) -> CV:
         if len(args) not in (1, 2):
             raise NotCompilable("sum() arity")
@@ -2065,6 +2097,8 @@ def merge_cv(frame: Frame, mask, a: CV, b: CV) -> CV:
     if am.elts is not None and bm.elts is not None:
         if len(am.elts) != len(bm.elts):
             raise NotCompilable("merging tuples of different arity")
+        if am.kind != bm.kind:   # list vs tuple branches: per-row TYPE
+            raise NotCompilable("merging list and tuple")
         elts = tuple(merge_cv(frame, mask, x, y)
                      for x, y in zip(am.elts, bm.elts))
         valid = None
@@ -2072,7 +2106,8 @@ def merge_cv(frame: Frame, mask, a: CV, b: CV) -> CV:
             av = am.valid if am.valid is not None else jnp.ones(b_, bool)
             bv = bm.valid if bm.valid is not None else jnp.ones(b_, bool)
             valid = jnp.where(mask, av, bv)
-        return tuple_cv(elts, names=am.names or bm.names, valid=valid)
+        return tuple_cv(elts, names=am.names or bm.names, valid=valid,
+                        kind=am.kind)
     at, bt = am.base, bm.base
     # strings
     if at is T.STR and bt is T.STR:

@@ -60,6 +60,17 @@ def result_arrays(cv: CV, b: int) -> tuple[dict, T.Type]:
     from .values import materialize
 
     cv = materialize(cv, b) if cv.is_const else cv
+
+    def _has_list(v) -> bool:
+        if v.kind in ("list", "genexp"):
+            return True
+        return v.elts is not None and any(_has_list(e) for e in v.elts)
+
+    if _has_list(cv):
+        # list/generator results must keep python's types: interpreter path
+        from ..core.errors import NotCompilable
+
+        raise NotCompilable("list-valued result")
     if cv.elts is not None and cv.valid is None:
         out: dict[str, Any] = {}
         for i, e in enumerate(cv.elts):

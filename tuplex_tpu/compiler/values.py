@@ -62,13 +62,13 @@ def null_cv() -> CV:
 
 
 def tuple_cv(elts: Sequence[CV], names: Optional[Sequence[str]] = None,
-             valid: Any = None) -> CV:
+             valid: Any = None, kind: Optional[str] = None) -> CV:
     ts = tuple(e.t for e in elts)
     t = T.tuple_of(*ts)
     if valid is not None:
         t = T.option(t)
     return CV(t=t, elts=tuple(elts), names=tuple(names) if names else None,
-              valid=valid)
+              valid=valid, kind=kind)
 
 
 def materialize(cv: CV, b: int) -> CV:
