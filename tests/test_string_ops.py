@@ -254,3 +254,27 @@ def test_nfa_regex_e2e_filter(ctx):
     assert ds.collect() == ["GET /a", "POST /b", "GET /e"]
     assert ctx.metrics.fastPathWallTime() > 0
     assert not ctx.backend._not_compilable
+
+
+@pytest.mark.parametrize("impl", ["bitmask", "dense", "pallas"])
+def test_nfa_engines_agree_with_re(impl, monkeypatch):
+    """All three NFA engines (uint64 bit-parallel, dense-MXU matmul, and
+    the Pallas row-blocked kernel in interpret mode) must agree with
+    python re on existence for the full supported-pattern matrix."""
+    import re
+
+    monkeypatch.setenv("TUPLEX_NFA_IMPL", impl)
+    from tuplex_tpu.ops.nfa import compile_nfa
+
+    strings = ["", "a", "abc", "zabcz", "GET /idx HTTP/1.0", "aaab",
+               "ab\n", "aXb", "2023-04-01", "foo123bar", "a" * 50 + "b"]
+    patterns = ["abc", "a+b", "GET|POST", "a*b", "[0-9]+-[0-9]+",
+                "^abc", "abc$", "^a.*b$", r"\d+", "(ab)+", "^$", "b$"]
+    b, l = enc(strings)
+    for pat in patterns:
+        rx = compile_nfa(pat)
+        got = np.asarray(rx.match(b, l)).tolist()
+        want = [re.search(pat, s) is not None for s in strings]
+        assert got == want, (impl, pat,
+                             [s for s, g, w in zip(strings, got, want)
+                              if g != w])

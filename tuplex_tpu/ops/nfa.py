@@ -23,6 +23,8 @@ transition is pure bitwise arithmetic on [N] uint64 — TPU-vector friendly.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 from ..core.errors import NotCompilable
@@ -205,31 +207,117 @@ class NFARegex:
         self._classtab = tab
         self._follow_np = np.asarray(self.follow, dtype=np.uint64)
 
+    # dense (MXU) formulation tables: TPUs emulate 64-bit ints, so the
+    # bitmask scan is the CPU engine; on TPU the state is [N, P] f32 and
+    # the position-transition is a MATMUL on the systolic array. Built
+    # LAZILY (the CPU default never reads them) from the packed tables.
+    @functools.cached_property
+    def _dense_tables(self):
+        P = self.n_pos
+        qbits = np.arange(P, dtype=np.uint64)
+        unpack = lambda v: ((np.uint64(v) >> qbits) &
+                            np.uint64(1)).astype(np.float32)
+        follow = np.stack([unpack(m) for m in self.follow]) if P else \
+            np.zeros((0, 0), np.float32)
+        classtab = ((self._classtab[:, None] >> qbits[None, :]) &
+                    np.uint64(1)).astype(np.float32)
+        return follow, classtab, unpack(self.first), unpack(self.last)
+
+    @property
+    def _follow_dense(self):
+        return self._dense_tables[0]
+
+    @property
+    def _classtab_dense(self):
+        return self._dense_tables[1]
+
+    @property
+    def _first_dense(self):
+        return self._dense_tables[2]
+
+    @property
+    def _last_dense(self):
+        return self._dense_tables[3]
+
     def match(self, bytes_, lens):
+        impl = _nfa_impl()
+        if impl == "dense":
+            return self.match_dense(bytes_, lens)
+        if impl == "pallas":
+            from .pallas_nfa import match_pallas
+
+            return match_pallas(self, bytes_, lens)
+        return self.match_bitmask(bytes_, lens)
+
+    def _end_masks(self, bytes_, lens, w):
+        """(lens64, end_at): '$' also matches just before one trailing
+        newline (python semantics)."""
+        lens64 = lens.astype(jnp.int64)
+        lastpos = jnp.clip(lens64 - 1, 0, max(w - 1, 0))
+        trailing_nl = (lens64 > 0) & (
+            jnp.take_along_axis(bytes_, lastpos[:, None].astype(jnp.int32),
+                                axis=1)[:, 0] == 10)
+        return lens64, jnp.where(trailing_nl, lens64 - 1, lens64)
+
+    def _matched0(self, n, end_at):
+        if self.nullable:
+            if self.anchored_start and self.anchored_end:
+                return end_at == 0
+            return jnp.ones(n, dtype=bool)
+        return jnp.zeros(n, dtype=bool)
+
+    def match_dense(self, bytes_, lens):
+        """Dense-state engine: S is [N, P] f32 and the Glushkov transition
+        is S @ FOLLOW — a matmul the TPU MXU eats directly (the bitmask
+        engine's uint64 ops are EMULATED on TPU). Same observable results
+        as match_bitmask (shared golden tests run both)."""
+        n, w = bytes_.shape
+        P = self.n_pos
+        if P == 0:      # pure-anchor pattern ('^$'): decided by matched0
+            lens64, end_at = self._end_masks(bytes_, lens, w)
+            return self._matched0(n, end_at)
+        follow = jnp.asarray(self._follow_dense)
+        classtab = jnp.asarray(self._classtab_dense)
+        firstv = jnp.asarray(self._first_dense)
+        lastv = jnp.asarray(self._last_dense)
+        lens64, end_at = self._end_masks(bytes_, lens, w)
+        matched0 = self._matched0(n, end_at)
+        xs = (jnp.transpose(bytes_).astype(jnp.int32),
+              jnp.arange(w, dtype=jnp.int64))
+
+        def step(carry, x):
+            S, matched = carry
+            byte_col, j = x
+            cm = jnp.take(classtab, byte_col, axis=0)      # [N, P]
+            nxt = jnp.dot(S, follow,
+                          preferred_element_type=jnp.float32) > 0.5
+            if self.anchored_start:
+                seed = jnp.where(j == 0, firstv, 0.0)[None, :]
+            else:
+                seed = firstv[None, :]
+            S2 = jnp.where((nxt | (seed > 0.5)) & (cm > 0.5), 1.0, 0.0)
+            inb = (j < lens64)[:, None]
+            S2 = jnp.where(inb, S2, 0.0)
+            hit = jnp.max(S2 * lastv[None, :], axis=1) > 0.5
+            if self.anchored_end:
+                hit = hit & ((j + 1 == lens64) | (j + 1 == end_at))
+            return (S2.astype(jnp.float32), matched | hit), None
+
+        (S, matched), _ = lax.scan(
+            step, (jnp.zeros((n, P), dtype=jnp.float32), matched0), xs)
+        return matched
+
+    def match_bitmask(self, bytes_, lens):
         n, w = bytes_.shape
         classtab = jnp.asarray(self._classtab)
         first = jnp.uint64(self.first)
         last = jnp.uint64(self.last)
         follow_masks = [jnp.uint64(m) for m in self.follow]
-        lens64 = lens.astype(jnp.int64)
-        # $ also matches just before one trailing '\n' (python semantics)
-        lastpos = jnp.clip(lens64 - 1, 0, max(w - 1, 0))
-        trailing_nl = (lens64 > 0) & (
-            jnp.take_along_axis(bytes_, lastpos[:, None].astype(jnp.int32),
-                                axis=1)[:, 0] == 10)
-        end_at = jnp.where(trailing_nl, lens64 - 1, lens64)
-
-        if self.nullable:
-            # an empty match exists at position 0 (and, for '$'-anchored
-            # searches, at the end — which every string has). Only the
-            # doubly-anchored nullable case ('^$', '^a*$') constrains it:
-            # the empty match must sit at BOTH ends, i.e. end_at == 0.
-            if self.anchored_start and self.anchored_end:
-                matched0 = end_at == 0
-            else:
-                matched0 = jnp.ones(n, dtype=bool)
-        else:
-            matched0 = jnp.zeros(n, dtype=bool)
+        lens64, end_at = self._end_masks(bytes_, lens, w)
+        # nullable: an empty match exists at position 0 (and, for
+        # '$'-anchored searches, at the end); only the doubly-anchored
+        # nullable case ('^$', '^a*$') constrains it to end_at == 0
+        matched0 = self._matched0(n, end_at)
 
         xs = (jnp.transpose(bytes_).astype(jnp.int32),
               jnp.arange(w, dtype=jnp.int64))
@@ -259,6 +347,25 @@ class NFARegex:
         (S, matched), _ = lax.scan(
             step, (jnp.zeros(n, dtype=jnp.uint64), matched0), xs)
         return matched
+
+
+def _nfa_impl() -> str:
+    """Engine choice: 'bitmask' (uint64 bit-parallel; best on CPU),
+    'dense' (state [N,P] f32, transition = matmul; rides the TPU MXU where
+    64-bit ints are emulated), or 'pallas' (dense formulation as a Pallas
+    kernel, row-blocked, state held in VMEM across the width loop).
+    TUPLEX_NFA_IMPL overrides; auto = dense on TPU, bitmask elsewhere."""
+    import os
+
+    mode = os.environ.get("TUPLEX_NFA_IMPL", "auto")
+    if mode in ("bitmask", "dense", "pallas"):
+        return mode
+    if mode != "auto":
+        raise ValueError(f"TUPLEX_NFA_IMPL={mode!r}: expected "
+                         "bitmask|dense|pallas|auto")
+    from ..runtime.jaxcfg import jax
+
+    return "dense" if jax.default_backend() not in ("cpu",) else "bitmask"
 
 
 _NFA_CACHE: dict[tuple, NFARegex] = {}

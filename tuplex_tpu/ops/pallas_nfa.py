@@ -1,0 +1,128 @@
+"""Pallas kernel for the dense Glushkov NFA scan.
+
+The dense engine (ops/nfa.py match_dense) advances an [N, P] f32 state
+across string byte columns with one [P, P] matmul per column — already
+MXU-shaped, but under plain `lax.scan` XLA round-trips the state through
+HBM between steps. This kernel blocks rows into tiles and runs the WHOLE
+width loop inside one kernel instance, keeping the state, the follow
+matrix, and the class table resident in VMEM (the Pallas playbook:
+sequential dependence inside the kernel, parallelism across the grid).
+
+Selected with TUPLEX_NFA_IMPL=pallas. On CPU the kernel runs in Pallas
+interpret mode (slow, for correctness tests); on TPU it compiles to
+Mosaic. Position tables pad to sublane multiples (8); Mosaic handles the
+lane-width relayout.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..runtime.jaxcfg import jax, jnp
+
+_ROW_BLOCK = 256
+
+
+@functools.lru_cache(maxsize=32)
+def _build_kernel(P: int, w: int, anchored_start: bool, anchored_end: bool,
+                  interpret: bool):
+    from jax.experimental import pallas as pl
+
+    # pad positions to a SUBLANE multiple (8); Mosaic relayouts the
+    # 8-wide tiles onto 128-lane registers itself — padding P to 128
+    # here would waste 16x matmul work for small patterns
+    Pp = max(8, -(-P // 8) * 8)
+
+    def kernel(bytes_ref, lens_ref, end_ref, m0_ref, follow_ref, class_ref,
+               first_ref, last_ref, out_ref):
+        S = jnp.zeros((_ROW_BLOCK, Pp), dtype=jnp.float32)
+        matched = m0_ref[...] > 0.5
+        lens = lens_ref[...]
+        end_at = end_ref[...]
+        follow = follow_ref[...]
+        firstv = first_ref[...]
+        lastv = last_ref[...]
+
+        def body(j, carry):
+            S, matched = carry
+            byte_col = bytes_ref[:, j]
+            cm = class_ref[byte_col, :]                    # [B, Pp] gather
+            nxt = jnp.dot(S, follow,
+                          preferred_element_type=jnp.float32) > 0.5
+            if anchored_start:
+                seed = jnp.where(j == 0, firstv, 0.0)[None, :]
+            else:
+                seed = firstv[None, :]
+            S2 = jnp.where((nxt | (seed > 0.5)) & (cm > 0.5),
+                           1.0, 0.0).astype(jnp.float32)
+            inb = (j < lens)[:, None]
+            S2 = jnp.where(inb, S2, 0.0).astype(jnp.float32)
+            hit = jnp.max(S2 * lastv[None, :], axis=1) > 0.5
+            if anchored_end:
+                hit = hit & ((j + 1 == lens) | (j + 1 == end_at))
+            return S2, matched | hit
+
+        S, matched = jax.lax.fori_loop(0, w, body, (S, matched))
+        out_ref[...] = matched
+
+    def run(bytes_p, lens_p, end_p, m0_p, follow, classtab, firstv, lastv):
+        n_blocks = bytes_p.shape[0] // _ROW_BLOCK
+        return pl.pallas_call(
+            kernel,
+            grid=(n_blocks,),
+            in_specs=[
+                pl.BlockSpec((_ROW_BLOCK, w), lambda i: (i, 0)),
+                pl.BlockSpec((_ROW_BLOCK,), lambda i: (i,)),
+                pl.BlockSpec((_ROW_BLOCK,), lambda i: (i,)),
+                pl.BlockSpec((_ROW_BLOCK,), lambda i: (i,)),
+                pl.BlockSpec((Pp, Pp), lambda i: (0, 0)),
+                pl.BlockSpec((256, Pp), lambda i: (0, 0)),
+                pl.BlockSpec((Pp,), lambda i: (0,)),
+                pl.BlockSpec((Pp,), lambda i: (0,)),
+            ],
+            out_specs=pl.BlockSpec((_ROW_BLOCK,), lambda i: (i,)),
+            out_shape=jax.ShapeDtypeStruct((bytes_p.shape[0],), jnp.bool_),
+            interpret=interpret,
+        )(bytes_p, lens_p, end_p, m0_p, follow, classtab, firstv, lastv)
+
+    return run, Pp
+
+
+def match_pallas(rx, bytes_, lens):
+    """Drive the kernel: pad rows to the block multiple and positions to
+    sublane width, then slice the matches back."""
+    n, w = bytes_.shape
+    P = rx.n_pos
+    if P == 0:          # pure-anchor pattern ('^$'): decided by matched0
+        lens64, end_at = rx._end_masks(bytes_, lens, w)
+        return rx._matched0(n, end_at)
+    # Mosaic is the only native target this kernel is written for (1D
+    # blocks + dynamic ref gather); every other backend interprets
+    interpret = jax.default_backend() != "tpu"
+    run, Pp = _build_kernel(P, w, rx.anchored_start, rx.anchored_end,
+                            interpret)
+
+    lens64, end_at = rx._end_masks(bytes_, lens, w)
+    m0 = rx._matched0(n, end_at)
+
+    npad = -(-max(n, 1) // _ROW_BLOCK) * _ROW_BLOCK
+
+    def padrows(a, fill=0):
+        return jnp.pad(a, ((0, npad - n),) + ((0, 0),) * (a.ndim - 1),
+                       constant_values=fill)
+
+    def padP(a):
+        return jnp.pad(a, ((0, 0),) * (a.ndim - 1) + ((0, Pp - P),))
+
+    out = run(
+        padrows(bytes_), padrows(lens64.astype(jnp.int32)),
+        padrows(end_at.astype(jnp.int32)),
+        padrows(m0.astype(jnp.float32)),
+        padP(jnp.asarray(np.pad(rx._follow_dense, ((0, Pp - P), (0, 0))))),
+        padP(jnp.asarray(rx._classtab_dense)),
+        padP(jnp.asarray(rx._first_dense)),
+        padP(jnp.asarray(rx._last_dense)),
+    )
+    return out[:n]
