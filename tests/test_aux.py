@@ -246,3 +246,17 @@ def test_sample_previews_dedup_mapcolumn_and_memo(tmp_path):
                p["op"] == "MapColumnOperator" for p in pv), pv
     # duplicates collapse: both zero rows produce identical entries -> one
     assert len([p for p in pv if p["exc"] == "ZeroDivisionError"]) == 1
+
+
+def test_profile_dir_writes_trace(tmp_path):
+    import os
+
+    import tuplex_tpu
+
+    c = tuplex_tpu.Context({"tuplex.tpu.profileDir": str(tmp_path / "prof")})
+    got = c.parallelize([1, 2, 3]).map(lambda x: x * 2).collect()
+    assert got == [2, 4, 6]
+    # a plugins/profile dir with at least one trace artifact appears
+    found = [os.path.join(r, f) for r, _, fs in os.walk(tmp_path / "prof")
+             for f in fs]
+    assert found, "no profiler artifacts written"

@@ -219,3 +219,66 @@ def test_tuplexfile_source_stages_partitions(tmp_path):
            .map(lambda x: x["a"] + x["b"])
            .collect())
     assert got == [i * 3 for i in range(800)]
+
+
+def test_sink_pushdown_workers_write_parts(tmp_path, monkeypatch):
+    # tocsv to a directory on the serverless backend: each worker writes
+    # its own part file; nothing is staged back through the driver
+    import csv as _csv
+
+    c = _ctx(tmp_path)
+    out = tmp_path / "csvout"
+    out.mkdir()
+    loaded = {"n": 0}
+    from tuplex_tpu.io import tuplexfmt as TF
+
+    orig = TF.TuplexFileSourceOperator.load_partitions
+
+    def counting(self, context, projection=None):
+        loaded["n"] += 1
+        return orig(self, context, projection)
+
+    monkeypatch.setattr(TF.TuplexFileSourceOperator, "load_partitions",
+                        counting)
+    (c.parallelize([(i, f"s{i}") for i in range(4000)], columns=["a", "s"])
+     .map(lambda x: (x["a"] * 2, x["s"]))
+     .tocsv(str(out) + "/"))
+    files = sorted(os.listdir(out))
+    assert len(files) >= 2, files     # one part per task
+    assert all(f.startswith("part0") for f in files), files  # zero-padded
+    rows = []
+    for f in files:
+        with open(out / f) as fp:
+            r = list(_csv.reader(fp))
+        assert r[0] == ["_0", "_1"]
+        rows += [(int(a), b) for a, b in r[1:]]
+    assert rows == [(i * 2, f"s{i}") for i in range(4000)]
+    assert loaded["n"] == 0, "driver must not stage worker output back"
+    # re-run with FEWER tasks: stale higher parts must be swept
+    (c.parallelize([(1, "x")], columns=["a", "s"])
+     .map(lambda x: (x["a"], x["s"]))
+     .tocsv(str(out) + "/"))
+    files2 = sorted(os.listdir(out))
+    assert files2 == ["part00000.csv"], files2
+
+
+def test_sink_pushdown_degrade_writes_part_locally(tmp_path, monkeypatch):
+    import csv as _csv
+    import subprocess
+    import sys
+
+    c = _ctx(tmp_path, **{"tuplex.aws.retryCount": 0})
+
+    def always_dead(self, run_dir, task, tspec, req_base):
+        os.makedirs(os.path.join(run_dir, f"task-{task:04d}"), exist_ok=True)
+        return subprocess.Popen([sys.executable, "-c", "raise SystemExit(3)"])
+
+    monkeypatch.setattr(ServerlessBackend, "_launch", always_dead)
+    out = tmp_path / "dgout"
+    out.mkdir()
+    c.parallelize(list(range(1000)), columns=["v"]).tocsv(str(out) + "/")
+    rows = []
+    for f in sorted(os.listdir(out)):
+        with open(out / f) as fp:
+            rows += [int(r[0]) for r in list(_csv.reader(fp))[1:]]
+    assert rows == list(range(1000))

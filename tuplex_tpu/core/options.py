@@ -86,6 +86,7 @@ DEFAULTS: dict[str, str] = {
     "tuplex.tpu.donateBuffers": "true",
     "tuplex.tpu.interpretOnly": "false",        # force interpreter (debugging)
     "tuplex.tpu.jitCacheSize": "128",
+    "tuplex.tpu.profileDir": "",            # jax.profiler trace per action
 }
 
 

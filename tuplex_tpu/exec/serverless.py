@@ -314,6 +314,11 @@ class ServerlessBackend(LocalBackend):
     object-store-style part staging. Aggregates, joins, fused folds, and
     limited (take) stages run on the driver via LocalBackend."""
 
+    # tocsv() to a directory ships the sink INTO the workers: each task
+    # writes its own part file from columnar buffers (reference: Lambda
+    # tasks writing S3 output.part-N, AWSLambdaBackend.cc:410-430)
+    supports_sink_pushdown = True
+
     def __init__(self, options):
         super().__init__(options)
         # counts WORKERS, not local cores (reference: concurrent Lambda
@@ -330,9 +335,11 @@ class ServerlessBackend(LocalBackend):
 
     # -- dispatch ----------------------------------------------------------
     def execute_any(self, stage, partitions, context,
-                    intermediate: bool = False) -> StageResult:
+                    intermediate: bool = False,
+                    sink: Optional[dict] = None) -> StageResult:
         from ..plan.physical import TransformStage
 
+        self._sink_pushed = False
         fan_out = (isinstance(stage, TransformStage)
                    and stage.fold_op is None
                    and stage.limit < 0
@@ -346,7 +353,8 @@ class ServerlessBackend(LocalBackend):
                 log.warning("stage spec serialization failed (%s: %s); "
                             "running on driver", type(e).__name__, e)
             else:
-                return self._execute_fanout(stage, spec, partitions, context)
+                return self._execute_fanout(stage, spec, partitions,
+                                            context, sink=sink)
         # device views never survive the process boundary
         return super().execute_any(stage, partitions, context,
                                    intermediate=False)
@@ -382,7 +390,8 @@ class ServerlessBackend(LocalBackend):
         return tasks
 
     # -- fan-out core ------------------------------------------------------
-    def _execute_fanout(self, stage, spec, partitions, context) -> StageResult:
+    def _execute_fanout(self, stage, spec, partitions, context,
+                        sink: Optional[dict] = None) -> StageResult:
         import uuid
 
         from ..utils.signals import check_interrupted
@@ -394,7 +403,10 @@ class ServerlessBackend(LocalBackend):
         tasks = self._plan_tasks(stage, spec, partitions, run_dir)
         if not tasks:
             return StageResult([], [], {"serverless_tasks": 0})
-        req_base = {"stage": spec, "options": self.options.to_dict()}
+        if sink is not None:
+            _sweep_stale_parts(sink, len(tasks))
+        req_base = {"stage": spec, "options": self.options.to_dict(),
+                    "sink": sink}
         procs: dict[int, tuple[subprocess.Popen, float, int]] = {}
         done: dict[int, Optional[str]] = {}   # task -> outdir (None = local)
         pending = list(range(len(tasks)))
@@ -416,7 +428,9 @@ class ServerlessBackend(LocalBackend):
                 except OSError:
                     pass
         result = self._collect(stage, tasks, done, context, run_dir, t0,
-                               fl_snap)
+                               fl_snap, sink=sink)
+        if sink is not None:
+            self._sink_pushed = True
         if all(d is not None for d in done.values()):
             # clean scratch only for fully-healthy runs; failed runs keep
             # their request/worker.log for post-mortem (reference keeps the
@@ -494,7 +508,7 @@ class ServerlessBackend(LocalBackend):
 
     # -- result collection -------------------------------------------------
     def _collect(self, stage, tasks, done, context, run_dir, t0,
-                 fl_snap) -> StageResult:
+                 fl_snap, sink: Optional[dict] = None) -> StageResult:
         from ..runtime import columns as C
 
         out_parts: list = []
@@ -506,9 +520,11 @@ class ServerlessBackend(LocalBackend):
         for t in range(len(tasks)):
             outdir = done.get(t)
             if outdir is None:
-                res = self._run_task_local(stage, tasks[t], context)
+                res = self._run_task_local(stage, tasks[t], context,
+                                           sink=sink, task=t)
             else:
-                res = self._load_response(run_dir, t, outdir, context)
+                res = self._load_response(run_dir, t, outdir, context,
+                                          skip_parts=sink is not None)
             for part in res.partitions:
                 part.start_index = offset
                 offset += part.num_rows
@@ -518,12 +534,15 @@ class ServerlessBackend(LocalBackend):
             for k, v in res.metrics.items():
                 if isinstance(v, (int, float)):
                     metrics[k] = metrics.get(k, 0) + v
+            offset += res.metrics.get("sink_rows", 0) \
+                if isinstance(res.metrics.get("sink_rows"), int) else 0
         metrics["wall_s"] = time.perf_counter() - t0
         metrics["rows_out"] = offset
         return StageResult(C.harmonize_partitions(out_parts), exceptions,
                            metrics)
 
-    def _load_response(self, run_dir, t, outdir, context) -> StageResult:
+    def _load_response(self, run_dir, t, outdir, context,
+                       skip_parts: bool = False) -> StageResult:
         from ..io.tuplexfmt import TuplexFileSourceOperator
 
         with open(os.path.join(run_dir, f"task-{t:04d}", "response.pkl"),
@@ -531,15 +550,19 @@ class ServerlessBackend(LocalBackend):
             resp = pickle.load(fp)
         for entry in resp.get("failure_log", []):
             self.failure_log.append(dict(entry, task=t))
-        if not resp.get("rows"):
-            return StageResult([], resp.get("exceptions", []),
-                               resp.get("metrics", {}))
+        if skip_parts or not resp.get("rows"):
+            m = dict(resp.get("metrics", {}))
+            if skip_parts:
+                m["sink_rows"] = resp.get("rows", 0)
+            return StageResult([], resp.get("exceptions", []), m)
         src = TuplexFileSourceOperator(self.options, outdir)
         parts = src.load_partitions(context)
         return StageResult(parts, resp.get("exceptions", []),
                            resp.get("metrics", {}))
 
-    def _run_task_local(self, stage, tspec, context) -> StageResult:
+    def _run_task_local(self, stage, tspec, context,
+                        sink: Optional[dict] = None,
+                        task: int = 0) -> StageResult:
         """Degraded path: run one failed task's share in-process."""
         from ..api.dataset import _source_partitions
         from ..io.tuplexfmt import TuplexFileSourceOperator
@@ -547,10 +570,17 @@ class ServerlessBackend(LocalBackend):
         if tspec.get("files") is not None:
             sub = _clone_stage_for_files(stage, tspec["files"])
             parts = _source_partitions(context, sub, lazy=False)
-            return LocalBackend.execute(self, sub, parts)
-        src = TuplexFileSourceOperator(self.options, tspec["indir"])
-        return LocalBackend.execute(self, stage,
-                                    src.load_partitions(context))
+            res = LocalBackend.execute(self, sub, parts)
+        else:
+            src = TuplexFileSourceOperator(self.options, tspec["indir"])
+            res = LocalBackend.execute(self, stage,
+                                       src.load_partitions(context))
+        if sink is not None:
+            write_sink_part(sink, task, res.partitions, backend=self)
+            m = dict(res.metrics)
+            m["sink_rows"] = sum(p.num_rows for p in res.partitions)
+            return StageResult([], res.exceptions, m)
+        return res
 
 
 def _clone_stage_for_files(stage, files):
@@ -562,3 +592,37 @@ def _clone_stage_for_files(stage, files):
     sub.source = copy.copy(stage.source)
     sub.source.files = list(files)
     return sub
+
+
+def _sweep_stale_parts(sink: dict, n_tasks: int) -> None:
+    """A previous run with MORE tasks leaves higher-numbered part files;
+    mixing them into this run's directory would silently append old rows
+    (task count varies with maxConcurrency/partitioning)."""
+    import glob
+
+    from ..io.vfs import VirtualFileSystem
+
+    if VirtualFileSystem._scheme(sink["path"]) != "file":
+        return   # remote stores: writers overwrite; sweeping needs listing
+    root = VirtualFileSystem._strip(sink["path"].rstrip("/"))
+    for f in glob.glob(os.path.join(root, "part*.csv")):
+        base = os.path.basename(f)[4:-4]
+        try:
+            if int(base) >= n_tasks:
+                os.unlink(f)
+        except (ValueError, OSError):
+            pass
+
+
+def write_sink_part(sink: dict, task: int, partitions, backend=None) -> None:
+    """One task's output as its own part file, written straight from
+    columnar buffers (reference: per-invocation S3 output parts)."""
+    if sink["format"] != "csv":
+        raise TuplexException(f"unknown sink format {sink['format']!r}")
+    from ..io.csvsink import write_partitions_csv
+
+    path = sink["path"].rstrip("/") + f"/part{task:05d}.csv"
+    write_partitions_csv(path, list(partitions), sink.get("columns"),
+                         backend=backend,
+                         null_value=sink.get("null_value"),
+                         header=sink.get("header", True))

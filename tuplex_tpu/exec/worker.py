@@ -70,8 +70,18 @@ def main(argv: list[str]) -> int:
 
     result = backend.execute(stage, partitions)
 
-    write_partitions_tuplex(req["outdir"], result.partitions,
-                            backend=backend)
+    sink = req.get("sink")
+    if sink is not None:
+        # sink pushdown: this task's rows become its own part file written
+        # straight from columnar buffers (reference: Lambda writing S3
+        # output.part-N); no partitions travel back
+        from .serverless import write_sink_part
+
+        write_sink_part(sink, req["task"], result.partitions,
+                        backend=backend)
+    else:
+        write_partitions_tuplex(req["outdir"], result.partitions,
+                                backend=backend)
     resp = {"ok": True,
             "rows": sum(p.num_rows for p in result.partitions),
             "metrics": result.metrics,

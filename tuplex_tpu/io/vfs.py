@@ -28,6 +28,19 @@ class VirtualFileSystem:
         return "file"
 
     @staticmethod
+    def is_dir_path(path: str) -> bool:
+        """Whether `path` denotes a DIRECTORY target for writers (trailing
+        slash, or an existing local directory) — the single definition the
+        sinks and the sink-pushdown trigger share."""
+        import os as _os
+
+        if path.endswith("/"):
+            return True
+        if VirtualFileSystem._scheme(path) != "file":
+            return False
+        return _os.path.isdir(VirtualFileSystem._strip(path))
+
+    @staticmethod
     def _strip(uri: str) -> str:
         return uri.split("://", 1)[1] if "://" in uri else uri
 
