@@ -282,3 +282,25 @@ def test_sink_pushdown_degrade_writes_part_locally(tmp_path, monkeypatch):
         with open(out / f) as fp:
             rows += [int(r[0]) for r in list(_csv.reader(fp))[1:]]
     assert rows == list(range(1000))
+
+
+def test_flights_pipeline_on_serverless(tmp_path):
+    # the flights benchmark (three joins + UDF chain) end-to-end on the
+    # fan-out backend: transform stages ship to workers, join stages run
+    # on the driver, output matches the pure-python reference (floats to
+    # 1 ulp, same comparison as the local golden test)
+    from tuplex_tpu.models import flights
+
+    perf = flights.generate_perf_csv(str(tmp_path / "perf.csv"), 600)
+    car = flights.generate_carrier_csv(str(tmp_path / "car.csv"))
+    apt = flights.generate_airport_db(str(tmp_path / "apt.csv"))
+    want = flights.run_reference_python(perf, car, apt)
+    c = _ctx(tmp_path / "s")
+    got = flights.build_pipeline(c, perf, car, apt).collect()
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        for a, b in zip(g, w):
+            if isinstance(a, float) and isinstance(b, float):
+                assert abs(a - b) <= 1e-12 * max(1.0, abs(b)), (a, b)
+            else:
+                assert a == b, (a, b)
