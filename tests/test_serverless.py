@@ -312,3 +312,26 @@ def test_flights_pipeline_on_serverless(tmp_path):
                 assert abs(a - b) <= 1e-12 * max(1.0, abs(b)), (a, b)
             else:
                 assert a == b, (a, b)
+
+
+def test_task_timeout_kills_and_degrades(tmp_path, monkeypatch):
+    # a worker exceeding tuplex.aws.requestTimeout is killed and its share
+    # re-runs (here: degrade straight to the driver with retryCount=0)
+    import subprocess
+    import sys
+    import time as _time
+
+    c = _ctx(tmp_path, **{"tuplex.aws.retryCount": 0,
+                          "tuplex.aws.requestTimeout": 1})
+
+    def sleeper(self, run_dir, task, tspec, req_base):
+        os.makedirs(os.path.join(run_dir, f"task-{task:04d}"), exist_ok=True)
+        return subprocess.Popen([sys.executable, "-c",
+                                 "import time; time.sleep(600)"])
+
+    monkeypatch.setattr(ServerlessBackend, "_launch", sleeper)
+    t0 = _time.perf_counter()
+    got = c.parallelize(list(range(300))).map(lambda x: x + 7).collect()
+    assert got == [x + 7 for x in range(300)]
+    assert _time.perf_counter() - t0 < 60   # killed, not awaited
+    assert any(e.get("rc") == -9 for e in c.backend.failure_log)
