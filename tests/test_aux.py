@@ -260,3 +260,14 @@ def test_profile_dir_writes_trace(tmp_path):
     found = [os.path.join(r, f) for r, _, fs in os.walk(tmp_path / "prof")
              for f in fs]
     assert found, "no profiler artifacts written"
+
+
+def test_backend_specific_metrics_survive(tmp_path):
+    import tuplex_tpu
+
+    c = tuplex_tpu.Context({"tuplex.backend": "serverless",
+                            "tuplex.aws.scratchDir": str(tmp_path),
+                            "tuplex.aws.maxConcurrency": 2})
+    c.parallelize(list(range(2000))).map(lambda x: x + 1).collect()
+    stages = c.metrics.as_dict()["stages"]
+    assert any("serverless_tasks" in s for s in stages), stages

@@ -42,13 +42,17 @@ class Metrics:
     def swappedBytes(self) -> int:
         return sum(int(m.get("swapped_bytes", 0)) for m in self.stages)
 
+    _STANDARD = ("wall_s", "fast_path_s", "general_path_s", "slow_path_s",
+                 "rows_out", "exception_rows",
+                 "swap_out", "swap_in", "swapped_bytes")
+
     # -- per-stage breakdown (JobMetrics.h ns/row discipline) ---------------
     def stage_breakdown(self) -> list[dict]:
         out = []
         for i, m in enumerate(self.stages):
             rows = int(m.get("rows_out", 0))
             wall = float(m.get("wall_s", 0.0))
-            out.append({
+            rec = {
                 "stage": i,
                 "wall_s": wall,
                 "fast_path_s": float(m.get("fast_path_s", 0.0)),
@@ -57,7 +61,16 @@ class Metrics:
                 "rows_out": rows,
                 "ns_per_row": (wall / rows * 1e9) if rows else 0.0,
                 "exception_rows": int(m.get("exception_rows", 0)),
-            })
+            }
+            # backend-specific counters (compile_s, task_failures,
+            # serverless_tasks, sink_rows...) survive into the breakdown;
+            # never clobber derived fields, never admit bools
+            for k, v in m.items():
+                if k not in self._STANDARD and k not in rec \
+                        and isinstance(v, (int, float)) \
+                        and not isinstance(v, bool):
+                    rec[k] = v
+            out.append(rec)
         return out
 
     def as_dict(self) -> dict:
