@@ -945,3 +945,38 @@ def test_list_kind_survives_transformations():
     check(lambda x: ([x, 1] if x > 0 else [x, 2])[1], [5, -3])
     check(lambda x: sum([x + i for i in range(2)]), [5, -3])
     check(lambda x: ([x] + [1])[0], [5])
+
+
+def test_dict_membership_tests_keys():
+    # python `in` over a dict tests KEYS; compiled must agree
+    check(lambda x: "a" in {"a": x}, [1, 2])
+    check(lambda x: "zz" in {"a": x}, [1])
+    check(lambda x: "b" not in {"a": x, "b": 2}, [5])
+
+
+def test_tuple_index_count_divmod_ord_chr():
+    check(lambda x: (5, 7, 9).index(x), [7, 9, 4])   # ValueError row
+    check(lambda x: (1, 2, 2).count(x), [2, 3, 1])
+    check(lambda x: divmod(x, 3), [7, -7, 0])
+    check(lambda x: divmod(10, x), [3, 0])           # ZeroDivision row
+    check(lambda x: chr(ord("a") + x), [0, 3, 25])
+    check(lambda s: ord(s), ["a", "Z", "ab", ""])    # TypeError rows
+    check(lambda x: chr(x), [65, 97, -1])            # ValueError row
+    # floats: python chr raises TypeError -> whole-UDF fallback, and the
+    # product interpreter keeps exact semantics
+    import pytest as _pytest
+
+    import tuplex_tpu
+    with _pytest.raises(NotCompilable):
+        run_compiled(lambda x: chr(x), [65.0, 97.5])
+    ctx = tuplex_tpu.Context()
+    got = (ctx.parallelize([65.0]).map(lambda x: chr(x))
+           .resolve(TypeError, lambda x: "?").collect())
+    assert got == ["?"]
+
+
+def test_membership_const_dict_and_set():
+    codes = {"GET": 1, "POST": 2}
+    allowed = {"a", "b"}
+    check(lambda m: m in codes, ["GET", "PUT"])
+    check(lambda m: m in allowed, ["a", "z"])

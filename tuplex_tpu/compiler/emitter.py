@@ -823,6 +823,10 @@ class Frame:
                     and recv.names is not None:
                 args = [self.eval(a) for a in node.args]
                 return self._dict_method(node, recv, node.func.attr, args)
+            if recv is not None and recv.elts is not None \
+                    and node.func.attr in ("index", "count"):
+                args = [self.eval(a) for a in node.args]
+                return self._tuple_method(recv, node.func.attr, args)
             raise NotCompilable(f"method {node.func.attr}")
         if not isinstance(node.func, ast.Name):
             raise NotCompilable("computed call target")
@@ -1789,14 +1793,18 @@ class Frame:
                 hb, hl = self._to_strpair(hay)
                 return S.contains_const(hb, hl, needle.const)
             raise NotCompilable("dynamic needle for `in`")
-        if hay.is_const and isinstance(hay.const, (tuple, list)):
+        items = None
+        if hay.is_const and isinstance(hay.const,
+                                       (tuple, list, set, frozenset, dict)):
+            # iteration order gives dict KEYS — python `in` semantics
+            items = [const_cv(v) for v in hay.const]
+        elif hay.elts is not None:
+            # dict CV: python `in` tests KEYS (which are static strs)
+            items = [const_cv(k) for k in hay.names] \
+                if hay.names is not None else list(hay.elts)
+        if items is not None:
             acc = jnp.zeros(self.ctx.b, dtype=bool)
-            for item in hay.const:
-                acc = acc | self._compare(ast.Eq(), needle, const_cv(item))
-            return acc
-        if hay.elts is not None:
-            acc = jnp.zeros(self.ctx.b, dtype=bool)
-            for e in hay.elts:
+            for e in items:
                 acc = acc | self._compare(ast.Eq(), needle, e)
             return acc
         raise NotCompilable(f"`in` over {hay.t}")
@@ -1909,6 +1917,60 @@ class Frame:
         if len(args) > 1:
             return CV(t=T.F64, data=r / (10.0 ** nd))
         return CV(t=T.I64, data=r.astype(jnp.int64))
+
+    def _tuple_method(self, recv: CV, name: str, args: list[CV]) -> CV:
+        """tuple.index / tuple.count over static elements (unrolled
+        equality tests; index raises ValueError rows when absent)."""
+        if len(args) != 1:
+            raise NotCompilable(f"tuple.{name} arity")
+        needle = args[0]
+        eqs = [self._compare(ast.Eq(), needle, e) for e in recv.elts]
+        if name == "count":
+            cnt = jnp.zeros(self.ctx.b, dtype=jnp.int64)
+            for eq in eqs:
+                cnt = cnt + eq.astype(jnp.int64)
+            return CV(t=T.I64, data=cnt)
+        idx = jnp.full(self.ctx.b, -1, dtype=jnp.int64)
+        for i in range(len(eqs) - 1, -1, -1):
+            idx = jnp.where(eqs[i], i, idx)
+        self.raise_where(idx < 0, ExceptionCode.VALUEERROR)
+        return CV(t=T.I64, data=jnp.maximum(idx, 0))
+
+    def _builtin_divmod(self, args: list[CV]) -> CV:
+        if len(args) != 2:
+            raise NotCompilable("divmod arity")
+        return tuple_cv([self._binop(ast.FloorDiv(), args[0], args[1]),
+                         self._binop(ast.Mod(), args[0], args[1])])
+
+    def _builtin_ord(self, args: list[CV]) -> CV:
+        if len(args) != 1:
+            raise NotCompilable("ord arity")
+        v = args[0]
+        if v.is_const and isinstance(v.const, str):
+            if len(v.const) != 1:
+                raise NotCompilable("ord of non-1-char constant")
+            return const_cv(ord(v.const))
+        rb, rl = self._to_strpair(v)
+        self._ascii_guard(rb, rl)
+        # TypeError rows where len != 1 (python raises TypeError)
+        self.raise_where(rl != 1, ExceptionCode.TYPEERROR)
+        return CV(t=T.I64, data=rb[:, 0].astype(jnp.int64))
+
+    def _builtin_chr(self, args: list[CV]) -> CV:
+        if len(args) != 1:
+            raise NotCompilable("chr arity")
+        v = self._require_numeric(args[0], "chr")
+        if v.base is T.F64 or (v.is_const and isinstance(v.const, float)):
+            raise NotCompilable("chr of float")   # python: TypeError
+        code = self._as_i64(v)
+        # ValueError outside unicode range; non-ASCII routes (byte matrix
+        # is utf-8; multibyte encoding of one codepoint stays interpreter)
+        self.raise_where((code < 0) | (code > 0x10FFFF),
+                         ExceptionCode.VALUEERROR)
+        self.raise_where(code > 127, ExceptionCode.NORMALCASEVIOLATION)
+        b = jnp.clip(code, 0, 127).astype(jnp.uint8)[:, None]
+        return CV(t=T.STR, sbytes=b, slen=jnp.ones(self.ctx.b,
+                                                   dtype=jnp.int32))
 
     def _builtin_sorted(self, args: list[CV]) -> CV:
         """sorted() over a static iterable via a compare-exchange network
