@@ -980,3 +980,48 @@ def test_membership_const_dict_and_set():
     allowed = {"a", "b"}
     check(lambda m: m in codes, ["GET", "PUT"])
     check(lambda m: m in allowed, ["a", "z"])
+
+
+def test_re_sub_class_runs():
+    import re
+
+    vals = ["a12b345c", "no digits", "", "  lots   of   space ", "x#!y"]
+    check(lambda s: re.sub(r"[0-9]+", "#", s), vals)
+    check(lambda s: re.sub(r"\d+", "NUM", s), vals)
+    check(lambda s: re.sub(r"\s+", " ", s), vals)
+    check(lambda s: re.sub(r"[^a-z]+", "", s), vals)
+    check(lambda s: re.sub(r"a+", "A", s), ["aaabaa", "b"])
+    # beyond the subset -> interpreter (NotCompilable at the emitter)
+    import pytest as _pytest
+    with _pytest.raises(NotCompilable):
+        run_compiled(lambda s: re.sub(r"ab+c", "#", s), ["abc"])
+    with _pytest.raises(NotCompilable):
+        run_compiled(lambda s: re.sub(r"(\d)", r"\1x", s), ["a1"])
+
+
+def test_partition_casefold_removeaffix():
+    vals = ["k=v", "a=b=c", "noeq", "", "=lead"]
+    check(lambda s: s.partition("="), vals)
+    check(lambda s: s.rpartition("="), vals)
+    check(lambda s: s.partition("=")[2], vals)
+    check(lambda s: s.casefold(), ["AbC", "", "XYZ"])
+    check(lambda s: s.removeprefix("ab"), ["abcd", "xy", "ab", ""])
+    check(lambda s: s.removesuffix("cd"), ["abcd", "xy", "cd", ""])
+
+
+def test_re_sub_subset_boundaries():
+    import re
+
+    import pytest as _pytest
+
+    # bare class (no +) replaces EACH char; {2,} needs run-length checks:
+    # both are beyond the run-collapsing kernel -> interpreter
+    with _pytest.raises(NotCompilable):
+        run_compiled(lambda s: re.sub(r"\d", "#", s), ["a12b"])
+    with _pytest.raises(NotCompilable):
+        run_compiled(lambda s: re.sub(r"\d{2,}", "#", s), ["a1b22c"])
+    import tuplex_tpu
+    ctx = tuplex_tpu.Context()
+    got = ctx.parallelize(["a12b", "xx"]).map(
+        lambda s: re.sub(r"\d", "#", s)).collect()
+    assert got == ["a##b", "xx"]

@@ -794,6 +794,11 @@ class Frame:
                 args = [self.eval(a) for a in node.args]
                 return self._re_search(node.func.attr, args)
             if recv is not None and recv.is_const and \
+                    getattr(recv.const, "__name__", None) == "re" and \
+                    node.func.attr == "sub":
+                args = [self.eval(a) for a in node.args]
+                return self._re_sub(args)
+            if recv is not None and recv.is_const and \
                     getattr(recv.const, "__name__", None) == "random" and \
                     type(recv.const).__name__ == "module":
                 args = [self.eval(a) for a in node.args]
@@ -961,6 +966,31 @@ class Frame:
             elts.append(CV(t=T.STR, sbytes=bb, slen=bl))
         return CV(t=T.option(T.tuple_of(*[T.STR] * (rx.n_groups + 1))),
                   elts=tuple(elts), valid=matched, kind="match")
+
+    def _re_sub(self, args: list[CV]) -> CV:
+        """Compiled re.sub for the class-run subset ('[class]+' / '\\d+' /
+        '\\s+' style — one character class repeated at least once, the
+        common data-cleaning shape; reference: FunctionRegistry re.sub).
+        Everything else falls back to the interpreter."""
+        if len(args) != 3:
+            raise NotCompilable("re.sub arity")
+        pat, repl, s = args
+        if not (pat.is_const and isinstance(pat.const, str)):
+            raise NotCompilable("dynamic regex pattern")
+        if not (repl.is_const and isinstance(repl.const, str)):
+            raise NotCompilable("re.sub dynamic replacement")
+        if "\\" in repl.const:
+            raise NotCompilable("re.sub backreference replacement")
+        table = _class_run_table(pat.const)
+        if table is None:
+            raise NotCompilable("re.sub pattern beyond class-run subset")
+        if s.valid is not None:
+            self.raise_where(~s.valid, ExceptionCode.TYPEERROR)
+        s = materialize(s, self.ctx.b)
+        rb, rl = self._to_strpair(s)
+        self._ascii_guard(rb, rl)
+        fb, fl = S.replace_class_runs(rb, rl, table, repl.const)
+        return CV(t=T.STR, sbytes=fb, slen=fl)
 
     _SPLIT_INDEX_CAP = 32
 
@@ -1515,6 +1545,50 @@ class Frame:
                 raise NotCompilable(f"str.{name}: needs constant str arg")
             return args[i].const
 
+        if name == "casefold":
+            # ASCII casefold == lower; multibyte rows already routed by the
+            # guard below where byte semantics could diverge
+            self._ascii_guard(rb, rl)
+            fb, fl = S.lower(rb, rl)
+            return CV(t=T.STR, sbytes=fb, slen=fl)
+        if name in ("removeprefix", "removesuffix"):
+            affix = need_const_str(0)
+            if not affix:
+                return CV(t=T.STR, sbytes=rb, slen=rl)
+            m = len(affix.encode())
+            if name == "removeprefix":
+                hit = S.startswith_const(rb, rl, affix)
+                start = jnp.where(hit, m, 0).astype(jnp.int32)
+                fb, fl = S.slice_(rb, rl, start, None)
+            else:
+                hit = S.endswith_const(rb, rl, affix)
+                stop = jnp.where(hit, rl - m, rl).astype(jnp.int32)
+                fb, fl = S.slice_(rb, rl, None, stop)
+            return CV(t=T.STR, sbytes=fb, slen=fl)
+        if name in ("partition", "rpartition"):
+            self._ascii_guard(rb, rl)
+            sep = need_const_str(0)
+            if not sep:
+                raise NotCompilable("partition with empty separator")
+            m = len(sep.encode())
+            pos = S.find_const(rb, rl, sep, reverse=name == "rpartition")
+            found = pos >= 0
+            if name == "partition":
+                # not found: (s, '', '')
+                head_stop = jnp.where(found, pos, rl).astype(jnp.int32)
+                tail_start = jnp.where(found, pos + m, rl).astype(jnp.int32)
+            else:
+                # not found: ('', '', s)
+                head_stop = jnp.where(found, pos, 0).astype(jnp.int32)
+                tail_start = jnp.where(found, pos + m,
+                                       jnp.zeros_like(rl)).astype(jnp.int32)
+            hb, hl = S.slice_(rb, rl, None, head_stop)
+            sb2, sl2 = S.broadcast_const(sep, self.ctx.b)
+            sl2 = jnp.where(found, sl2, 0)
+            tb, tl = S.slice_(rb, rl, tail_start, None)
+            return tuple_cv([CV(t=T.STR, sbytes=hb, slen=hl),
+                             CV(t=T.STR, sbytes=sb2, slen=sl2),
+                             CV(t=T.STR, sbytes=tb, slen=tl)])
         if name in ("lower", "upper", "swapcase"):
             fb, fl = getattr(S, name)(rb, rl)
             return CV(t=T.STR, sbytes=fb, slen=fl)
@@ -2214,3 +2288,52 @@ def _const_binop(op: ast.operator, a, b):
     if fn is None:
         raise NotCompilable(f"const op {type(op).__name__}")
     return fn(a, b)
+
+
+def _class_run_table(pattern: str):
+    """[256] bool table when `pattern` is exactly one character class
+    repeated 1+ times ('[0-9]+', '\\s+', 'x+', '[^a-z]+'); else None."""
+    import re as _pyre
+
+    try:
+        from re import _parser as _sre
+    except ImportError:                      # pragma: no cover - py<3.11
+        import sre_parse as _sre             # type: ignore
+
+    import numpy as np
+
+    from ..ops.regex import _byte_in_spec, _in_spec
+
+    if any(ord(c) > 127 for c in pattern):
+        return None
+    try:
+        tree = _sre.parse(pattern)
+    except Exception:
+        return None
+    if tree.state.flags & ~_pyre.UNICODE.value:
+        return None
+    terms = list(tree)
+    if len(terms) != 1:
+        return None
+    op, av = terms[0]
+    # exactly class+ / literal+ — a bare class (no repeat) replaces EACH
+    # char, and {2,} must not match length-1 runs: both diverge from the
+    # run-collapsing kernel, so only MAX_REPEAT(1, MAXREPEAT) qualifies
+    if str(op) != "MAX_REPEAT":
+        return None
+    lo, hi, body = av
+    if lo != 1 or str(hi) != "MAXREPEAT" or len(body) != 1:
+        return None
+    op, av = list(body)[0]
+    spec = None
+    if str(op) == "IN":
+        spec = _in_spec(av)
+    elif str(op) == "LITERAL":
+        spec = (("lit", av),)
+    if spec is None:
+        return None
+    tab = np.zeros(256, dtype=bool)
+    for c in range(256):
+        if _byte_in_spec(c, spec):
+            tab[c] = True
+    return tab

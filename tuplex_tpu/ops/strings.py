@@ -849,3 +849,31 @@ def format_f64(vals, prec: int):
     sl = jnp.where(neg, sl_full, 0)
     ob, ol = concat(sb, sl, ib, il)
     return ob, ol, suspect
+
+
+def replace_class_runs(bytes_, lens, table: np.ndarray, new: str):
+    """re.sub('[class]+', new, s): each maximal run of class-member bytes
+    becomes `new` (reference: FunctionRegistry re.sub codegen; the common
+    data-cleaning subset — full regex replacement stays interpreter).
+    `table` is a [256] bool membership table."""
+    nb = const_bytes(new)
+    k = len(nb)
+    n, w = bytes_.shape
+    inside = _pos_mask(w, lens)
+    member = jnp.take(jnp.asarray(table), bytes_.astype(jnp.int32)) & inside
+    prev = jnp.pad(member[:, :-1], ((0, 0), (1, 0)))
+    run_start = member & ~prev
+    copied = inside & ~member
+    contrib = jnp.where(run_start, k, jnp.where(copied, 1, 0))
+    out_start = jnp.cumsum(contrib, axis=1) - contrib
+    out_len = jnp.sum(contrib, axis=1).astype(jnp.int32)
+    wout = w * k if k > 1 else max(w, 1)
+    rows = jnp.arange(n)[:, None]
+    out = jnp.zeros((n, wout), dtype=jnp.uint8)
+    tgt = jnp.where(copied, out_start, wout)   # park non-copied off-end
+    out = _scatter_cols(out, rows, tgt, bytes_, wout)
+    for j in range(k):   # k is a small compile-time constant
+        tgt_j = jnp.where(run_start, out_start + j, wout)
+        rep = jnp.full((n, w), nb[j], dtype=jnp.uint8)
+        out = _scatter_cols(out, rows, tgt_j, rep, wout)
+    return out.astype(jnp.uint8), out_len
