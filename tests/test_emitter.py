@@ -1025,3 +1025,22 @@ def test_re_sub_subset_boundaries():
     got = ctx.parallelize(["a12b", "xx"]).map(
         lambda s: re.sub(r"\d", "#", s)).collect()
     assert got == ["a##b", "xx"]
+
+
+def test_format_sign_flag():
+    check(lambda x: f"{x:+d}", [5, -5, 0])
+    check(lambda x: f"{x:+08d}", [42, -42])
+    check(lambda x: f"{x:+.2f}", [1.5, -1.5, 0.0, -0.0])
+    check(lambda x: "{:+d}!".format(x), [7, -7])
+
+
+def test_format_d_of_float_falls_back():
+    import pytest as _pytest
+
+    import tuplex_tpu
+    with _pytest.raises(NotCompilable):
+        run_compiled(lambda x: f"{x:+d}", [1.5])
+    ctx = tuplex_tpu.Context()
+    got = (ctx.parallelize([1.5]).map(lambda x: f"{x:d}")
+           .resolve(ValueError, lambda x: "bad").collect())
+    assert got == ["bad"]

@@ -1398,7 +1398,8 @@ class Frame:
                 part = const_cv("}")
             elif piece.startswith("{"):
                 m = _re.fullmatch(
-                    r"\{(\d*)(?::(0?)(\d*)(?:\.(\d+))?([dsf]?))?\}", piece)
+                    r"\{(\d*)(?::([+]?)(0?)(\d*)(?:\.(\d+))?([dsf]?))?\}",
+                    piece)
                 if not m:
                     raise NotCompilable(f"format spec {piece!r}")
                 if m.group(1):
@@ -1414,13 +1415,15 @@ class Frame:
                 if idx >= len(args):
                     raise NotCompilable("format arity")
                 arg = args[idx]
-                zero = m.group(2) == "0"
-                width = int(m.group(3)) if m.group(3) else 0
-                prec = int(m.group(4)) if m.group(4) else None
-                kind = m.group(5) or ""
+                plus = m.group(2) == "+"
+                zero = m.group(3) == "0"
+                width = int(m.group(4)) if m.group(4) else 0
+                prec = int(m.group(5)) if m.group(5) else None
+                kind = m.group(6) or ""
                 if kind == "f":
                     part = self._float_format(arg, 6 if prec is None
-                                              else prec, width, zero)
+                                              else prec, width, zero,
+                                              plus=plus)
                     out = part if out is None else \
                         self._str_concat(out, part)
                     continue
@@ -1428,6 +1431,12 @@ class Frame:
                     # bare '{:.2}' is CPython general format (g-style
                     # sig-digits; ValueError on ints) — not fixed-point
                     raise NotCompilable(f"format spec {piece!r}")
+                arg_is_float = arg.base is T.F64 or (
+                    arg.is_const and isinstance(arg.const, float))
+                if kind == "d" and arg_is_float:
+                    # CPython: ValueError — types are static, so the whole
+                    # UDF falls back and keeps exact semantics
+                    raise NotCompilable("format d of float")
                 is_int = (kind == "d") or (
                     kind == "" and ((arg.base is T.I64 and not arg.is_const)
                                     or (arg.is_const and
@@ -1435,13 +1444,24 @@ class Frame:
                                         not isinstance(arg.const, bool))))
                 if is_int:
                     na = self._require_numeric(arg, "format int")
-                    fb, fl = S.format_i64(self._as_i64(na), width=width,
-                                          pad_zero=zero)
+                    iv = self._as_i64(na)
+                    if plus:
+                        # sign first, THEN zero-fill to the total width
+                        # (python counts the sign inside the field)
+                        fb, fl = self._prepend_plus(*S.format_i64(iv),
+                                                    iv >= 0)
+                        if zero and width > 0:
+                            fb, fl = S.zfill(fb, fl, width)
+                    else:
+                        fb, fl = S.format_i64(iv, width=width,
+                                              pad_zero=zero)
                     if width > 0 and not zero:
                         fb, fl = S.pad_left(fb, fl, width, " ")
                     part = CV(t=T.STR, sbytes=fb, slen=fl)
                 elif kind == "d":
                     raise NotCompilable("format d of non-int")
+                elif plus:
+                    raise NotCompilable("sign flag on non-numeric format")
                 else:
                     part = self._to_str(arg)
                     if width > 0:
@@ -1458,16 +1478,24 @@ class Frame:
             out = part if out is None else self._str_concat(out, part)
         return out if out is not None else const_cv("")
 
+    def _prepend_plus(self, fb, fl, nonneg):
+        """'+' before non-negative rows (negatives already carry '-')."""
+        pb, pl = S.broadcast_const("+", self.ctx.b)
+        return S.concat(pb, jnp.where(nonneg, pl, 0), fb, fl)
+
     def _float_format(self, arg: CV, prec: int, width: int = 0,
-                      pad_zero: bool = False) -> CV:
+                      pad_zero: bool = False, plus: bool = False) -> CV:
         """%.Nf / {:.Nf} fixed-point rendering; rounding ties and huge
         magnitudes route to the interpreter (CPython renders from the
         exact binary value — scaled integer math can double-round)."""
         from ..core.errors import ExceptionCode
 
         na = self._require_numeric(arg, "float format")
-        fb, fl, suspect = S.format_f64(self._cast(na.data, T.F64), prec)
+        fv = self._cast(na.data, T.F64)
+        fb, fl, suspect = S.format_f64(fv, prec)
         self.raise_where(suspect, ExceptionCode.NORMALCASEVIOLATION)
+        if plus:
+            fb, fl = self._prepend_plus(fb, fl, ~jnp.signbit(fv))
         if width > 0:
             if pad_zero:
                 fb, fl = S.zfill(fb, fl, width)
