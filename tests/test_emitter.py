@@ -1044,3 +1044,22 @@ def test_format_d_of_float_falls_back():
     got = (ctx.parallelize([1.5]).map(lambda x: f"{x:d}")
            .resolve(ValueError, lambda x: "bad").collect())
     assert got == ["bad"]
+
+
+def test_format_comma_grouping():
+    vals = [1, 123, 1234, 1234567, -9876543, 0]
+    check(lambda x: f"{x:,}", vals)
+    check(lambda x: f"{x:+,}", vals)
+    check(lambda x: f"{x:12,}", vals)
+    check(lambda x: "{:,}".format(x * 1000), vals)
+    import pytest as _pytest
+    with _pytest.raises(NotCompilable):
+        run_compiled(lambda x: f"{x:,.2f}", [1.5])
+    with _pytest.raises(NotCompilable):
+        run_compiled(lambda x: f"{x:08,}", [1234])
+
+
+def test_format_comma_on_string_falls_back():
+    import pytest as _pytest
+    with _pytest.raises(NotCompilable):
+        run_compiled(lambda s: f"{s:,}", ["abc"])

@@ -1398,8 +1398,8 @@ class Frame:
                 part = const_cv("}")
             elif piece.startswith("{"):
                 m = _re.fullmatch(
-                    r"\{(\d*)(?::([+]?)(0?)(\d*)(?:\.(\d+))?([dsf]?))?\}",
-                    piece)
+                    r"\{(\d*)(?::([+]?)(0?)(\d*)(,?)(?:\.(\d+))?"
+                    r"([dsf]?))?\}", piece)
                 if not m:
                     raise NotCompilable(f"format spec {piece!r}")
                 if m.group(1):
@@ -1418,8 +1418,15 @@ class Frame:
                 plus = m.group(2) == "+"
                 zero = m.group(3) == "0"
                 width = int(m.group(4)) if m.group(4) else 0
-                prec = int(m.group(5)) if m.group(5) else None
-                kind = m.group(6) or ""
+                comma = m.group(5) == ","
+                prec = int(m.group(6)) if m.group(6) else None
+                kind = m.group(7) or ""
+                if comma and (prec is not None or kind not in ("", "d")):
+                    raise NotCompilable(f"format spec {piece!r}")
+                if comma and zero:
+                    # python zero-fills WITH commas ('0,012'): beyond the
+                    # grouping kernel
+                    raise NotCompilable("comma grouping with zero fill")
                 if kind == "f":
                     part = self._float_format(arg, 6 if prec is None
                                               else prec, width, zero,
@@ -1433,10 +1440,10 @@ class Frame:
                     raise NotCompilable(f"format spec {piece!r}")
                 arg_is_float = arg.base is T.F64 or (
                     arg.is_const and isinstance(arg.const, float))
-                if kind == "d" and arg_is_float:
-                    # CPython: ValueError — types are static, so the whole
-                    # UDF falls back and keeps exact semantics
-                    raise NotCompilable("format d of float")
+                if (kind == "d" or comma) and arg_is_float:
+                    # CPython: ValueError for :d; ',' on floats groups the
+                    # int part (beyond the kernel) — both fall back
+                    raise NotCompilable("format d/comma of float")
                 is_int = (kind == "d") or (
                     kind == "" and ((arg.base is T.I64 and not arg.is_const)
                                     or (arg.is_const and
@@ -1453,15 +1460,18 @@ class Frame:
                         if zero and width > 0:
                             fb, fl = S.zfill(fb, fl, width)
                     else:
-                        fb, fl = S.format_i64(iv, width=width,
-                                              pad_zero=zero)
+                        fb, fl = S.format_i64(iv, width=0 if comma
+                                              else width, pad_zero=zero)
+                    if comma:
+                        fb, fl = S.group_thousands(fb, fl)
                     if width > 0 and not zero:
                         fb, fl = S.pad_left(fb, fl, width, " ")
                     part = CV(t=T.STR, sbytes=fb, slen=fl)
                 elif kind == "d":
                     raise NotCompilable("format d of non-int")
-                elif plus:
-                    raise NotCompilable("sign flag on non-numeric format")
+                elif plus or comma:
+                    # CPython: ValueError for sign/comma on non-numerics
+                    raise NotCompilable("sign/comma flag on non-numeric")
                 else:
                     part = self._to_str(arg)
                     if width > 0:

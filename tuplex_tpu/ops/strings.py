@@ -877,3 +877,34 @@ def replace_class_runs(bytes_, lens, table: np.ndarray, new: str):
         rep = jnp.full((n, w), nb[j], dtype=jnp.uint8)
         out = _scatter_cols(out, rows, tgt_j, rep, wout)
     return out.astype(jnp.uint8), out_len
+
+
+def group_thousands(bytes_, lens):
+    """Insert ',' every three digits from the right ('{:,}' grouping).
+    Input rows are sign+digits (format_i64 output)."""
+    n, w = bytes_.shape
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    has_sign = (bytes_[:, 0] == 45) | (bytes_[:, 0] == 43)
+    sign = has_sign.astype(jnp.int32)
+    ndig = lens - sign
+    # digit index from the LEFT for each position (sign occupies slot 0)
+    didx = pos - sign[:, None]
+    inside = (pos < lens[:, None]) & (didx >= 0)
+    # commas inserted before this digit = number of complete 3-groups to
+    # its right that start after it = (ndig-1-didx) // 3 subtracted from
+    # the total; equivalently commas to the LEFT of digit didx:
+    total_commas = jnp.maximum(ndig - 1, 0) // 3
+    commas_right = jnp.where(inside, (ndig[:, None] - 1 - didx) // 3, 0)
+    commas_left = total_commas[:, None] - commas_right
+    tgt = jnp.where(inside, pos + commas_left, -1)
+    # the sign char stays at position 0 (its didx is -1)
+    is_sign_pos = (pos == 0) & has_sign[:, None]
+    tgt = jnp.where(is_sign_pos, 0, tgt)
+    out_len = (lens + total_commas).astype(jnp.int32)
+    wout = w + (max(w, 1) + 2) // 3
+    rows = jnp.arange(n)[:, None]
+    out = jnp.full((n, wout), ord(","), dtype=jnp.uint8)
+    out = _scatter_cols(out, rows, jnp.where(tgt >= 0, tgt, wout),
+                        bytes_, wout)
+    keep = jnp.arange(wout, dtype=jnp.int32)[None, :] < out_len[:, None]
+    return jnp.where(keep, out, 0).astype(jnp.uint8), out_len
