@@ -14,16 +14,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import tempfile
 
-import jax
-
-# default to CPU so the example always runs (this machine's TPU plugin can
-# wedge in init); set TUPLEX_EXAMPLE_PLATFORM=tpu on a healthy chip. The
-# config update must come AFTER the jax import: a force-registered plugin
-# ignores the JAX_PLATFORMS env var.
-import os as _os
-
-jax.config.update("jax_platforms",
-                  _os.environ.get("TUPLEX_EXAMPLE_PLATFORM", "cpu"))
+import _platform  # noqa: F401 (platform default)
 
 import tuplex_tpu as tuplex
 

@@ -16,10 +16,7 @@ import tempfile
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-import jax
-
-# this machine's TPU plugin can wedge in init; examples stay on CPU
-jax.config.update("jax_platforms", "cpu")
+import _platform  # noqa: F401 (platform default)
 
 import tuplex_tpu
 

@@ -1,0 +1,12 @@
+"""Shared example bootstrap: default to the CPU platform so every
+example runs anywhere (a force-registered accelerator plugin ignores
+JAX_PLATFORMS, and a wedged tunnel hangs init); set
+TUPLEX_EXAMPLE_PLATFORM=tpu on a healthy chip. The config update must
+come AFTER the jax import."""
+
+import os
+
+import jax
+
+jax.config.update("jax_platforms",
+                  os.environ.get("TUPLEX_EXAMPLE_PLATFORM", "cpu"))
