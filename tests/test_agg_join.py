@@ -654,3 +654,31 @@ def test_mesh_failure_degrades_to_single_device_compiled():
     # degrade, no interpreter entries
     assert "interpreter" not in actions
     assert actions.count("elastic") == 1
+
+
+@pytest.mark.slow
+def test_nyc311_pipeline_on_mesh(tmp_path):
+    # a full benchmark pipeline through the mesh backend (8 virtual CPU
+    # devices via conftest): row-sharded stages + exact python parity
+    import tuplex_tpu
+    from tuplex_tpu.models import nyc311
+
+    path = str(tmp_path / "311.csv")
+    nyc311.generate_csv(path, 4000)
+    want = nyc311.run_reference_python(path)
+    c = tuplex_tpu.Context({"tuplex.backend": "multihost"})
+    got = nyc311.build_pipeline(c, path).collect()
+    assert sorted(map(repr, got)) == sorted(map(repr, want))
+
+
+@pytest.mark.slow
+def test_logs_strip_pipeline_on_mesh(tmp_path):
+    import tuplex_tpu
+    from tuplex_tpu.models import logs
+
+    path = str(tmp_path / "log.txt")
+    logs.generate_log(path, 3000)
+    want = logs.run_reference_python(path, "strip")
+    c = tuplex_tpu.Context({"tuplex.backend": "multihost"})
+    got = logs.build_pipeline(c.text(path), "strip").collect()
+    assert got == want
