@@ -1063,3 +1063,21 @@ def test_format_comma_on_string_falls_back():
     import pytest as _pytest
     with _pytest.raises(NotCompilable):
         run_compiled(lambda s: f"{s:,}", ["abc"])
+
+
+def test_int_base_and_base_render():
+    check(lambda s: int(s, 16), ["ff", "0xFF", "-0xff", " 1A ", "zz", ""])
+    check(lambda s: int(s, 2), ["101", "0b11", "2"])
+    check(lambda s: int(s, 36), ["zz", "10"])
+    check(lambda x: hex(x), [255, -255, 0, 2**40])
+    check(lambda x: oct(x), [8, -9, 0])
+    check(lambda x: bin(x), [5, -2, 0])
+    check(lambda x: hex(x * 16 + 10), [1, 15])
+
+
+def test_int_base_review_regressions():
+    # underscores route to the interpreter (exact CPython separator rules)
+    check(lambda s: int(s, 16), ["f_f", "0x_ff", "1_2_3"])
+    # const folds incl. arbitrary precision
+    check(lambda x: hex(2**100) if x else "", [1])
+    check(lambda x: int("ff", 16) + x, [1])

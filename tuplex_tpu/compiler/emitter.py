@@ -1927,7 +1927,23 @@ class Frame:
             return const_cv(0)
         v = args[0]
         if len(args) > 1:
-            raise NotCompilable("int(x, base)")
+            if not (args[1].is_const and isinstance(args[1].const, int)
+                    and 2 <= args[1].const <= 36):
+                raise NotCompilable("int(x, base) dynamic base")
+            if not (v.base is T.STR or (v.is_const and
+                                        isinstance(v.const, str))):
+                raise NotCompilable("int(x, base) of non-string")
+            if v.is_const:
+                try:
+                    return const_cv(int(v.const, args[1].const))
+                except ValueError:
+                    pass   # every row raises: keep python semantics below
+            rb, rl = self._to_strpair(v)
+            self._ascii_guard(rb, rl)
+            val, bad, ovf = S.parse_int_base(rb, rl, args[1].const)
+            self.raise_where(bad, ExceptionCode.VALUEERROR)
+            self.raise_where(ovf & ~bad, ExceptionCode.NORMALCASEVIOLATION)
+            return CV(t=T.I64, data=val)
         if v.is_const:
             try:
                 return const_cv(int(v.const))
@@ -2047,6 +2063,30 @@ class Frame:
             idx = jnp.where(eqs[i], i, idx)
         self.raise_where(idx < 0, ExceptionCode.VALUEERROR)
         return CV(t=T.I64, data=jnp.maximum(idx, 0))
+
+    def _int_to_base(self, args: list[CV], base: int, what: str) -> CV:
+        if len(args) != 1:
+            raise NotCompilable(f"{what} arity")
+        v = args[0]
+        if not (v.base is T.I64 or v.base is T.BOOL or
+                (v.is_const and isinstance(v.const, int))):
+            raise NotCompilable(f"{what} of non-int")   # python: TypeError
+        if v.is_const:
+            # const fold (also: arbitrary-precision consts never reach the
+            # i64 kernel)
+            return const_cv({16: hex, 8: oct, 2: bin}[base](v.const))
+        fb, fl = S.int_to_base(self._as_i64(
+            self._require_numeric(v, what)), base)
+        return CV(t=T.STR, sbytes=fb, slen=fl)
+
+    def _builtin_hex(self, args: list[CV]) -> CV:
+        return self._int_to_base(args, 16, "hex")
+
+    def _builtin_oct(self, args: list[CV]) -> CV:
+        return self._int_to_base(args, 8, "oct")
+
+    def _builtin_bin(self, args: list[CV]) -> CV:
+        return self._int_to_base(args, 2, "bin")
 
     def _builtin_divmod(self, args: list[CV]) -> CV:
         if len(args) != 2:

@@ -908,3 +908,103 @@ def group_thousands(bytes_, lens):
                         bytes_, wout)
     keep = jnp.arange(wout, dtype=jnp.int32)[None, :] < out_len[:, None]
     return jnp.where(keep, out, 0).astype(jnp.uint8), out_len
+
+
+def parse_int_base(bytes_, lens, base: int):
+    """int(s, base) with a constant base in 2..36. Accepts optional
+    surrounding whitespace, one sign, and the matching 0x/0o/0b prefix.
+    Returns (value i64, bad bool, overflow bool): `bad` rows raise
+    ValueError, `overflow` rows need arbitrary precision (interpreter)."""
+    sb, sl = strip(bytes_, lens)
+    n, w = sb.shape
+    first = sb[:, 0]
+    has_sign = ((first == 43) | (first == 45)) & (sl > 0)
+    neg = (first == 45) & has_sign
+    start = has_sign.astype(jnp.int32)
+    prefix = {16: (120, 88), 8: (111, 79), 2: (98, 66)}.get(base)
+    if prefix is not None:
+        idx0 = jnp.clip(start, 0, w - 1)
+        idx1 = jnp.clip(start + 1, 0, w - 1)
+        c0 = jnp.take_along_axis(sb, idx0[:, None], axis=1)[:, 0]
+        c1 = jnp.take_along_axis(sb, idx1[:, None], axis=1)[:, 0]
+        has_pref = (c0 == 48) & ((c1 == prefix[0]) | (c1 == prefix[1])) & \
+            (sl >= start + 2)
+        start = start + jnp.where(has_pref, 2, 0)
+    # digit value table: 255 = invalid for this base
+    tab = np.full(256, 255, dtype=np.uint8)
+    for c in range(256):
+        v = None
+        if 48 <= c <= 57:
+            v = c - 48
+        elif 97 <= c <= 122:
+            v = c - 87
+        elif 65 <= c <= 90:
+            v = c - 55
+        if v is not None and v < base:
+            tab[c] = v
+    dig = jnp.take(jnp.asarray(tab), sb.astype(jnp.int32))
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    in_digits = (pos >= start[:, None]) & (pos < sl[:, None])
+    # CPython accepts '_' separators between digits: exact handling needs
+    # positional rules, so underscore rows route to the interpreter
+    # (overflow flag) instead of raising
+    has_us = jnp.any(in_digits & (sb == 95), axis=1)
+    bad = (jnp.any(in_digits & (dig == 255) & (sb != 95), axis=1)
+           | (sl <= start))
+    # digits such that base**k fits i64 comfortably
+    max_digits = 1
+    while base ** (max_digits + 1) < 2 ** 62:
+        max_digits += 1
+    ndig = sl - start
+    overflow = (ndig > max_digits) | has_us
+    # positional power sum over a bounded window (same technique as
+    # parse_i64: no W-step carry chain)
+    widx = start[:, None] + jnp.arange(max_digits, dtype=jnp.int32)[None, :]
+    wd = jnp.take_along_axis(
+        jnp.where(dig == 255, 0, dig).astype(jnp.int64),
+        jnp.clip(widx, 0, w - 1), axis=1)
+    j = jnp.arange(max_digits, dtype=jnp.int32)[None, :]
+    exp = jnp.clip(ndig[:, None] - 1 - j, 0, max_digits - 1)
+    powers = jnp.asarray(
+        np.array([base ** k for k in range(max_digits)], dtype=np.int64))
+    term = wd * jnp.take(powers, exp) * (j < ndig[:, None])
+    acc = jnp.sum(term, axis=1)
+    return jnp.where(neg, -acc, acc), bad, overflow
+
+
+def int_to_base(vals, base: int):
+    """hex()/oct()/bin() rendering: sign + 0x/0o/0b + digits (python
+    semantics: hex(-255) == '-0xff'). Returns (bytes, lens)."""
+    pref = {16: "0x", 8: "0o", 2: "0b"}[base]
+    n = vals.shape[0]
+    neg = vals < 0
+    a = jnp.where(neg, -vals, vals).astype(jnp.uint64)
+    ndigits = 1
+    while base ** ndigits < 2 ** 64:
+        ndigits += 1
+    digs = []
+    cur = a
+    for _ in range(ndigits):
+        d = (cur % base).astype(jnp.int32)
+        digs.append(d)
+        cur = cur // base
+    # digs[0] = least significant; render most-significant first with
+    # leading-zero suppression
+    chars = []
+    for d in reversed(digs):
+        chars.append(jnp.where(d < 10, 48 + d, 87 + d).astype(jnp.uint8))
+    mat = jnp.stack(chars, axis=1)                     # [n, ndigits]
+    sig = jnp.stack(list(reversed(digs)), axis=1) != 0
+    first_sig = jnp.argmax(sig, axis=1).astype(jnp.int32)
+    nz = jnp.any(sig, axis=1)
+    first_sig = jnp.where(nz, first_sig, ndigits - 1)  # 0 renders '0'
+    out_ndig = ndigits - first_sig
+    # assemble: sign + prefix + digits (shift digits left)
+    head = ("-" + pref, pref)
+    hb_neg, hl_neg = broadcast_const(head[0], n)
+    hb_pos, hl_pos = broadcast_const(head[1], n, width=hb_neg.shape[1])
+    hb = jnp.where(neg[:, None], hb_neg, hb_pos)
+    hl = jnp.where(neg, hl_neg, hl_pos)
+    db, dl = slice_(mat, jnp.full(n, ndigits, jnp.int32),
+                    first_sig, first_sig + out_ndig)
+    return concat(hb, hl, db, dl)
