@@ -1081,3 +1081,31 @@ def test_int_base_review_regressions():
     # const folds incl. arbitrary precision
     check(lambda x: hex(2**100) if x else "", [1])
     check(lambda x: int("ff", 16) + x, [1])
+
+
+def test_percent_hex_octal():
+    vals = [255, -255, 0, 4095]
+    check(lambda x: "%x" % x, vals)
+    check(lambda x: "%X" % x, vals)
+    check(lambda x: "%o" % x, vals)
+    check(lambda x: "%08x" % x, vals)
+    check(lambda x: "%6x|" % x, vals)
+    import pytest as _pytest
+    with _pytest.raises(NotCompilable):
+        run_compiled(lambda x: "%x" % x, [1.5])
+
+
+def test_percent_format_strictness():
+    import pytest as _pytest
+
+    import tuplex_tpu
+    for f in (lambda x: "%#x" % x, lambda x: "%e" % x,
+              lambda x: "%x" % (x, x), lambda x: "%-8d" % x):
+        with _pytest.raises(NotCompilable):
+            run_compiled(f, [255])
+    ctx = tuplex_tpu.Context()
+    assert ctx.parallelize([255]).map(lambda x: "%#x" % x).collect() \
+        == ["0xff"]
+    got = (ctx.parallelize([255]).map(lambda x: "%x" % (x, x))
+           .resolve(TypeError, lambda x: "bad").collect())
+    assert got == ["bad"]

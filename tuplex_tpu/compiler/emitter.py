@@ -1329,7 +1329,7 @@ class Frame:
         # '%%' splits out first so "%%d" stays the literal '%d' instead of
         # consuming an argument (advisor finding, round 1 — CPython treats
         # '%%' as an escape wherever it appears)
-        pieces = _re.split(r"(%%|%0?\d*(?:\.\d+)?[dsf])", spec)
+        pieces = _re.split(r"(%%|%0?\d*(?:\.\d+)?[dsfxXo])", spec)
         out: Optional[CV] = None
         ai = 0
         for piece in pieces:
@@ -1337,7 +1337,7 @@ class Frame:
                 continue
             if piece == "%%":
                 part = const_cv("%")
-            elif _re.fullmatch(r"%0?\d*(?:\.\d+)?[dsf]", piece):
+            elif _re.fullmatch(r"%0?\d*(?:\.\d+)?[dsfxXo]", piece):
                 if ai >= len(arg_list):
                     raise NotCompilable("format arity")
                 arg = arg_list[ai]
@@ -1358,6 +1358,24 @@ class Frame:
                     continue
                 if prec is not None:
                     raise NotCompilable(f"format {piece!r}")
+                if kind in ("x", "X", "o"):
+                    if arg.base is T.F64 or (arg.is_const and
+                                             isinstance(arg.const, float)):
+                        raise NotCompilable("%x of float")  # TypeError
+                    base = 8 if kind == "o" else 16
+                    fb, fl = S.int_to_base(self._as_i64(
+                        self._require_numeric(arg, "%x")), base,
+                        prefix=False)
+                    if kind == "X":
+                        fb, fl = S.upper(fb, fl)
+                    if pad_zero and width > 0:
+                        fb, fl = S.zfill(fb, fl, width)
+                    elif width > 0:
+                        fb, fl = S.pad_left(fb, fl, width, " ")
+                    part = CV(t=T.STR, sbytes=fb, slen=fl)
+                    out = part if out is None else \
+                        self._str_concat(out, part)
+                    continue
                 if kind == "d":
                     arg = self._require_numeric(arg, "%d")
                     fb, fl = S.format_i64(self._as_i64(arg), width=width,
@@ -1374,8 +1392,16 @@ class Frame:
                 else:
                     raise NotCompilable(f"format kind {kind!r}")
             else:
+                if "%" in piece:
+                    # an unrecognized directive (%#x, %e, %-8d, lone %)
+                    # must never pass through as literal text
+                    raise NotCompilable(f"format {piece!r}")
                 part = const_cv(piece)
             out = part if out is None else self._str_concat(out, part)
+        if ai != len(arg_list):
+            # CPython: TypeError('not all arguments converted ...') — the
+            # interpreter keeps exact semantics
+            raise NotCompilable("surplus % format arguments")
         return out if out is not None else const_cv("")
 
     def _format_method(self, spec: str, args: list[CV]) -> CV:

@@ -972,10 +972,11 @@ def parse_int_base(bytes_, lens, base: int):
     return jnp.where(neg, -acc, acc), bad, overflow
 
 
-def int_to_base(vals, base: int):
+def int_to_base(vals, base: int, prefix: bool = True):
     """hex()/oct()/bin() rendering: sign + 0x/0o/0b + digits (python
-    semantics: hex(-255) == '-0xff'). Returns (bytes, lens)."""
-    pref = {16: "0x", 8: "0o", 2: "0b"}[base]
+    semantics: hex(-255) == '-0xff'); prefix=False renders the %x/%o
+    shape (sign + digits). Returns (bytes, lens)."""
+    pref = {16: "0x", 8: "0o", 2: "0b"}[base] if prefix else ""
     n = vals.shape[0]
     neg = vals < 0
     a = jnp.where(neg, -vals, vals).astype(jnp.uint64)
