@@ -1,0 +1,17 @@
+"""TPU liveness probe with verbose PJRT logging. Prints stages as it goes."""
+import os, sys, time, faulthandler, threading
+faulthandler.enable()
+# dump all thread stacks every 60s so a wedge leaves evidence
+faulthandler.dump_traceback_later(60, repeat=True, file=sys.stderr)
+t0 = time.time()
+print(f"[{time.time()-t0:.1f}s] importing jax", flush=True)
+import jax
+print(f"[{time.time()-t0:.1f}s] jax {jax.__version__} imported; calling jax.devices()", flush=True)
+devs = jax.devices()
+print(f"[{time.time()-t0:.1f}s] devices: {devs}", flush=True)
+import jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+y = (x @ x).sum()
+y.block_until_ready()
+print(f"[{time.time()-t0:.1f}s] matmul ok: {float(y)}", flush=True)
+print("PROBE_OK", flush=True)

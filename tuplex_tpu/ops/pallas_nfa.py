@@ -48,17 +48,31 @@ def _build_kernel(P: int, w: int, anchored_start: bool, anchored_end: bool,
         def body(j, carry):
             S, matched = carry
             byte_col = bytes_ref[:, j]
-            cm = class_ref[byte_col, :]                    # [B, Pp] gather
+            # class membership via one-hot matmul, not a ref gather: Mosaic
+            # rejects int indexing on VMEM refs ("Cannot do int indexing on
+            # TPU", mosaic/lowering.py _canonicalize_transforms_to_indexer —
+            # caught by tpu_diag/aot_lower_tpu.py), and the [B,256]x[256,Pp]
+            # product is MXU work anyway.
+            b32 = byte_col.astype(jnp.int32)
+            onehot = (b32[:, None] ==
+                      jnp.arange(256, dtype=jnp.int32)[None, :]
+                      ).astype(jnp.float32)                # [B, 256]
+            cm = jnp.dot(onehot, class_ref[...],
+                         preferred_element_type=jnp.float32)  # [B, Pp]
             nxt = jnp.dot(S, follow,
                           preferred_element_type=jnp.float32) > 0.5
             if anchored_start:
                 seed = jnp.where(j == 0, firstv, 0.0)[None, :]
             else:
                 seed = firstv[None, :]
-            S2 = jnp.where((nxt | (seed > 0.5)) & (cm > 0.5),
-                           1.0, 0.0).astype(jnp.float32)
+            # f32 literals: under x64 a bare 1.0 is f64, and Mosaic has no
+            # f64->f32 cast (finding 2 of 3 in tpu_diag/aot_lower_tpu.py;
+            # TPU_DIAGNOSIS.md lists all three)
+            one = jnp.float32(1.0)
+            zero = jnp.float32(0.0)
+            S2 = jnp.where((nxt | (seed > 0.5)) & (cm > 0.5), one, zero)
             inb = (j < lens)[:, None]
-            S2 = jnp.where(inb, S2, 0.0).astype(jnp.float32)
+            S2 = jnp.where(inb, S2, zero)
             hit = jnp.max(S2 * lastv[None, :], axis=1) > 0.5
             if anchored_end:
                 hit = hit & ((j + 1 == lens) | (j + 1 == end_at))
@@ -90,17 +104,21 @@ def _build_kernel(P: int, w: int, anchored_start: bool, anchored_end: bool,
     return run, Pp
 
 
-def match_pallas(rx, bytes_, lens):
+def match_pallas(rx, bytes_, lens, interpret=None):
     """Drive the kernel: pad rows to the block multiple and positions to
-    sublane width, then slice the matches back."""
+    sublane width, then slice the matches back. `interpret=None` picks
+    automatically (Mosaic on TPU, interpret elsewhere); tpu_diag's AOT
+    lowering passes False explicitly to force the Mosaic path from a CPU
+    host."""
     n, w = bytes_.shape
     P = rx.n_pos
     if P == 0:          # pure-anchor pattern ('^$'): decided by matched0
         lens64, end_at = rx._end_masks(bytes_, lens, w)
         return rx._matched0(n, end_at)
-    # Mosaic is the only native target this kernel is written for (1D
-    # blocks + dynamic ref gather); every other backend interprets
-    interpret = jax.default_backend() != "tpu"
+    if interpret is None:
+        # Mosaic is the only native target this kernel is tuned for (1D
+        # blocks, VMEM-resident tables); every other backend interprets
+        interpret = jax.default_backend() != "tpu"
     run, Pp = _build_kernel(P, w, rx.anchored_start, rx.anchored_end,
                             interpret)
 
@@ -116,13 +134,20 @@ def match_pallas(rx, bytes_, lens):
     def padP(a):
         return jnp.pad(a, ((0, 0),) * (a.ndim - 1) + ((0, Pp - P),))
 
-    out = run(
-        padrows(bytes_), padrows(lens64.astype(jnp.int32)),
-        padrows(end_at.astype(jnp.int32)),
-        padrows(m0.astype(jnp.float32)),
-        padP(jnp.asarray(np.pad(rx._follow_dense, ((0, Pp - P), (0, 0))))),
-        padP(jnp.asarray(rx._classtab_dense)),
-        padP(jnp.asarray(rx._first_dense)),
-        padP(jnp.asarray(rx._last_dense)),
-    )
+    # trace the kernel with x64 OFF: global x64 + pallas_call + the Mosaic
+    # TPU lowering recurses without bound in jax 0.9 (RecursionError even at
+    # limit 100k — minimized repro in tpu_diag/aot_lower_tpu.py notes). All
+    # kernel inputs are explicitly 32-bit, so narrowing the promotion rules
+    # changes nothing semantically.
+    with jax.enable_x64(False):
+        out = run(
+            padrows(bytes_), padrows(lens64.astype(jnp.int32)),
+            padrows(end_at.astype(jnp.int32)),
+            padrows(m0.astype(jnp.float32)),
+            padP(jnp.asarray(np.pad(rx._follow_dense,
+                                    ((0, Pp - P), (0, 0))))),
+            padP(jnp.asarray(rx._classtab_dense)),
+            padP(jnp.asarray(rx._first_dense)),
+            padP(jnp.asarray(rx._last_dense)),
+        )
     return out[:n]
