@@ -71,3 +71,64 @@ def test_offsets_to_matrix_parity(monkeypatch):
         assert (leaf_n.bytes == leaf_p.bytes).all()
         assert (leaf_n.lengths == leaf_p.lengths).all()
         assert full_n.tolist() == full_p.tolist()
+
+
+def test_decode_columns_parity(monkeypatch):
+    """One-pass C decode (decode_columns) must equal the python column
+    decode exactly, incl. Option masks and non-ASCII strings."""
+    from tuplex_tpu import native as N
+    from tuplex_tpu.runtime import columns as C
+
+    vals = [(1, "ab", 1.5, True), (None, None, None, None),
+            (3, "日本語", -2.25, False), (4, "", 0.0, True)]
+    schema = T.row_of(["a", "b", "c", "d"],
+                      [T.option(T.I64), T.option(T.STR),
+                       T.option(T.F64), T.option(T.BOOL)])
+    part = C.build_partition(vals, schema)
+    fast = C.partition_to_pylist(part)
+    monkeypatch.setattr(N, "_mod", None)
+    monkeypatch.setattr(N, "_tried", True)  # force the python path
+    slow = C.partition_to_pylist(part)
+    assert fast == slow
+
+
+@pytest.mark.slow
+def test_bulk_transfer_speedup_1m():
+    """VERDICT r3 #7: the native mixed-tuple paths must clearly beat the
+    python boxing loop at scale. Numbers print for STATUS; the assert is a
+    conservative floor so timing noise can't flake CI."""
+    import time
+
+    from tuplex_tpu import native as N
+    from tuplex_tpu.runtime import columns as C
+
+    n = 1_000_000
+    vals = [(i, f"name_{i % 9973}", i * 0.5, i % 3 == 0) for i in range(n)]
+    schema = T.row_of(["a", "b", "c", "d"], [T.I64, T.STR, T.F64, T.BOOL])
+
+    t0 = time.perf_counter()
+    part = C.build_partition(vals, schema)
+    enc_fast = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = C.partition_to_pylist(part)
+    dec_fast = time.perf_counter() - t0
+    assert out[:2] == vals[:2] and len(out) == n
+
+    mod, tried = N._mod, N._tried
+    N._mod, N._tried = None, True  # force the python path
+    try:
+        t0 = time.perf_counter()
+        part_p = C.build_partition(vals, schema)
+        enc_py = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out_p = C.partition_to_pylist(part_p)
+        dec_py = time.perf_counter() - t0
+    finally:
+        N._mod, N._tried = mod, tried
+    assert out_p == out
+    print(f"\nencode 1M rows: native {enc_fast:.3f}s vs python {enc_py:.3f}s "
+          f"({enc_py / enc_fast:.1f}x)")
+    print(f"decode 1M rows: native {dec_fast:.3f}s vs python {dec_py:.3f}s "
+          f"({dec_py / dec_fast:.1f}x)")
+    assert enc_py / enc_fast > 1.5
+    assert dec_py / dec_fast > 1.5

@@ -294,6 +294,321 @@ static PyObject *decode_str(PyObject *, PyObject *args) {
   return out;
 }
 
+// One-pass multi-column decode: typed column buffers -> list of row tuples
+// (list of bare values for a single column). The resultSetToCPython analog
+// (reference: tuplex/python/src/PythonDataSet.cc:1400-1442 dispatches to
+// per-type bulk decoders) — avoids per-column python lists, Option-mask
+// comprehensions, and the final zip().
+//
+// spec per column: (kind, data_buf, valid_buf|None[, lens_buf, width])
+//   kind: 0=i64 1=f64 2=bool 3=str(bytes matrix + i32 lens + width)
+static PyObject *decode_columns(PyObject *, PyObject *args) {
+  PyObject *spec;
+  Py_ssize_t n;
+  if (!PyArg_ParseTuple(args, "On", &spec, &n)) return nullptr;
+  if (!PyList_Check(spec)) {
+    PyErr_SetString(PyExc_TypeError, "spec must be a list");
+    return nullptr;
+  }
+  Py_ssize_t k = PyList_GET_SIZE(spec);
+  struct Col {
+    int kind = 0;
+    Py_buffer data{}, valid{}, lens{};
+    bool has_valid = false, has_lens = false;
+    Py_ssize_t w = 0;
+  };
+  std::vector<Col> cols(static_cast<size_t>(k));
+  bool arg_ok = true;
+  for (Py_ssize_t c = 0; c < k && arg_ok; c++) {
+    PyObject *t = PyList_GET_ITEM(spec, c);
+    Col &col = cols[static_cast<size_t>(c)];
+    PyObject *vb = Py_None;
+    long kind = 0;
+    if (!PyArg_ParseTuple(t, "ly*|Oy*n", &kind, &col.data, &vb, &col.lens,
+                          &col.w)) {
+      col = Col{};  // ParseTuple released any y* buffers it acquired
+      arg_ok = false;
+      break;
+    }
+    col.kind = static_cast<int>(kind);
+    col.has_lens = col.lens.buf != nullptr;
+    if (vb != Py_None) {
+      if (PyObject_GetBuffer(vb, &col.valid, PyBUF_SIMPLE) < 0) {
+        arg_ok = false;
+        break;
+      }
+      col.has_valid = true;
+    }
+    // bounds: every row index must stay inside the provided buffers
+    Py_ssize_t need = col.kind == 3 ? n * col.w
+                      : col.kind == 2 ? n
+                                      : n * 8;
+    if (col.data.len < need || (col.has_valid && col.valid.len < n) ||
+        (col.kind == 3 && (!col.has_lens || col.lens.len < n * 4))) {
+      PyErr_SetString(PyExc_ValueError, "column buffer too small");
+      arg_ok = false;
+      break;
+    }
+  }
+  PyObject *out = arg_ok ? PyList_New(n) : nullptr;
+  if (out) {
+    bool single = (k == 1);
+    for (Py_ssize_t i = 0; i < n && out; i++) {
+      PyObject *row = single ? nullptr : PyTuple_New(k);
+      if (!single && !row) {
+        Py_CLEAR(out);
+        break;
+      }
+      for (Py_ssize_t c = 0; c < k; c++) {
+        Col &col = cols[static_cast<size_t>(c)];
+        PyObject *v = nullptr;
+        if (col.has_valid &&
+            !reinterpret_cast<const char *>(col.valid.buf)[i]) {
+          v = Py_None;
+          Py_INCREF(v);
+        } else {
+          switch (col.kind) {
+            case 0:
+              v = PyLong_FromLongLong(
+                  reinterpret_cast<const int64_t *>(col.data.buf)[i]);
+              break;
+            case 1:
+              v = PyFloat_FromDouble(
+                  reinterpret_cast<const double *>(col.data.buf)[i]);
+              break;
+            case 2:
+              v = PyBool_FromLong(
+                  reinterpret_cast<const char *>(col.data.buf)[i]);
+              break;
+            case 3: {
+              int32_t li = reinterpret_cast<const int32_t *>(col.lens.buf)[i];
+              if (li < 0) li = 0;
+              if (li > col.w) li = static_cast<int32_t>(col.w);
+              v = PyUnicode_DecodeUTF8(
+                  reinterpret_cast<const char *>(col.data.buf) + i * col.w,
+                  li, "replace");
+              break;
+            }
+            default:
+              PyErr_SetString(PyExc_ValueError, "bad column kind");
+          }
+        }
+        if (!v) {
+          Py_XDECREF(row);
+          Py_CLEAR(out);
+          break;
+        }
+        if (single) {
+          PyList_SET_ITEM(out, i, v);
+        } else {
+          PyTuple_SET_ITEM(row, c, v);
+        }
+      }
+      if (out && !single) PyList_SET_ITEM(out, i, row);
+    }
+  }
+  for (auto &col : cols) {
+    if (col.data.buf) PyBuffer_Release(&col.data);
+    if (col.has_valid) PyBuffer_Release(&col.valid);
+    if (col.has_lens) PyBuffer_Release(&col.lens);
+  }
+  return out;
+}
+
+// One-pass mixed-tuple encode: list of k-tuples -> per-column typed buffers
+// (the fastMixedSimpleTypeTupleTransfer analog, reference:
+// tuplex/python/src/PythonContext.cc:860). kinds: same codes as
+// decode_columns. Returns (cols, bad_list) where cols is a list of
+//   i64/f64: (data_bytes, valid_bytes)   bool: (data_bytes, valid_bytes)
+//   str:     (mat_bytes, lens_bytes, valid_bytes, width)
+// bad rows (wrong arity / non-conforming field type / i64 overflow) have
+// every column slot zeroed+valid and appear in bad_list for boxing.
+static PyObject *encode_rows(PyObject *, PyObject *args) {
+  PyObject *rows, *kinds_obj;
+  if (!PyArg_ParseTuple(args, "OO", &rows, &kinds_obj)) return nullptr;
+  if (!PyList_Check(rows) || !PyList_Check(kinds_obj)) {
+    PyErr_SetString(PyExc_TypeError, "expected (list, list)");
+    return nullptr;
+  }
+  Py_ssize_t n = PyList_GET_SIZE(rows);
+  Py_ssize_t k = PyList_GET_SIZE(kinds_obj);
+  std::vector<int> kinds(static_cast<size_t>(k));
+  for (Py_ssize_t c = 0; c < k; c++) {
+    long v = PyLong_AsLong(PyList_GET_ITEM(kinds_obj, c));
+    if (v < 0 || v > 3) {
+      PyErr_SetString(PyExc_ValueError, "bad kind");
+      return nullptr;
+    }
+    kinds[static_cast<size_t>(c)] = static_cast<int>(v);
+  }
+  // str columns need a width pass first
+  std::vector<Py_ssize_t> widths(static_cast<size_t>(k), 0);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *row = PyList_GET_ITEM(rows, i);
+    // exact tuple only (matches the python path's `type(v) is tuple`):
+    // namedtuple rows must box so collect() returns them unchanged
+    if (!PyTuple_CheckExact(row) || PyTuple_GET_SIZE(row) != k) continue;
+    for (Py_ssize_t c = 0; c < k; c++) {
+      if (kinds[static_cast<size_t>(c)] != 3) continue;
+      PyObject *o = PyTuple_GET_ITEM(row, c);
+      if (PyUnicode_Check(o)) {
+        Py_ssize_t sz = 0;
+        if (PyUnicode_AsUTF8AndSize(o, &sz)) {
+          if (sz > widths[static_cast<size_t>(c)])
+            widths[static_cast<size_t>(c)] = sz;
+        } else {
+          PyErr_Clear();
+        }
+      }
+    }
+  }
+  struct OutCol {
+    PyObject *data = nullptr, *valid = nullptr, *lens = nullptr;
+    char *d = nullptr, *v = nullptr;
+    int32_t *lp = nullptr;
+    Py_ssize_t w = 1;
+  };
+  std::vector<OutCol> out(static_cast<size_t>(k));
+  bool alloc_ok = true;
+  for (Py_ssize_t c = 0; c < k && alloc_ok; c++) {
+    OutCol &oc = out[static_cast<size_t>(c)];
+    int kind = kinds[static_cast<size_t>(c)];
+    Py_ssize_t esz = kind == 2 ? 1 : 8;
+    if (kind == 3) {
+      oc.w = widths[static_cast<size_t>(c)] > 0
+                 ? widths[static_cast<size_t>(c)]
+                 : 1;
+      oc.data = PyBytes_FromStringAndSize(nullptr, n * oc.w);
+      oc.lens = PyBytes_FromStringAndSize(nullptr, n * 4);
+      if (!oc.data || !oc.lens) {
+        alloc_ok = false;
+        break;
+      }
+      oc.lp = reinterpret_cast<int32_t *>(PyBytes_AS_STRING(oc.lens));
+      memset(PyBytes_AS_STRING(oc.data), 0, static_cast<size_t>(n * oc.w));
+    } else {
+      oc.data = PyBytes_FromStringAndSize(nullptr, n * esz);
+      if (!oc.data) {
+        alloc_ok = false;
+        break;
+      }
+      memset(PyBytes_AS_STRING(oc.data), 0, static_cast<size_t>(n * esz));
+    }
+    oc.valid = PyBytes_FromStringAndSize(nullptr, n);
+    if (!oc.valid) {
+      alloc_ok = false;
+      break;
+    }
+    oc.d = PyBytes_AS_STRING(oc.data);
+    oc.v = PyBytes_AS_STRING(oc.valid);
+  }
+  PyObject *bad_list = alloc_ok ? PyList_New(0) : nullptr;
+  if (!bad_list) {
+    for (auto &oc : out) {
+      Py_XDECREF(oc.data);
+      Py_XDECREF(oc.valid);
+      Py_XDECREF(oc.lens);
+    }
+    return nullptr;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *row = PyList_GET_ITEM(rows, i);
+    bool bad = !PyTuple_CheckExact(row) || PyTuple_GET_SIZE(row) != k;
+    for (Py_ssize_t c = 0; c < k && !bad; c++) {
+      OutCol &oc = out[static_cast<size_t>(c)];
+      PyObject *o = PyTuple_GET_ITEM(row, c);
+      oc.v[i] = 1;
+      if (o == Py_None) {
+        oc.v[i] = 0;  // Option slot; schema-validity is the caller's check
+        if (oc.lp) oc.lp[i] = 0;
+        continue;
+      }
+      switch (kinds[static_cast<size_t>(c)]) {
+        case 0: {
+          if (!PyLong_Check(o) || PyBool_Check(o)) {
+            bad = true;
+            break;
+          }
+          int overflow = 0;
+          long long val = PyLong_AsLongLongAndOverflow(o, &overflow);
+          if (overflow) {
+            bad = true;
+            break;
+          }
+          reinterpret_cast<int64_t *>(oc.d)[i] = val;
+          break;
+        }
+        case 1:
+          if (!PyFloat_Check(o)) {
+            bad = true;
+            break;
+          }
+          reinterpret_cast<double *>(oc.d)[i] = PyFloat_AS_DOUBLE(o);
+          break;
+        case 2:
+          if (!PyBool_Check(o)) {
+            bad = true;
+            break;
+          }
+          oc.d[i] = (o == Py_True) ? 1 : 0;
+          break;
+        case 3: {
+          if (!PyUnicode_Check(o)) {
+            bad = true;
+            break;
+          }
+          Py_ssize_t sz = 0;
+          const char *u = PyUnicode_AsUTF8AndSize(o, &sz);
+          // sz > w can only happen if pass 1's AsUTF8 failed transiently
+          // for this object — never write past the row slot
+          if (!u || sz > oc.w) {
+            PyErr_Clear();
+            bad = true;
+            break;
+          }
+          memcpy(oc.d + i * oc.w, u, static_cast<size_t>(sz));
+          oc.lp[i] = static_cast<int32_t>(sz);
+          break;
+        }
+      }
+    }
+    if (bad) {
+      for (Py_ssize_t c = 0; c < k; c++) {
+        OutCol &oc = out[static_cast<size_t>(c)];
+        oc.v[i] = 1;  // slot unusable; caller boxes the row
+        if (oc.lp) oc.lp[i] = 0;
+      }
+      PyObject *idx = PyLong_FromSsize_t(i);
+      PyList_Append(bad_list, idx);
+      Py_DECREF(idx);
+    }
+  }
+  PyObject *cols_out = PyList_New(k);
+  if (!cols_out) {
+    for (auto &oc : out) {
+      Py_XDECREF(oc.data);
+      Py_XDECREF(oc.valid);
+      Py_XDECREF(oc.lens);
+    }
+    Py_DECREF(bad_list);
+    return nullptr;
+  }
+  for (Py_ssize_t c = 0; c < k; c++) {
+    OutCol &oc = out[static_cast<size_t>(c)];
+    PyObject *t =
+        kinds[static_cast<size_t>(c)] == 3
+            ? Py_BuildValue("(NNNn)", oc.data, oc.lens, oc.valid, oc.w)
+            : Py_BuildValue("(NN)", oc.data, oc.valid);
+    if (!t) {
+      Py_DECREF(cols_out);
+      Py_DECREF(bad_list);
+      return nullptr;
+    }
+    PyList_SET_ITEM(cols_out, c, t);
+  }
+  return Py_BuildValue("(NN)", cols_out, bad_list);
+}
+
 static PyMethodDef Methods[] = {
     {"encode_i64", encode_i64, METH_O, "bulk encode int column"},
     {"encode_f64", encode_f64, METH_O, "bulk encode float column"},
@@ -302,6 +617,10 @@ static PyMethodDef Methods[] = {
     {"offsets_to_matrix", offsets_to_matrix, METH_VARARGS,
      "arrow offsets+data -> padded byte matrix"},
     {"decode_str", decode_str, METH_VARARGS, "bulk decode str column"},
+    {"decode_columns", decode_columns, METH_VARARGS,
+     "typed column buffers -> list of row tuples"},
+    {"encode_rows", encode_rows, METH_VARARGS,
+     "list of tuples -> per-column typed buffers"},
     {nullptr, nullptr, 0, nullptr}};
 
 static struct PyModuleDef Module = {PyModuleDef_HEAD_INIT, "_tuplex_native",

@@ -48,17 +48,21 @@ def _build_kernel(P: int, w: int, anchored_start: bool, anchored_end: bool,
         def body(j, carry):
             S, matched = carry
             byte_col = bytes_ref[:, j]
-            # class membership via one-hot matmul, not a ref gather: Mosaic
-            # rejects int indexing on VMEM refs ("Cannot do int indexing on
-            # TPU", mosaic/lowering.py _canonicalize_transforms_to_indexer —
-            # caught by tpu_diag/aot_lower_tpu.py), and the [B,256]x[256,Pp]
-            # product is MXU work anyway.
-            b32 = byte_col.astype(jnp.int32)
-            onehot = (b32[:, None] ==
-                      jnp.arange(256, dtype=jnp.int32)[None, :]
-                      ).astype(jnp.float32)                # [B, 256]
-            cm = jnp.dot(onehot, class_ref[...],
-                         preferred_element_type=jnp.float32)  # [B, Pp]
+            if interpret:
+                # gather is legal (and far cheaper) off-Mosaic
+                cm = class_ref[byte_col, :]                   # [B, Pp]
+            else:
+                # class membership via one-hot matmul, not a ref gather:
+                # Mosaic rejects int indexing on VMEM refs ("Cannot do int
+                # indexing on TPU", mosaic/lowering.py — caught by
+                # tpu_diag/aot_lower_tpu.py), and the [B,256]x[256,Pp]
+                # product is MXU work anyway.
+                b32 = byte_col.astype(jnp.int32)
+                onehot = (b32[:, None] ==
+                          jnp.arange(256, dtype=jnp.int32)[None, :]
+                          ).astype(jnp.float32)               # [B, 256]
+                cm = jnp.dot(onehot, class_ref[...],
+                             preferred_element_type=jnp.float32)  # [B, Pp]
             nxt = jnp.dot(S, follow,
                           preferred_element_type=jnp.float32) > 0.5
             if anchored_start:

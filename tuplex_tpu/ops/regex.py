@@ -276,13 +276,14 @@ class CompiledRegex:
                                        st.spec)[:, 0]
                 if st.retreat_from >= 0:
                     # retreat into the greedy run: last lit occurrence
+                    # (single max-reduce; hit == last >= 0 spares the any())
                     start = greedy_state[st.retreat_from]
                     lit = st.spec[-1][1]
                     window = (positions >=
                               (start + st.retreat_min)[:, None]) & \
                         (positions < pos[:, None]) & (bytes_ == lit)
-                    hit = window.any(axis=1)
                     last = jnp.max(jnp.where(window, positions, -1), axis=1)
+                    hit = last >= 0
                     use = alive & ~ok & hit
                     # group ends recorded at the greedy end move back too
                     for g in st.retreat_groups:

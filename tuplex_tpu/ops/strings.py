@@ -320,8 +320,14 @@ def _pad_common(a_bytes, b_bytes):
 
 
 def equals(a_bytes, a_lens, b_bytes, b_lens):
-    a, b = _pad_common(a_bytes, b_bytes)
-    same = jnp.all(a == b, axis=1)  # zero padding ⇒ tails equal iff lens equal
+    # zero-tail invariant (bytes beyond lens are 0): equal lens + equal
+    # bytes over the NARROWER width decide it — a string longer than the
+    # narrow side's width fails the length check, and in-width tails are
+    # zero on both sides. Comparing x == "-" then reads [N, 1], not the
+    # [N, W] the wide side would force.
+    w = min(a_bytes.shape[1], b_bytes.shape[1])
+    a, b = _pad_common(a_bytes[:, :w], b_bytes[:, :w])
+    same = jnp.all(a == b, axis=1)
     return same & (a_lens == b_lens)
 
 
@@ -350,6 +356,28 @@ def compare_lt(a_bytes, a_lens, b_bytes, b_lens, or_equal: bool = False):
 # parse / format
 # ---------------------------------------------------------------------------
 
+# post-strip width cap for numeric parses: i64 needs <= 20 chars, every
+# practically-occurring float literal <= 26; longer rows route (fail-safe)
+_PARSE_WIN = 32
+
+
+def _narrowed_parse(core, bytes_, lens):
+    """Run a numeric parse core on a _PARSE_WIN-wide window. Wide columns
+    (regex-group slices come in at the source width, e.g. [N, 96] on the
+    logs pipeline) waste 3-4x the work in strip + validity/digit masks;
+    measured [N, 96] 57ms -> [N, 32] 15ms for i64 and 196ms -> ~60ms for
+    f64 at N=61k (CPU). Rows longer than the window can still be valid
+    CPython numbers ('0'*40 + '7', float('1'+'0'*40), heavy space padding)
+    — those ROUTE to the interpreter instead of claiming ValueError."""
+    n, w = bytes_.shape
+    if w <= _PARSE_WIN:
+        return core(*strip(bytes_, lens))
+    long_rows = lens > _PARSE_WIN
+    sb, sl = strip(bytes_[:, :_PARSE_WIN], jnp.minimum(lens, _PARSE_WIN))
+    val, bad, route = core(sb, sl)
+    return (val, bad & ~long_rows, route | long_rows)
+
+
 def parse_i64(bytes_, lens):
     """int(s) semantics: optional surrounding spaces, optional sign, digits.
     Returns (val int64 [N], bad bool [N], route bool [N]): `bad` rows are
@@ -357,7 +385,10 @@ def parse_i64(bytes_, lens):
     valid Python ints that don't fit i64 (arbitrary precision territory) and
     must resolve on the interpreter — conflating them would report
     ValueError where CPython succeeds (advisor finding, round 1)."""
-    sb, sl = strip(bytes_, lens)
+    return _narrowed_parse(_parse_i64_core, bytes_, lens)
+
+
+def _parse_i64_core(sb, sl):
     n, w = sb.shape
     pos = jnp.arange(w, dtype=jnp.int32)[None, :]
     inside = pos < sl[:, None]
@@ -422,7 +453,10 @@ def parse_f64(bytes_, lens):
     EXACT CPython ValueErrors; `route` rows are inf/infinity/nan literals
     (CPython accepts them, this kernel doesn't evaluate them) and must
     resolve on the interpreter."""
-    sb, sl = strip(bytes_, lens)
+    return _narrowed_parse(_parse_f64_core, bytes_, lens)
+
+
+def _parse_f64_core(sb, sl):
     n, w = sb.shape
     pos = jnp.arange(w, dtype=jnp.int32)[None, :]
     inside = pos < sl[:, None]

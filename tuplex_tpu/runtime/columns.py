@@ -836,21 +836,64 @@ def decode_rows(part: Partition, indices) -> "list[Row]":
     return rows
 
 
+def _decode_columns_native(part: Partition, n: int) -> Optional[list]:
+    """One-pass C decode of a flat-primitive partition into row tuples
+    (reference analog: PythonDataSet.cc:1400-1442 resultSetToCPython's
+    per-type bulk decoders). None when the schema has nested/object
+    columns or the native module is unavailable."""
+    from ..native import get as native_get
+
+    nat = native_get()
+    if nat is None or not hasattr(nat, "decode_columns"):
+        return None
+    codes = {T.I64: 0, T.F64: 1, T.BOOL: 2, T.STR: 3}
+    spec = []
+    for ci, t in enumerate(part.schema.types):
+        base = t.without_option() if t.is_optional() else t
+        code = codes.get(base)
+        leaf = part.leaves.get(str(ci))
+        if code is None or leaf is None:
+            return None
+        valid = None
+        if getattr(leaf, "valid", None) is not None:
+            valid = np.ascontiguousarray(
+                np.asarray(leaf.valid[:n]).astype(np.uint8, copy=False))
+        if code == 3:
+            if not isinstance(leaf, StrLeaf):
+                return None
+            mat = np.ascontiguousarray(np.asarray(leaf.bytes[:n]))
+            w = mat.shape[1] if mat.ndim == 2 else 1
+            lens = np.ascontiguousarray(
+                np.asarray(leaf.lengths[:n]).astype(np.int32, copy=False))
+            spec.append((3, mat, valid, lens, w))
+        else:
+            if not isinstance(leaf, NumericLeaf):
+                return None
+            data = np.asarray(leaf.data[:n])
+            want = {0: np.int64, 1: np.float64, 2: np.uint8}[code]
+            data = np.ascontiguousarray(data.astype(want, copy=False))
+            spec.append((code, data, valid))
+    return nat.decode_columns(spec, n)
+
+
 def partition_to_pylist(part: Partition) -> list:
     """Bulk row decode (reference analog: PythonDataSet.cc fast decoders —
     bulk converters instead of per-row boxing)."""
     n = part.num_rows
     if n == 0:
         return []  # empty partitions may carry no leaf arrays at all
-    cols = []
-    for ci, ct in enumerate(part.schema.types):
-        cols.append(_column_pylist(part, str(ci), ct, n))
-    single = len(cols) == 1
-    out: list = []
-    if single:
-        out = list(cols[0])
+    single = len(part.schema.types) == 1
+    out_fast = _decode_columns_native(part, n)
+    if out_fast is not None:
+        out = out_fast
     else:
-        out = list(zip(*cols))
+        cols = []
+        for ci, ct in enumerate(part.schema.types):
+            cols.append(_column_pylist(part, str(ci), ct, n))
+        if single:
+            out = list(cols[0])
+        else:
+            out = list(zip(*cols))
     if part.fallback:
         for i, v in part.fallback.items():
             # Row.from_value semantics: single-field tuples collect bare
@@ -915,6 +958,9 @@ def _fast_partition(values: Sequence[Any], schema: T.RowType,
     k = len(kinds)
     multi = k > 1
 
+    if multi and hasattr(nat, "encode_rows"):
+        return _fast_partition_rows(nat, values, schema, kinds, start_index)
+
     # split rows into per-column python lists (C-speed zip for clean rows)
     bad_rows: set[int] = set()
     if multi:
@@ -942,29 +988,66 @@ def _fast_partition(values: Sequence[Any], schema: T.RowType,
         col = cols[ci]
         if kind == "str":
             mat_b, lens_b, valid_b, w, bad = nat.encode_str(col)
-            mat = np.frombuffer(mat_b, dtype=np.uint8).reshape(n, w).copy() \
-                if n else np.zeros((0, max(w, 1)), np.uint8)
-            lens = np.frombuffer(lens_b, dtype=np.int32).copy()
-            valid = np.frombuffer(valid_b, dtype=np.uint8).astype(np.bool_)
-            leaves[str(ci)] = StrLeaf(mat, lens,
-                                      valid.copy() if opt else None)
+            enc = (mat_b, lens_b, valid_b, w)
         else:
-            enc = {"i64": nat.encode_i64, "f64": nat.encode_f64,
-                   "bool": nat.encode_bool}[kind]
-            data_b, valid_b, bad = enc(col)
-            dtype = {"i64": np.int64, "f64": np.float64,
-                     "bool": np.uint8}[kind]
-            data = np.frombuffer(data_b, dtype=dtype).copy()
-            if kind == "bool":
-                data = data.astype(np.bool_)
-            valid = np.frombuffer(valid_b, dtype=np.uint8).astype(np.bool_)
-            leaves[str(ci)] = NumericLeaf(data,
-                                          valid.copy() if opt else None)
+            encode = {"i64": nat.encode_i64, "f64": nat.encode_f64,
+                      "bool": nat.encode_bool}[kind]
+            data_b, valid_b, bad = encode(col)
+            enc = (data_b, valid_b)
+        leaves[str(ci)], valid = _leaf_from_encoded(kind, opt, enc, n)
         bad_rows.update(bad)
         if not opt:
             # None in a non-Option column deviates from the normal case
             bad_rows.update(np.nonzero(~valid)[0].tolist())
+    return _partition_with_fallback(schema, n, leaves, start_index,
+                                    bad_rows, values)
 
+
+def _fast_partition_rows(nat, values: Sequence[Any], schema: T.RowType,
+                         kinds, start_index: int) -> Partition:
+    """Mixed-tuple bulk encode: ONE C pass over the row tuples builds every
+    column buffer (reference analog: PythonContext.cc:860
+    fastMixedSimpleTypeTupleTransfer), replacing the python-side transpose +
+    per-column encoders. Non-conforming rows (arity/type/overflow) come back
+    in bad_list and box into the fallback dict."""
+    n = len(values)
+    codes = {"i64": 0, "f64": 1, "bool": 2, "str": 3}
+    cols_enc, bad = nat.encode_rows(list(values),
+                                    [codes[kd] for kd, _ in kinds])
+    bad_rows: set[int] = set(bad)
+    leaves: dict[str, Leaf] = {}
+    for ci, (kind, opt) in enumerate(kinds):
+        leaves[str(ci)], valid = _leaf_from_encoded(kind, opt,
+                                                    cols_enc[ci], n)
+        if not opt:
+            # None in a non-Option column deviates from the normal case
+            bad_rows.update(np.nonzero(~valid)[0].tolist())
+    return _partition_with_fallback(schema, n, leaves, start_index,
+                                    bad_rows, values)
+
+
+def _leaf_from_encoded(kind: str, opt: bool, enc: tuple, n: int):
+    """C-encoder buffers -> Leaf + full validity array (shared by the
+    per-column and mixed-tuple encode paths)."""
+    if kind == "str":
+        mat_b, lens_b, valid_b, w = enc
+        mat = np.frombuffer(mat_b, dtype=np.uint8).reshape(n, w).copy() \
+            if n else np.zeros((0, max(w, 1)), np.uint8)
+        lens = np.frombuffer(lens_b, dtype=np.int32).copy()
+        valid = np.frombuffer(valid_b, dtype=np.uint8).astype(np.bool_)
+        return StrLeaf(mat, lens, valid.copy() if opt else None), valid
+    data_b, valid_b = enc
+    dtype = {"i64": np.int64, "f64": np.float64, "bool": np.uint8}[kind]
+    data = np.frombuffer(data_b, dtype=dtype).copy()
+    if kind == "bool":
+        data = data.astype(np.bool_)
+    valid = np.frombuffer(valid_b, dtype=np.uint8).astype(np.bool_)
+    return NumericLeaf(data, valid.copy() if opt else None), valid
+
+
+def _partition_with_fallback(schema: T.RowType, n: int, leaves: dict,
+                             start_index: int, bad_rows: set,
+                             values: Sequence[Any]) -> Partition:
     part = Partition(schema=schema, num_rows=n, leaves=leaves,
                      start_index=start_index)
     if bad_rows:
