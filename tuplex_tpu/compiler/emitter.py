@@ -950,14 +950,53 @@ class Frame:
         sb, sl = s.sbytes, s.slen
         if rx is None:
             # unanchored / alternation patterns: exact EXISTENCE via the
-            # bit-parallel NFA (ops/nfa.py). No capture groups — .group()
-            # raises NotCompilable and the whole UDF interprets.
+            # bit-parallel NFA (ops/nfa.py).
             from ..ops.nfa import compile_nfa
 
             nfa = compile_nfa(pattern)
-            matched = nfa.match(sb, sl)
-            return CV(t=T.option(T.tuple_of(T.STR)), elts=(),
-                      valid=matched, kind="match")
+            # two-pass capture groups (reference codegens re.search
+            # generally, FunctionRegistry.h:184-205): the NFA's min-plus
+            # scan finds python's leftmost match START (its boolean is the
+            # same exact existence answer, so one scan serves both), then
+            # the anchored engine re-runs at that offset for the greedy
+            # group spans. The second pass is LAZY — a UDF that only uses
+            # the match as a boolean never pays the anchored engine or its
+            # fallback routing.
+            rx2 = None
+            if not nfa.anchored_start and not nfa.nullable \
+                    and nfa.n_pos <= nfa._START_MAX_POS:
+                try:
+                    rx2 = compile_regex("^" + pattern)
+                except NotCompilable:
+                    rx2 = None
+            if rx2 is None:
+                # boolean-only: exact existence via the bit-parallel
+                # engine; .group() raises NotCompilable and the whole UDF
+                # interprets
+                return CV(t=T.option(T.tuple_of(T.STR)), elts=(),
+                          valid=nfa.match(sb, sl), kind="match")
+            matched, start = nfa.match_start(sb, sl)
+            cell: list = []
+
+            def _two_pass():
+                if not cell:
+                    shb, shl = S.slice_(sb, sl, start, sl)
+                    am, suspect, gs, ge = rx2.match(shb, shl)
+                    elts = []
+                    for g in range(rx2.n_groups + 1):
+                        bb, bl = S.slice_(shb, shl, gs[g], ge[g])
+                        elts.append(CV(t=T.STR, sbytes=bb, slen=bl))
+                    # fail-safe: the anchored engine's single-retreat
+                    # backtracking may fall short at the found offset —
+                    # those rows interpret (raised by the consumer)
+                    cell.append((tuple(elts),
+                                 matched & (suspect | ~am)))
+                return cell[0]
+
+            return CV(t=T.option(T.tuple_of(*[T.STR] *
+                                            (rx2.n_groups + 1))),
+                      elts=(), valid=matched, kind="match",
+                      names=("#lazy_groups", _two_pass))
         matched, suspect, gs, ge = rx.match(sb, sl)
         self.raise_where(suspect & ~matched, ExceptionCode.PYTHON_FALLBACK)
         elts = []
@@ -981,15 +1020,66 @@ class Frame:
             raise NotCompilable("re.sub dynamic replacement")
         if "\\" in repl.const:
             raise NotCompilable("re.sub backreference replacement")
-        table = _class_run_table(pat.const)
-        if table is None:
-            raise NotCompilable("re.sub pattern beyond class-run subset")
         if s.valid is not None:
             self.raise_where(~s.valid, ExceptionCode.TYPEERROR)
         s = materialize(s, self.ctx.b)
         rb, rl = self._to_strpair(s)
         self._ascii_guard(rb, rl)
-        fb, fl = S.replace_class_runs(rb, rl, table, repl.const)
+        table = _class_run_table(pat.const)
+        if table is not None:
+            fb, fl = S.replace_class_runs(rb, rl, table, repl.const)
+            return CV(t=T.STR, sbytes=fb, slen=fl)
+        return self._re_sub_general(pat.const, repl.const, rb, rl)
+
+    _RE_SUB_MAX_MATCHES = 8
+
+    def _re_sub_general(self, pattern: str, new: str, rb, rl) -> CV:
+        """General multi-element re.sub (VERDICT r4 #5; reference codegens
+        re.sub generally, FunctionRegistry.h:184-205): python's scan loop —
+        find leftmost match, replace, continue at its end — vectorized as a
+        bounded unroll. Each round the NFA min-plus scan locates the next
+        match start on the remaining suffix, the anchored engine supplies
+        the greedy end, and splice_spans assembles the output in one pass.
+        Rows with more than _RE_SUB_MAX_MATCHES matches (or needing deeper
+        backtracking) route to the interpreter — fail-safe, never wrong."""
+        from ..ops.nfa import compile_nfa
+        from ..ops.regex import compile_regex
+
+        nfa = compile_nfa(pattern)
+        if nfa.anchored_start:
+            # ^/\A patterns replace at most the one leftmost match; the
+            # suffix-restart loop would wrongly re-anchor every round
+            raise NotCompilable("re.sub of anchored pattern")
+        if nfa.nullable or not 0 < nfa.n_pos <= nfa._START_MAX_POS:
+            raise NotCompilable("re.sub pattern outside compiled bounds")
+        rx2 = compile_regex("^" + pattern)   # may raise NotCompilable
+        b = self.ctx.b
+        zero = jnp.zeros(b, dtype=rl.dtype)
+        o = zero
+        active = jnp.ones(b, dtype=bool)
+        suspect = jnp.zeros(b, dtype=bool)
+        starts, ends, valids = [], [], []
+        for _ in range(self._RE_SUB_MAX_MATCHES):
+            sufb, sufl = S.slice_(rb, rl, o, rl)
+            mk, st_rel = nfa.match_start(sufb, sufl)
+            mk = mk & active
+            shb, shl = S.slice_(sufb, sufl, st_rel, sufl)
+            am, susp, gs, ge = rx2.match(shb, shl)
+            suspect = suspect | (mk & (susp | ~am))
+            st_abs = o + st_rel
+            en_abs = st_abs + ge[0]
+            starts.append(jnp.where(mk, st_abs, 0).astype(jnp.int32))
+            ends.append(jnp.where(mk, en_abs, 0).astype(jnp.int32))
+            valids.append(mk)
+            o = jnp.where(mk, en_abs, o)
+            active = mk
+        sufb, sufl = S.slice_(rb, rl, o, rl)
+        suspect = suspect | (nfa.match(sufb, sufl) & active)
+        self.raise_where(suspect, ExceptionCode.PYTHON_FALLBACK)
+        fb, fl = S.splice_spans(rb, rl,
+                                jnp.stack(starts, axis=1),
+                                jnp.stack(ends, axis=1),
+                                jnp.stack(valids, axis=1), new)
         return CV(t=T.STR, sbytes=fb, slen=fl)
 
     _SPLIT_INDEX_CAP = 32
@@ -1043,11 +1133,17 @@ class Frame:
             idx = args[0].const
         else:
             raise NotCompilable("match.group with non-constant index")
-        if not 0 <= idx < len(m.elts):
+        elts = m.elts
+        if not elts and m.names and m.names[0] == "#lazy_groups":
+            # unanchored two-pass: the anchored engine runs only here,
+            # where groups are actually consumed (+ its fail-safe routing)
+            elts, suspect = m.names[1]()
+            self.raise_where(suspect, ExceptionCode.PYTHON_FALLBACK)
+        if not 0 <= idx < len(elts):
             raise NotCompilable(f"no such regex group {idx}")
         # match is None -> .group raises AttributeError (python semantics)
         self.raise_where(~m.valid, ExceptionCode.ATTRIBUTEERROR)
-        return m.elts[idx]
+        return elts[idx]
 
     def eval_JoinedStr(self, node: ast.JoinedStr) -> CV:
         parts: list[CV] = []

@@ -177,7 +177,9 @@ class NFARegex:
         # also hit MAX_REPEAT
         if terms and str(terms[0][0]) == "AT":
             name = str(terms[0][1])
-            if name.endswith("AT_BEGINNING"):
+            # \A (AT_BEGINNING_STRING) == ^ without MULTILINE (flags are
+            # rejected above)
+            if "AT_BEGINNING" in name:
                 self.anchored_start = True
                 terms = terms[1:]
             else:
@@ -306,6 +308,67 @@ class NFARegex:
         (S, matched), _ = lax.scan(
             step, (jnp.zeros((n, P), dtype=jnp.float32), matched0), xs)
         return matched
+
+    _START_MAX_POS = 32   # [N, P, P] broadcast cap for start tracking
+
+    def match_start(self, bytes_, lens):
+        """(matched [N] bool, start [N] int32): the LEFTMOST match start —
+        exactly python re.search's scan order (min over all accepting
+        threads' seed positions). Min-plus formulation of the Glushkov
+        transition: state is [N, P] int32 where the value is the earliest
+        seed position reaching that NFA position (INF = inactive):
+
+            S'[p] = min( min_{q: q->p} S[q],  j if p in FIRST )  if byte in
+                    class(p) else INF
+            best  = min(best, min_{p in LAST} S'[p])   (subject to '$')
+
+        Powers the two-pass unanchored capture-group path (the anchored
+        engine re-runs at the found offset — emitter._re_search) and the
+        general re.sub loop. Nullable patterns (zero-width match) are not
+        representable in a consuming scan — NotCompilable, caller falls
+        back. Reference parity target: FunctionRegistry.h:184-205 codegens
+        general re.search/re.sub."""
+        if self.nullable:
+            raise NotCompilable("start tracking over nullable pattern")
+        P = self.n_pos
+        if P == 0 or P > self._START_MAX_POS:
+            raise NotCompilable("pattern outside start-tracking bounds")
+        n, w = bytes_.shape
+        INF = jnp.int32(1 << 29)   # INF+INF stays inside int32
+        follow, classtab, firstv, lastv = self._dense_tables
+        cost = jnp.asarray(
+            np.where(follow > 0.5, 0, 1 << 29).astype(np.int32))
+        cmtab = jnp.asarray(classtab > 0.5)
+        first_b = jnp.asarray(firstv > 0.5)
+        last_b = jnp.asarray(lastv > 0.5)
+        lens64, end_at = self._end_masks(bytes_, lens, w)
+        xs = (jnp.transpose(bytes_).astype(jnp.int32),
+              jnp.arange(w, dtype=jnp.int64))
+
+        def step(carry, x):
+            S, best = carry
+            byte_col, j = x
+            cm = jnp.take(cmtab, byte_col, axis=0)            # [N, P]
+            nxt = jnp.min(S[:, :, None] + cost[None, :, :], axis=1)
+            if self.anchored_start:
+                seed = jnp.where(first_b & (j == 0),
+                                 jnp.int32(0), INF)
+            else:
+                seed = jnp.where(first_b, j.astype(jnp.int32), INF)
+            S2 = jnp.minimum(nxt, seed[None, :])
+            inb = (j < lens64)[:, None]
+            S2 = jnp.where(cm & inb, S2, INF)
+            hit = jnp.min(jnp.where(last_b[None, :], S2, INF), axis=1)
+            if self.anchored_end:
+                at_end = (j + 1 == lens64) | (j + 1 == end_at)
+                hit = jnp.where(at_end, hit, INF)
+            return (S2, jnp.minimum(best, hit)), None
+
+        (S, best), _ = lax.scan(
+            step, (jnp.full((n, P), INF, jnp.int32),
+                   jnp.full((n,), INF, jnp.int32)), xs)
+        matched = best < (1 << 29)
+        return matched, jnp.where(matched, best, 0).astype(jnp.int32)
 
     def match_bitmask(self, bytes_, lens):
         n, w = bytes_.shape

@@ -885,6 +885,54 @@ def format_f64(vals, prec: int):
     return ob, ol, suspect
 
 
+def splice_spans(bytes_, lens, starts, ends, valid, new: str):
+    """Delete the (ordered, non-overlapping) spans [starts[:,k], ends[:,k])
+    and insert `new` at each — the output assembler for general re.sub
+    (emitter._re_sub's NFA match loop finds the spans; reference:
+    FunctionRegistry re.sub codegen). starts/ends are [N, K] int32, valid
+    [N, K] bool; invalid spans are ignored. Returns (out_bytes, out_lens)
+    at width W + K*max(len(new)-1, 0)."""
+    n, w = bytes_.shape
+    k = starts.shape[1] if starts.ndim == 2 else 0
+    nb = const_bytes(new)
+    r = len(new.encode("utf-8"))
+    wout = w + k * max(r - 1, 0)
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    starts = jnp.where(valid, starts, jnp.int32(w + 1))
+    ends = jnp.where(valid, ends, jnp.int32(w + 1))
+    span_len = jnp.maximum(ends - starts, 0)
+    inside = jnp.zeros((n, w), dtype=bool)
+    removed_before = jnp.zeros((n, w), dtype=jnp.int32)
+    spans_before = jnp.zeros((n, w), dtype=jnp.int32)
+    for j in range(k):
+        st = starts[:, j][:, None]
+        en = ends[:, j][:, None]
+        inside = inside | ((pos >= st) & (pos < en))
+        past = en <= pos
+        removed_before = removed_before + jnp.where(
+            past, (en - st)[:, 0][:, None], 0)
+        spans_before = spans_before + past.astype(jnp.int32)
+    keep = (pos < lens[:, None]) & ~inside
+    out_pos = pos - removed_before + r * spans_before
+    flat = jnp.where(keep, jnp.arange(n, dtype=jnp.int32)[:, None] * wout +
+                     out_pos, n * wout)
+    out = jnp.zeros(n * wout + 1, dtype=bytes_.dtype).at[
+        flat.reshape(-1)].set(bytes_.reshape(-1), mode="drop")
+    # replacement copies: span j inserts at st_j - removed(st_j) + r*j
+    cum_removed = jnp.cumsum(span_len, axis=1) - span_len   # removed before j
+    rows = jnp.arange(n, dtype=jnp.int32)
+    for j in range(k):
+        base = starts[:, j] - cum_removed[:, j] + r * j
+        ok = valid[:, j]
+        for rr in range(r):
+            idx = jnp.where(ok, rows * wout + base + rr, n * wout)
+            out = out.at[idx].set(nb[rr], mode="drop")
+    total_removed = jnp.sum(jnp.where(valid, span_len, 0), axis=1)
+    n_spans = jnp.sum(valid.astype(jnp.int32), axis=1)
+    out_lens = lens - total_removed + r * n_spans
+    return out[:-1].reshape(n, wout), out_lens.astype(lens.dtype)
+
+
 def replace_class_runs(bytes_, lens, table: np.ndarray, new: str):
     """re.sub('[class]+', new, s): each maximal run of class-member bytes
     becomes `new` (reference: FunctionRegistry re.sub codegen; the common
