@@ -1,0 +1,68 @@
+"""Worker process for the real-multi-process jax.distributed test
+(tests/test_multiprocess.py). NOT a pytest module.
+
+Each process: init jax.distributed against a localhost coordinator, build a
+multihost Context over the GLOBAL mesh (2 procs x 2 virtual CPU devices),
+run the pipelines SPMD, and dump collected results to a pickle for the
+parent to compare against the single-process reference (reference analog:
+AWSLambdaBackend correctness is only provable against real AWS,
+AWSLambdaBackend.cc:254-330 — here the control plane is jax.distributed
+and it IS locally testable).
+"""
+import os
+import pickle
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    data_csv = sys.argv[4]
+    out_path = sys.argv[5]
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")   # post-import: beats the
+    # force-registered axon plugin (see tests/conftest.py)
+    import tuplex_tpu
+    from tuplex_tpu.exec.multihost import init_multihost
+    from tuplex_tpu.models import nyc311
+
+    init_multihost(f"localhost:{port}", nproc, pid)
+    assert jax.process_count() == nproc
+
+    ctx = tuplex_tpu.Context({
+        "tuplex.backend": "multihost",
+        "tuplex.scratchDir": f"{out_path}.scratch{pid}",
+    })
+
+    results = {}
+    results["nyc311"] = nyc311.build_pipeline(ctx, data_csv).collect()
+
+    # psum-combined aggregate over DCN
+    data = [(float(i % 50) / 100, float(i % 7)) for i in range(4096)]
+    results["agg"] = (ctx.parallelize(data, columns=["disc", "price"])
+                      .filter(lambda x: x["disc"] > 0.05)
+                      .aggregate(lambda a, b: a + b,
+                                 lambda a, x: a + x["price"] * x["disc"],
+                                 0.0)
+                      .collect())
+
+    # mesh broadcast join (build replicated, probe row-sharded)
+    left = ctx.parallelize([(i % 37, i) for i in range(2048)],
+                           columns=["k", "v"])
+    right = ctx.parallelize([(i, i * 10) for i in range(30)],
+                            columns=["k", "w"])
+    results["join"] = sorted(left.join(right, "k", "k").collect())
+
+    with open(f"{out_path}.p{pid}", "wb") as fp:
+        pickle.dump(results, fp)
+    print(f"[p{pid}] OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

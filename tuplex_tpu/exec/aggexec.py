@@ -354,7 +354,7 @@ class AggregateExecutor:
             lambda: CC.sharded_fold_fn(eval_exprs, spec.reducers, mesh,
                                        arrays))
         outs = run(arrays)
-        ok_np = np.asarray(outs[-1])[: part.num_rows] & _real_mask(part)
+        ok_np = M.materialize_np(outs[-1])[: part.num_rows] & _real_mask(part)
         partials = [o.item() for o in outs[:-1]]
         bad = np.nonzero(~ok_np & _real_mask(part))[0].tolist()
         bad += [i for i in part.fallback if i not in bad]
@@ -449,8 +449,8 @@ class AggregateExecutor:
             lambda: CC.sharded_segment_fold_fn(
                 eval_exprs, spec.reducers, nseg, mesh, arrays))
         outs = run(arrays, codes_b)
-        ok_np = np.asarray(outs[-1])[:n] & real
-        counts = np.asarray(outs[-2])[:nseg]
+        ok_np = M.materialize_np(outs[-1])[:n] & real
+        counts = M.materialize_np(outs[-2])[:nseg]
         seg_partials = [np.asarray(o)[:nseg] for o in outs[:-2]]
         for si, row_i in enumerate(uniq_rows):
             if counts[si] == 0:

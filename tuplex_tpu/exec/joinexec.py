@@ -667,8 +667,9 @@ class _DeviceProbe(_VectorBuild):
         return self
 
     def _match_positions(self, sig: np.ndarray):
-        from ..runtime.jaxcfg import jax
         import numpy as _np
+
+        from ..parallel import mesh as _mesh
 
         u = len(self.uniq_view)
         words = _pack_sig_words(sig)
@@ -683,14 +684,15 @@ class _DeviceProbe(_VectorBuild):
             ("joinprobe", u, self._nw, id(self._mesh)),
             lambda: _build_probe_fn(u, self._nw, self._mesh))
         pos, matched = fn(words, self._build_words)
-        pos = _np.asarray(jax.device_get(pos))[:n]
-        matched = _np.asarray(jax.device_get(matched))[:n]
+        pos = _mesh.materialize_np(pos)[:n]
+        matched = _mesh.materialize_np(matched)[:n]
         return pos, matched
 
     def _gather(self, part: C.Partition, idx: np.ndarray, valid_rows=None
                 ) -> Optional[dict]:
-        from ..runtime.jaxcfg import jax
         import numpy as _np
+
+        from ..parallel import mesh as _mesh
 
         m = len(idx)
         if m == 0:
@@ -715,7 +717,7 @@ class _DeviceProbe(_VectorBuild):
             outs = fn(arrays, {}, idx_p, idx_p, hm)
         else:
             outs = fn({}, arrays, idx_p, idx_p, hm)
-        outs = jax.device_get(outs)
+        outs = {k: _mesh.materialize_np(v) for k, v in outs.items()}
         # rebuild leaves, sliced back to the true match count
         gathered: dict[str, C.Leaf] = {}
         for path, leaf in part.leaves.items():

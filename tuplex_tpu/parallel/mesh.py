@@ -38,18 +38,81 @@ def row_sharding(mesh, axis: str = DATA_AXIS):
 def shard_stage_fn(raw_fn, mesh, axis: str = DATA_AXIS):
     """jit a stage function with every leading-dim array row-sharded over the
     mesh. Row-wise stage bodies partition trivially (XLA inserts no
-    collectives); reduction stages contain their own psums."""
+    collectives); reduction stages contain their own psums.
+
+    Single-process (CI's virtual mesh, a single-host TPU slice): inputs
+    device_put inside the jit. Multi-process (jax.distributed / DCN): each
+    process stages ONLY ITS ROW RANGE of the batch
+    (make_array_from_process_local_data — host-sharded staging, so H2D is
+    1/P per host), and outputs are constrained to replicated so every
+    process can materialize results host-side (np.asarray on a
+    fully-replicated array is local)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     shard = NamedSharding(mesh, P(axis))
     repl = NamedSharding(mesh, P())     # 0-d scalars (e.g. '#seed'): replicate
+    nproc = jax.process_count()
 
-    def sharded(arrays):
-        placed = {k: jax.device_put(v, shard if v.ndim else repl)
-                  for k, v in arrays.items()}
-        return raw_fn(placed)
+    if nproc == 1:
+        def sharded(arrays):
+            placed = {k: jax.device_put(v, shard if v.ndim else repl)
+                      for k, v in arrays.items()}
+            return raw_fn(placed)
 
-    return jax.jit(sharded)
+        return jax.jit(sharded)
+
+    def replicated_out(arrays):
+        out = raw_fn(arrays)
+        return jax.tree.map(
+            lambda o: jax.lax.with_sharding_constraint(o, repl), out)
+
+    jfn = jax.jit(replicated_out)
+    pid = jax.process_index()
+
+    def local_row_range(shape):
+        """This process's contiguous row range under `shard` — derived from
+        the sharding's own index map, NOT a uniform b/nproc split (devices
+        need not spread evenly across processes, e.g. a 3-device mesh over
+        2 hosts)."""
+        los, his = [], []
+        for d, idx in shard.devices_indices_map(shape).items():
+            if d.process_index != pid:
+                continue
+            sl = idx[0]
+            los.append(0 if sl.start is None else sl.start)
+            his.append(shape[0] if sl.stop is None else sl.stop)
+        if not los:
+            return 0, 0     # no addressable mesh device on this process
+        return min(los), max(his)
+
+    def dispatch(arrays):
+        placed = {}
+        for k, v in arrays.items():
+            if np.ndim(v) == 0:
+                placed[k] = jax.device_put(v, repl)
+                continue
+            v = np.asarray(v)
+            lo, hi = local_row_range(v.shape)
+            placed[k] = jax.make_array_from_process_local_data(
+                shard, np.ascontiguousarray(v[lo:hi]), v.shape)
+        return jfn(placed)
+
+    return dispatch
+
+
+def materialize_np(x) -> np.ndarray:
+    """Host-materialize a mesh output. Single-process (or replicated /
+    fully-addressable) arrays convert directly; under jax.distributed a
+    row-sharded output spans other processes' devices, so gather it
+    (process_allgather over DCN) first."""
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    if not hasattr(x, "sharding") or x.is_fully_replicated \
+            or x.is_fully_addressable:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
 
 
 def pad_batch_for_mesh(arrays: dict, n_devices: int) -> dict:
