@@ -239,7 +239,7 @@ def test_multihost_non_pow2_mesh():
     c = tuplex_tpu.Context({"tuplex.backend": "multihost",
                             "tuplex.tpu.meshShape": "6"})
     assert c.backend.n_devices == 6
-    data = list(range(1000))
+    data = list(range(4000))
     got = c.parallelize(data).map(lambda x: x * 2).filter(
         lambda x: x % 3 == 0).collect()
     assert got == [x * 2 for x in data if (x * 2) % 3 == 0]
@@ -682,3 +682,45 @@ def test_logs_strip_pipeline_on_mesh(tmp_path):
     c = tuplex_tpu.Context({"tuplex.backend": "multihost"})
     got = logs.build_pipeline(c.text(path), "strip").collect()
     assert got == want
+
+
+def test_elastic_partial_mesh_degrade(monkeypatch):
+    """VERDICT r3 #10: a lost device must step down to the SURVIVING mesh
+    (here 8 -> 5 devices), not straight to one device. Failure injected by
+    poisoning the primary stage fn; survivors stubbed to a 5-device set."""
+    import tuplex_tpu
+    from tuplex_tpu.exec.multihost import MultiHostBackend
+
+    # tiny partitions -> multiple dispatches (the elastic ladder only arms
+    # after the fn has executed once; a FIRST-call failure is a trace
+    # failure and routes to the interpreter by design)
+    c = tuplex_tpu.Context({"tuplex.backend": "multihost",
+                            "tuplex.partitionSize": "16KB"})
+    be = c.backend
+    assert isinstance(be, MultiHostBackend) and be.n_devices >= 4
+
+    orig_build = type(be)._build_stage_fn
+    calls = {"n": 0}
+
+    def poisoned_build(self, stage, in_schema, skey, use_comp):
+        real_fn, uc = orig_build(self, stage, in_schema, skey, use_comp)
+
+        def flaky(arrays):
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                raise RuntimeError("injected device loss")
+            return real_fn(arrays)
+
+        return flaky, uc
+
+    monkeypatch.setattr(type(be), "_build_stage_fn", poisoned_build)
+    monkeypatch.setattr(
+        MultiHostBackend, "_surviving_devices",
+        lambda self: list(self.mesh.devices.flat)[:5])
+
+    data = list(range(4000))
+    got = c.parallelize(data).map(lambda x: x * 3 + 1).collect()
+    assert got == [x * 3 + 1 for x in data]
+    actions = [f.get("action") for f in be.failure_log]
+    assert "elastic-mesh" in actions, actions
+    assert be.n_devices == 5

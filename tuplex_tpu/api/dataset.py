@@ -298,12 +298,17 @@ class DataSet:
                             getattr(backend, "supports_sink_pushdown",
                                     False):
                         kw["sink"] = output_sink
-                    result = backend.execute_any(
-                        stage, partitions, self._context,
-                        intermediate=isinstance(
-                            nxt, (TransformStage, AggregateStage))
-                        and not getattr(nxt, "force_interpret", False),
-                        **kw)
+                    recorder.stage_started(stage)
+                    backend.progress_cb = recorder.task_progress
+                    try:
+                        result = backend.execute_any(
+                            stage, partitions, self._context,
+                            intermediate=isinstance(
+                                nxt, (TransformStage, AggregateStage))
+                            and not getattr(nxt, "force_interpret", False),
+                            **kw)
+                    finally:
+                        backend.progress_cb = None
                     partitions = result.partitions
                     all_exceptions.extend(result.exceptions)
                     self._context.metrics.record_stage(result.metrics)

@@ -350,7 +350,7 @@ class AggregateExecutor:
         shapes = tuple(sorted((k, v.shape, str(v.dtype))
                               for k, v in arrays.items()))
         run = self.backend.jit_cache.get_or_build(
-            ("meshfold", op.id, schema.name, shapes),
+            ("meshfold", op.id, schema.name, shapes, id(mesh)),
             lambda: CC.sharded_fold_fn(eval_exprs, spec.reducers, mesh,
                                        arrays))
         outs = run(arrays)

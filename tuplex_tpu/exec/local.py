@@ -119,6 +119,12 @@ class LocalBackend:
         # task-level fault tolerance record (reference analog: the Lambda
         # backend's failure_log, AWSLambdaBackend.cc:410-474)
         self.failure_log: list[dict] = []
+        # live per-partition progress hook (set by the driver around
+        # execute_any; feeds history 'progress' events)
+        self.progress_cb = None
+
+    def fn_cache_salt(self) -> str:
+        return ""   # mesh backends salt per mesh epoch (multihost.py)
 
     def touch_partition(self, part) -> None:
         self.mm.touch(part)
@@ -180,7 +186,8 @@ class LocalBackend:
         first_part = next(parts_it, None)
         device_fn = None
         in_schema = first_part.schema if first_part is not None else None
-        skey = stage.key() + "/" + (in_schema.name if in_schema else "")
+        skey = stage.key() + "/" + (in_schema.name if in_schema else "") \
+            + self.fn_cache_salt()
         use_comp = (self.supports_compaction
                     and self.options.get_bool(
                         "tuplex.tpu.filterCompaction", True)
@@ -321,6 +328,11 @@ class LocalBackend:
                 outp = _truncate_partition(outp, limit - emitted_total)
             emitted_total += outp.num_rows
             out_parts.append(outp)
+            if self.progress_cb is not None:
+                try:    # live history event (webui liveness, VERDICT r3 #9)
+                    self.progress_cb(len(out_parts), emitted_total)
+                except Exception:
+                    pass
 
         def parts_stream():
             if first_part is not None:

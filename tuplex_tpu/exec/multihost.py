@@ -44,14 +44,45 @@ class MultiHostBackend(LocalBackend):
         n = len(jax.devices()) if shape == "auto" else int(shape.split("x")[0])
         self.mesh = M.make_mesh(n)
         self.n_devices = n
+        self._mesh_epoch = 0    # bumped on elastic shrink
+
+    def fn_cache_salt(self) -> str:
+        """Stage-fn cache keys must change when the mesh does — a cached fn
+        closes over the mesh's device set, and a post-shrink fetch of a
+        pre-shrink fn would dispatch onto the dead device forever."""
+        return f"/mesh{self._mesh_epoch}x{self.n_devices}"
+
+    def _surviving_devices(self) -> list:
+        """Probe every mesh device with a tiny put+compute round trip; the
+        survivors define the reduced mesh. (A wedged — as opposed to
+        erroring — device is indistinguishable from a slow one without a
+        deadline; the reference's Lambda analog has the same blind spot and
+        bounds it with request timeouts.)"""
+        import jax
+        import numpy as np
+
+        alive = []
+        for d in self.mesh.devices.flat:
+            try:
+                x = jax.device_put(np.ones(8, dtype=np.float32), d)
+                (x + 1).block_until_ready()
+                alive.append(d)
+            except Exception:
+                continue
+        return alive
 
     def _elastic_stage_fn(self, stage, skey, in_schema):
-        """Elastic degrade: the mesh dispatch failed twice (lost device,
-        wedged collective) — keep the COMPILED path alive on one device
-        instead of dropping all the way to the interpreter (reference
-        analog: AWSLambdaBackend re-invoking failed tasks on new workers;
-        SPMD can't shrink mid-job, so the graceful step down is
-        single-device)."""
+        """Elastic degrade ladder for a twice-failed mesh dispatch (lost
+        device, wedged collective) — reference analog: AWSLambdaBackend
+        re-invokes failed tasks at full remaining concurrency:
+
+        1. REDUCED MESH: rebuild over the devices that still answer a
+           probe and re-shard the same stage over them (padding adapts —
+           any size >= 2 works, not just pow2). Later stages of the job
+           ride the smaller mesh too.
+        2. Single device, plain jit.
+        3. (caller) interpreter.
+        """
         import jax
 
         try:
@@ -60,6 +91,29 @@ class MultiHostBackend(LocalBackend):
                 fused_fold=self.supports_fused_fold)
         except Exception:
             return None
+        alive = self._surviving_devices()
+        if jax.process_count() == 1 and 2 <= len(alive) < self.n_devices:
+            try:
+                new_mesh = M.make_mesh_of(alive)
+                prev_mesh, prev_n = self.mesh, self.n_devices
+                # _jit_stage_fn reads self.mesh/n_devices; commit only
+                # after the fn builds (a failed build must not leave a
+                # shrunk-but-unvalidated mesh or a false log entry)
+                self.mesh, self.n_devices = new_mesh, len(alive)
+                try:
+                    fn = self.jit_cache.get_or_build(
+                        ("elastic-mesh", skey, len(alive)),
+                        lambda: self._jit_stage_fn(raw))
+                except Exception:
+                    self.mesh, self.n_devices = prev_mesh, prev_n
+                    raise
+                self._mesh_epoch += 1   # invalidate mesh-keyed fn caches
+                self.failure_log.append({
+                    "stage": skey[:16], "action": "elastic-mesh",
+                    "devices": len(alive)})
+                return fn
+            except Exception:
+                pass
         return self.jit_cache.get_or_build(
             ("elastic", skey), lambda: jax.jit(raw))
 

@@ -53,6 +53,24 @@ class JobRecorder:
                      # SampleProcessor feeding the webui BEFORE execution)
                      "sample_exception_previews": previews})
 
+    def stage_started(self, stage) -> None:
+        """LIVE event: a stage began executing (reference: the driver posts
+        task/stage updates to the history server DURING the job,
+        HistoryServerConnector.cc:102-198 — not only at completion)."""
+        self._write({"event": "stage_start", "no": self._stage_no + 1,
+                     "kind": type(stage).__name__})
+        self._last_progress = 0.0
+
+    def task_progress(self, parts_done: int, rows: int) -> None:
+        """LIVE event: partition-level progress inside the running stage.
+        Throttled (0.2s) so tight partition loops don't swamp the log."""
+        now = time.time()
+        if now - getattr(self, "_last_progress", 0.0) < 0.2:
+            return
+        self._last_progress = now
+        self._write({"event": "progress", "no": self._stage_no + 1,
+                     "parts": parts_done, "rows": rows})
+
     def stage_done(self, stage, metrics: dict, exceptions: list) -> None:
         self._stage_no += 1
         sample = [(getattr(e, "trace", None) or repr(e))[:800]
@@ -99,13 +117,35 @@ def _render_doc(log_dir: str, live: bool) -> str:
         excs = done.get("exception_counts") or {}
         fast = sum(e["metrics"].get("fast_path_s", 0) for e in stages)
         slow = sum(e["metrics"].get("slow_path_s", 0) for e in stages)
-        rows_html.append(
-            f"<tr><td><code>{html.escape(job_id)}</code></td>"
-            f"<td>{len(stages)}</td>"
-            f"<td>{done.get('rows', '—')}</td>"
-            f"<td>{done.get('wall_s', '—')}</td>"
-            f"<td>{fast:.3f}</td><td>{slow:.3f}</td>"
-            f"<td>{html.escape(json.dumps(excs)) if excs else '—'}</td></tr>")
+        if not done and live:
+            # in-flight job on a LIVE poll: surface the stage_start/
+            # progress events (the reference webui's live task updates).
+            # The static archival report keeps the plain row — a crashed
+            # job must not read as perpetually RUNNING there.
+            start = next((e for e in events if e["event"] == "job_start"),
+                         {})
+            n_stages = len(start.get("stages", [])) or "?"
+            cur = max((e["no"] for e in events
+                       if e["event"] in ("stage_start", "stage")), default=0)
+            prog = next((e for e in reversed(events)
+                         if e["event"] == "progress"), {})
+            status = (f"RUNNING — stage {cur}/{n_stages}, "
+                      f"{prog.get('parts', 0)} partition(s), "
+                      f"{prog.get('rows', 0)} rows so far")
+            rows_html.append(
+                f"<tr class=running><td><code>{html.escape(job_id)}"
+                f"</code></td><td>{len(stages)}</td>"
+                f"<td colspan=4>{html.escape(status)}</td>"
+                f"<td>—</td></tr>")
+        else:
+            rows_html.append(
+                f"<tr><td><code>{html.escape(job_id)}</code></td>"
+                f"<td>{len(stages)}</td>"
+                f"<td>{done.get('rows', '—')}</td>"
+                f"<td>{done.get('wall_s', '—')}</td>"
+                f"<td>{fast:.3f}</td><td>{slow:.3f}</td>"
+                f"<td>{html.escape(json.dumps(excs)) if excs else '—'}"
+                f"</td></tr>")
         for e in stages:
             for s in e.get("exception_sample", []):
                 rows_html.append(
@@ -123,6 +163,7 @@ def _render_doc(log_dir: str, live: bool) -> str:
            border-bottom: 1px solid #ddd; }}
  th {{ background: #f5f5f5; }}
  tr.exc td {{ color: #a33; font-size: 12px; border-bottom: none; }}
+ tr.running td {{ color: #0a6; font-style: italic; }}
  code {{ background: #f0f0f0; padding: 0 .3em; }}
 </style>
 <h1>tuplex_tpu job history</h1>

@@ -29,6 +29,14 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = DATA_AXIS):
     return Mesh(np.array(devs[:n]), (axis,))
 
 
+def make_mesh_of(devices, axis: str = DATA_AXIS):
+    """Mesh over an explicit (surviving) device list — the elastic
+    partial-mesh rebuild path."""
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(list(devices)), (axis,))
+
+
 def row_sharding(mesh, axis: str = DATA_AXIS):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
