@@ -1,0 +1,48 @@
+"""Larger-than-memory end-to-end (VERDICT r3 #8; reference:
+test/core/LargerThanMemoryDataSet.cc — run a real pipeline at a scale that
+exceeds a deliberately tiny executorMemory so partitions spill/respill
+mid-job, and require exact parity plus nonzero swap metrics)."""
+
+import pytest
+
+
+@pytest.mark.slow
+def test_zillow_spills_and_matches(tmp_path):
+    import tuplex_tpu
+    from tuplex_tpu.models import zillow
+
+    path = str(tmp_path / "zillow.csv")
+    n = 40000
+    zillow.generate_csv(path, n, seed=7)
+
+    # ~40k rows of zillow is tens of MB staged; 2MB forces repeated
+    # swap-out/swap-in cycles across the multi-partition job
+    c = tuplex_tpu.Context({"tuplex.executorMemory": "2MB",
+                            "tuplex.partitionSize": "1MB",
+                            "tuplex.scratchDir": str(tmp_path / "scratch")})
+    got = zillow.build_pipeline(c.csv(path)).collect()
+
+    m = c.metrics
+    assert m.swappedBytes() > 0, "no spill happened — raise n or lower mem"
+
+    want = zillow.run_reference_python(path)
+    assert got == want
+
+
+@pytest.mark.slow
+def test_parallelize_spill_respill_cycle(tmp_path):
+    import tuplex_tpu
+
+    c = tuplex_tpu.Context({"tuplex.executorMemory": "1MB",
+                            "tuplex.partitionSize": "256KB",
+                            "tuplex.scratchDir": str(tmp_path / "scratch")})
+    n = 120000
+    data = [(i, f"val_{i % 1000:04d}") for i in range(n)]
+    got = (c.parallelize(data, columns=["k", "s"])
+           .map(lambda x: (x["k"] * 2, x["s"].upper()))
+           .filter(lambda x: x[0] % 3 != 0)
+           .collect())
+    want = [(i * 2, f"VAL_{i % 1000:04d}") for i in range(n)
+            if (i * 2) % 3 != 0]
+    assert got == want
+    assert c.metrics.swappedBytes() > 0

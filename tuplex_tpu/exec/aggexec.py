@@ -350,7 +350,8 @@ class AggregateExecutor:
         shapes = tuple(sorted((k, v.shape, str(v.dtype))
                               for k, v in arrays.items()))
         run = self.backend.jit_cache.get_or_build(
-            ("meshfold", op.id, schema.name, shapes, id(mesh)),
+            ("meshfold", op.id, schema.name, shapes,
+             self.backend.fn_cache_salt()),
             lambda: CC.sharded_fold_fn(eval_exprs, spec.reducers, mesh,
                                        arrays))
         outs = run(arrays)
@@ -445,7 +446,8 @@ class AggregateExecutor:
         shapes = tuple(sorted((k, v.shape, str(v.dtype))
                               for k, v in arrays.items()))
         run = self.backend.jit_cache.get_or_build(
-            ("meshseg", op.id, schema.name, nseg, shapes),
+            ("meshseg", op.id, schema.name, nseg, shapes,
+             self.backend.fn_cache_salt()),
             lambda: CC.sharded_segment_fold_fn(
                 eval_exprs, spec.reducers, nseg, mesh, arrays))
         outs = run(arrays, codes_b)

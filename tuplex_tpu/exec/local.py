@@ -290,11 +290,20 @@ class LocalBackend:
                                     # not vouch for a different fn)
                                     device_fn, use_comp = efn, False
                                     skey = ekey
+                                    # report the rung that actually fired
+                                    # (the reduced-mesh tier logs an
+                                    # 'elastic-mesh' entry; otherwise it
+                                    # was the single-device fallback)
+                                    rung = ("reduced-mesh execution"
+                                            if any(r.get("action") ==
+                                                   "elastic-mesh"
+                                                   for r in
+                                                   self.failure_log[-2:])
+                                            else "single-device execution")
                                     get_logger("exec").warning(
                                         "mesh dispatch failed twice "
-                                        "(%s: %s); stage degraded to "
-                                        "single-device execution",
-                                        type(e2).__name__, e2)
+                                        "(%s: %s); stage degraded to %s",
+                                        type(e2).__name__, e2, rung)
                             except Exception as e3:
                                 self.failure_log.append({
                                     "stage": skey[:16],

@@ -260,6 +260,171 @@ def filter_pushdown(ops: list) -> list:
     return result
 
 
+def push_filters_through_joins(chain: list) -> list:
+    """Push single-side filters ACROSS join boundaries (reference:
+    FilterBreakdownVisitor.cc + LogicalPlan.cc optimizeFilters/
+    emitPartialFilters — key-side predicates move through join build/probe
+    sides so the join materializes fewer rows).
+
+    `chain` is plan_stages' source→sink operator list. A filter downstream
+    of a join pushes when every column it reads traces (through renames /
+    untouched withColumn/mapColumn outputs) to ONE side of the join:
+
+      * LEFT (probe) side — sound for inner AND left joins: the clone runs
+        before the join in the same chain;
+      * RIGHT (build) side — inner joins only (a left join keeps unmatched
+        probe rows, so dropping build rows early changes nulls): the join
+        node is shallow-copied with the clone spliced above its build
+        parent (the user's DAG is never mutated; JoinStage plans the build
+        side recursively from that parent).
+
+    Column names rewrite via AST (x['CarrierName'] -> x['AirlineName'] ->
+    undecorated side name). Resolvers/ignores between filter and join
+    block the push (the filter must see resolved rows). Same
+    exception-semantics caveat as in-stage pushdown, same option gate
+    (tuplex.optimizer.filterPushdown)."""
+    import copy
+
+    from .joins import JoinOperator
+
+    def attached_resolver(i: int) -> bool:
+        nxt = chain[i + 1] if i + 1 < len(chain) else None
+        return isinstance(nxt, (L.ResolveOperator, L.IgnoreOperator))
+
+    changed = True
+    while changed:
+        changed = False
+        for fi, f in enumerate(chain):
+            if not isinstance(f, L.FilterOperator) or attached_resolver(fi):
+                continue
+            reads = udf_read_columns(f.udf)
+            if reads is ALL or not reads:
+                continue
+            # walk upstream translating names until the nearest join
+            mapping = {r: r for r in reads}     # filter name -> name at op k
+            ji = None
+            for k in range(fi - 1, -1, -1):
+                op = chain[k]
+                if isinstance(op, JoinOperator):
+                    ji = k
+                    break
+                if isinstance(op, (L.ResolveOperator, L.IgnoreOperator)):
+                    ji = None
+                    break
+                if isinstance(op, L.FilterOperator):
+                    continue
+                if isinstance(op, L.RenameColumnOperator):
+                    if op.old in mapping.values():
+                        ji = None   # upstream-only name already in use
+                        break
+                    mapping = {r: (op.old if n == op.new else n)
+                               for r, n in mapping.items()}
+                    continue
+                if isinstance(op, (L.WithColumnOperator,
+                                   L.MapColumnOperator)):
+                    if op.column in mapping.values():
+                        ji = None   # reads a column this op writes
+                        break
+                    continue
+                if isinstance(op, L.SelectColumnsOperator):
+                    sel = set(c for c in op.selected if isinstance(c, str))
+                    if any(isinstance(c, int) for c in op.selected) or \
+                            not set(mapping.values()) <= sel:
+                        ji = None
+                        break
+                    continue
+                ji = None           # Map / aggregate / unknown: stop
+                break
+            if ji is None:
+                continue
+            j = chain[ji]
+            side_map = _classify_join_side(j, set(mapping.values()))
+            if side_map is None:
+                continue
+            side, names = side_map
+            if side == "right" and j.how != "inner":
+                continue
+            full_map = {r: names[n] for r, n in mapping.items()}
+            parent = j.parents[0] if side == "left" else j.parents[1]
+            clone = _rename_filter(f, full_map, parent)
+            if clone is None:
+                continue
+            if side == "left":
+                del chain[fi]
+                chain.insert(ji, clone)
+            else:
+                j2 = copy.copy(j)
+                j2.parents = [j.parents[0], clone]
+                chain[ji] = j2
+                del chain[fi]
+            changed = True
+            break
+    return chain
+
+
+def _classify_join_side(j, names: set):
+    """Which join side ALL `names` (join-output columns) come from:
+    ("left"|"right", {output name -> side-local name}) or None if mixed."""
+    ls = j.left.schema()
+    rs = j.right.schema()
+    lk = ls.columns.index(j.left_column)
+    rk = rs.columns.index(j.right_column)
+    left_names = {j._decorate(c, 0): c
+                  for i, c in enumerate(ls.columns) if i != lk}
+    left_names[j.left_column] = j.left_column
+    right_names = {j._decorate(c, 1): c
+                   for i, c in enumerate(rs.columns) if i != rk}
+    # the key column is both sides' key: usable on either
+    right_key_alias = {j.left_column: j.right_column}
+    if names <= set(left_names):
+        return "left", left_names
+    if names <= set(right_names) | set(right_key_alias):
+        return "right", {**right_names, **right_key_alias}
+    return None
+
+
+def _rename_filter(f, mapping: dict, parent):
+    """Clone a filter with its UDF's x['col'] subscripts renamed."""
+    import copy
+
+    from ..utils.reflection import UDFSource
+
+    udf = f.udf
+    if udf.source == "" or len(udf.params) != 1:
+        return None
+    p = udf.params[0]
+    tree = copy.deepcopy(udf.tree)
+
+    class R(ast.NodeTransformer):
+        def visit_Subscript(self, node: ast.Subscript):
+            self.generic_visit(node)
+            if isinstance(node.value, ast.Name) and node.value.id == p and \
+                    isinstance(node.slice, ast.Constant) and \
+                    node.slice.value in mapping:
+                node.slice = ast.Constant(mapping[node.slice.value])
+            return node
+
+    tree = ast.fix_missing_locations(R().visit(tree))
+    try:
+        if isinstance(tree, ast.Lambda):
+            src = ast.unparse(tree)
+            fn = eval(compile(src, f"<join-push-{udf.name}>", "eval"),
+                      dict(udf.globals))
+        elif isinstance(tree, ast.FunctionDef):
+            src = ast.unparse(tree)
+            ns = dict(udf.globals)
+            exec(compile(src, f"<join-push-{udf.name}>", "exec"), ns)
+            fn = ns[tree.name]
+        else:
+            return None
+    except Exception:
+        return None
+    fop = L.FilterOperator(parent, fn)
+    fop.udf = UDFSource(fn, src, tree, dict(udf.globals),
+                        f"{udf.name}#joinpush")
+    return fop
+
+
 def reorder_filters(ops: list) -> list:
     """Operator reordering (reference: LogicalPlan.cc's
     tuplex.optimizer.operatorReordering, off by default there too): order

@@ -563,6 +563,15 @@ def plan_stages(sink: L.LogicalOperator, options=None):
     source = node
     chain.reverse()
 
+    # filter pushdown THROUGH joins (reference: emitPartialFilters pushes
+    # key-side predicates across join boundaries) — on the extracted chain,
+    # before it's cut into stages; the user's DAG is never mutated
+    if options is None or options.get_bool(
+            "tuplex.optimizer.filterPushdown", True):
+        from .optimizer import push_filters_through_joins
+
+        chain = push_filters_through_joins(chain)
+
     stages: list = []
     cur: list[L.LogicalOperator] = []
     cur_source: Optional[L.LogicalOperator] = source
