@@ -1222,3 +1222,139 @@ def test_re_sub_backslash_A_routes():
     got = ctx.parallelize(["abab", "xab", "ab"]).map(
         lambda s: re.sub(r"\Aab", "X", s)).collect()
     assert got == ["Xab", "xab", "X"]
+
+
+# --- dynamic iterators (VERDICT r4 #4; reference: IteratorContextProxy.cc) --
+
+def test_for_over_split_dynamic():
+    def f(s):
+        total = 0
+        for tok in s.split(","):
+            total = total + len(tok)
+        return total
+
+    check(f, ["a,bb,ccc", "", "x", ",,", "one"])
+
+
+def test_for_over_split_parse_sum():
+    def f(s):
+        total = 0
+        for tok in s.split(","):
+            total += int(tok)
+        return total
+
+    check(f, ["1,2,3", "10", "4,5", "1,x", ""])
+
+
+def test_for_enumerate_split():
+    def f(s):
+        out = ""
+        for i, tok in enumerate(s.split(" ")):
+            if i > 0:
+                out = out + "|"
+            out = out + tok
+        return out
+
+    check(f, ["a b c", "x", "", "q w"])
+
+
+def test_for_chars_runtime_string():
+    def f(s):
+        n = 0
+        for ch in s:
+            if ch == "a":
+                n += 1
+        return n
+
+    check(f, ["banana", "", "xyz", "aaa", "no As here"])
+
+
+def test_for_dynamic_break_continue():
+    def f(s):
+        out = 0
+        for tok in s.split(","):
+            if tok == "stop":
+                break
+            if tok == "":
+                continue
+            out += 1
+        return out
+
+    check(f, ["a,b,stop,c", "a,,b", "stop", "", "q,w,e"])
+
+
+def test_for_dynamic_cap_routes():
+    long_s = ",".join(str(i) for i in range(30))
+
+    def f(s):
+        t = 0
+        for tok in s.split(","):
+            t += int(tok)
+        return t
+
+    # 30 pieces exceeds the 16-wide masked unroll: LOOPCAPEXCEEDED routes
+    # that row to the interpreter (check() accepts internal codes)
+    check(f, [long_s, "1,2", "5"])
+
+
+def test_for_ws_split_maxsplit_dynamic():
+    def f(s):
+        parts = 0
+        for tok in s.split(None, 2):
+            parts += len(tok)
+        return parts
+
+    check(f, ["a b  c d", "  ", "x", "one two"])
+
+
+def test_next_with_default():
+    def f(s):
+        it = iter(s.split(","))
+        a = next(it, "")
+        b = next(it, "-")
+        return a + "|" + b
+
+    check(f, ["x,y,z", "solo", ""])
+
+
+def test_next_stopiteration():
+    def f(s):
+        it = iter(s.split(","))
+        a = next(it)
+        b = next(it)
+        return a + b
+
+    check(f, ["x,y", "solo", "a,b,c"])
+
+
+def test_zip_dynamic_static():
+    def f(s):
+        out = ""
+        for a, b in zip(s.split(","), ("A", "B")):
+            out = out + a + b
+        return out
+
+    check(f, ["x,y,z", "q", ""])
+
+
+def test_next_under_branch_routes():
+    import pytest as _pytest
+
+    # review r4: next() under an if-mask advanced the shared cursor for
+    # rows python skips — must refuse to compile (interpreter is exact)
+    def f(s):
+        it = iter(s.split(","))
+        a = next(it, "")
+        if a == "x":
+            b = next(it, "-")
+        else:
+            b = "z"
+        return a + "/" + b + "/" + next(it, "!")
+
+    with _pytest.raises(NotCompilable):
+        run_compiled(f, ["y,p,q"])
+    import tuplex_tpu
+
+    ctx = tuplex_tpu.Context()
+    got = ctx.parallelize(["y,p,q", "x,1,2"]).map(f).collect()
+    assert got == [f(s) for s in ["y,p,q", "x,1,2"]]

@@ -44,6 +44,7 @@ from .values import CV, _MISSING, const_cv, dtype_for, materialize, null_cv, tup
 # UnrollLoopsVisitor.cc caps at compile time too)
 _FOR_UNROLL_CAP = 256
 _WHILE_UNROLL_CAP = 24
+_DYN_ITER_CAP = 16     # masked-unroll width for runtime-length iterables
 
 
 class EmitCtx:
@@ -308,10 +309,29 @@ class Frame:
 
     # -- loops (reference: BlockGeneratorVisitor.cc:5212 NFor, :5608 NWhile,
     # UnrollLoopsVisitor.cc, IteratorContextProxy.cc zip/enumerate) ---------
+    _ITER_BUILTINS = ("range", "zip", "enumerate", "reversed")
+
     def exec_For(self, node: ast.For) -> None:
-        items = self._static_iter_items(node.iter)
+        # evaluate the iterable ONCE (python does; and its error ops —
+        # ascii guards etc. — must not emit twice). Builtin iterator
+        # constructors go through the AST-level paths instead.
+        is_builtin_call = (
+            isinstance(node.iter, ast.Call)
+            and isinstance(node.iter.func, ast.Name)
+            and node.iter.func.id in self._ITER_BUILTINS
+            and node.iter.func.id not in self.env
+            and node.iter.func.id not in self.em.globals)
+        if is_builtin_call:
+            items = self._static_iter_items(node.iter)
+            dyn = None if items is not None else \
+                self._dynamic_iter(node.iter)
+        else:
+            v = self.eval(node.iter)
+            items = self._cv_iter_items(v)
+            dyn = None if items is not None else self._dynamic_iter_cv(v)
         if items is None:
-            raise NotCompilable("for over non-static iterable")
+            self._exec_for_dynamic(node, dyn)
+            return
         lp = {"brk": None, "cont": None, "done": None}
         self.loops.append(lp)
         try:
@@ -322,15 +342,59 @@ class Frame:
             brk = lp["brk"]
         finally:
             self.loops.pop()
-        if node.orelse:
-            # python for-else: runs unless the loop broke
-            outer = self.mask
-            if brk is not None:
-                self.mask = ~brk if outer is None else outer & ~brk
-            try:
-                self.exec_block(node.orelse)
-            finally:
-                self.mask = outer
+        self._for_orelse(node, brk)
+
+    def _for_orelse(self, node: ast.For, brk) -> None:
+        """python for-else: runs unless the loop broke (per row)."""
+        if not node.orelse:
+            return
+        outer = self.mask
+        if brk is not None:
+            self.mask = ~brk if outer is None else outer & ~brk
+        try:
+            self.exec_block(node.orelse)
+        finally:
+            self.mask = outer
+
+    def _exec_for_dynamic(self, node: ast.For, dyn) -> None:
+        """for over a RUNTIME-length iterable — split results, strings, and
+        enumerate/zip of those (reference: IteratorContextProxy.cc codegens
+        iterator state machines; here the masked-unroll scheme of exec_While
+        iterates every row to ITS OWN length). Iteration k deactivates rows
+        with count <= k via the loop's `done` mask, so assignments merge and
+        errors raise only for rows still iterating; rows longer than the
+        unroll width raise LOOPCAPEXCEEDED and resolve exactly on the
+        interpreter."""
+        if dyn is None:
+            raise NotCompilable("for over non-static iterable")
+        count, item_at, bound = dyn
+        # unroll only as wide as the iterable can be: a static bound
+        # (zip with a tuple, maxsplit) beats the blanket cap
+        width = _DYN_ITER_CAP if bound is None else min(bound,
+                                                        _DYN_ITER_CAP)
+        if bound is None or bound > _DYN_ITER_CAP:
+            self.raise_where(count > width, ExceptionCode.LOOPCAPEXCEEDED)
+        # python leaves the loop target unbound when the iterable is empty;
+        # a pre-bound name keeps its value (the masked merge reproduces
+        # that). For unbound targets the empty rows must interpret — a
+        # later read would otherwise see iteration-0 garbage instead of
+        # NameError.
+        names = [t.id for t in ast.walk(node.target)
+                 if isinstance(t, ast.Name)]
+        if any(n not in self.env for n in names):
+            self.raise_where(count == 0, ExceptionCode.PYTHON_FALLBACK)
+        lp = {"brk": None, "cont": None, "done": None, "dyn": True}
+        self.loops.append(lp)
+        try:
+            for k in range(width):
+                lp["done"] = count <= k      # rows whose iteration is over
+                self._assign_target(node.target, item_at(k))
+                self.exec_block(node.body)
+                lp["cont"] = None
+            brk = lp["brk"]
+        finally:
+            self.loops.pop()
+        self._for_orelse(node, brk)
 
     def exec_While(self, node: ast.While) -> None:
         """Bounded unrolling with per-row exit masks: rows whose condition
@@ -338,7 +402,7 @@ class Frame:
         interpreter — semantics stay exact, long-looping rows just go slow
         (reference: TypeAnnotator loop-stability + NWhile codegen)."""
         cap = _WHILE_UNROLL_CAP
-        lp = {"brk": None, "cont": None, "done": None}
+        lp = {"brk": None, "cont": None, "done": None, "dyn": True}
         self.loops.append(lp)
 
         def eval_cond():
@@ -462,6 +526,145 @@ class Frame:
         if v.elts is not None and v.valid is None:
             return list(v.elts)
         return None
+
+    def _dynamic_iter(self, node: ast.expr):
+        """(count [B] int32, item_at(k) -> CV, bound | None) for
+        RUNTIME-length iterables — the dynamic half of iteration
+        (reference: IteratorContextProxy.cc): split results, runtime
+        strings (chars), and enumerate/zip mixing those with static
+        iterables. `bound` is a trace-time upper limit on count when one
+        exists (static zip arm, maxsplit, string width) — the unroll uses
+        it instead of the blanket cap. None when the expression isn't
+        iterable this way."""
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and not node.keywords \
+                and node.func.id not in self.env \
+                and node.func.id not in self.em.globals:
+            fname = node.func.id
+            if fname == "enumerate" and len(node.args) in (1, 2):
+                sub = self._dynamic_iter(node.args[0])
+                if sub is None:
+                    return None
+                start = 0
+                if len(node.args) == 2:
+                    s = self.eval(node.args[1])
+                    if not (s.is_const and isinstance(s.const, int)):
+                        return None
+                    start = s.const
+                cnt, item, bound = sub
+                return (cnt,
+                        lambda k: tuple_cv([const_cv(k + start), item(k)]),
+                        bound)
+            if fname == "zip" and node.args:
+                subs = []      # (None, items) static | (count, item_at) dyn
+                any_dyn = False
+                bound = None
+                for a in node.args:
+                    d = self._dynamic_iter(a)
+                    if d is not None:
+                        subs.append((d[0], d[1]))
+                        if d[2] is not None:
+                            bound = d[2] if bound is None \
+                                else min(bound, d[2])
+                        any_dyn = True
+                        continue
+                    st = self._static_iter_items(a)
+                    if st is None:
+                        return None
+                    subs.append((None, st))
+                    bound = len(st) if bound is None \
+                        else min(bound, len(st))
+                if not any_dyn:
+                    return None
+                cnt = None
+                for c, _ in subs:
+                    if c is None:
+                        continue
+                    cnt = c if cnt is None else jnp.minimum(cnt, c)
+                for c, items in subs:
+                    if c is None:
+                        cnt = jnp.minimum(cnt, len(items))
+
+                def zip_item(k, subs=subs):
+                    parts = []
+                    for c, it in subs:
+                        if c is None:       # static list: clipped index
+                            parts.append(it[min(k, len(it) - 1)]
+                                         if it else const_cv(None))
+                        else:
+                            parts.append(it(k))
+                    return tuple_cv(parts)
+
+                return cnt, zip_item, bound
+        try:
+            v = self.eval(node)
+        except NotCompilable:
+            return None
+        return self._dynamic_iter_cv(v)
+
+    def _dynamic_iter_cv(self, v: CV):
+        """The CV-level half of _dynamic_iter (the iterable is already
+        evaluated — exec_For evaluates it exactly once)."""
+        if v.kind == "split":
+            return self._split_dynamic(v)
+        if v.base is T.STR and not v.is_const and v.sbytes is not None:
+            # char iteration over a runtime string (byte == codepoint only
+            # for ASCII rows; others route via the guard)
+            if v.valid is not None:
+                self.raise_where(~v.valid, ExceptionCode.TYPEERROR)
+            self._ascii_guard(v.sbytes, v.slen)
+            sb, sl = v.sbytes, v.slen
+
+            def char_at(k, sb=sb, sl=sl):
+                kk = jnp.full(self.ctx.b, k, dtype=jnp.int32)
+                bb, bl = S.slice_(sb, sl, kk, kk + 1, out_width=1)
+                return CV(t=T.STR, sbytes=bb, slen=bl)
+
+            return sl.astype(jnp.int32), char_at, int(sb.shape[1])
+        return None
+
+    def _split_dynamic(self, sv: CV):
+        """Piece count + per-piece bounds for a lazy split view, computed
+        ONCE with an unrolled find chain shared by every item_at(k)."""
+        sb, sl = sv.sbytes, sv.slen
+        sep, maxsplit = sv.names
+        bound = None if maxsplit is None else maxsplit + 1
+        if sep is None:
+            cnt = S.ws_token_count(sb, sl).astype(jnp.int32)
+            if maxsplit is not None:
+                cnt = jnp.minimum(cnt, maxsplit + 1)
+
+            def ws_item(k):
+                start, stop, missing = S.ws_token_bounds(sb, sl, k)
+                if maxsplit is not None and k == maxsplit:
+                    stop = jnp.where(missing, stop, sl)
+                bb, bl = S.slice_(sb, sl, start, stop)
+                return CV(t=T.STR, sbytes=bb, slen=bl)
+
+            return cnt, ws_item, bound
+        m = len(sep)
+        cnt = (S.count_const(sb, sl, sep) + 1).astype(jnp.int32)
+        if maxsplit is not None:
+            cnt = jnp.minimum(cnt, maxsplit + 1)
+        chain = _DYN_ITER_CAP if bound is None else min(bound,
+                                                        _DYN_ITER_CAP)
+        starts = [jnp.zeros(self.ctx.b, dtype=jnp.int32)]
+        stops = []
+        for k in range(chain):
+            nxt = S.find_const(sb, sl, sep, start=starts[k])
+            if maxsplit is not None and k == maxsplit:
+                stops.append(sl)
+            else:
+                stops.append(jnp.where(nxt < 0, sl, nxt))
+            starts.append(jnp.where(nxt < 0, sl, nxt + m).astype(jnp.int32))
+
+        def sep_item(k):
+            if k >= len(stops):     # next() beyond the traced find chain
+                raise NotCompilable("iterator past split chain")
+            bb, bl = S.slice_(sb, sl, starts[k], stops[k])
+            return CV(t=T.STR, sbytes=bb, slen=bl)
+
+        return cnt, sep_item, bound
 
     # -- comprehensions (reference: BlockGeneratorVisitor.cc:3278
     # NListComprehension) ---------------------------------------------------
@@ -2245,6 +2448,63 @@ class Frame:
         b = jnp.clip(code, 0, 127).astype(jnp.uint8)[:, None]
         return CV(t=T.STR, sbytes=b, slen=jnp.ones(self.ctx.b,
                                                    dtype=jnp.int32))
+
+    def _builtin_iter(self, args: list[CV]) -> CV:
+        """iter(x) with STATIC consumption: each next() call site advances
+        a trace-time cursor (reference: IteratorContextProxy.cc's iterator
+        state machines; the per-call-site cursor is the vectorized analog
+        for straight-line consumption)."""
+        if len(args) != 1:
+            raise NotCompilable("iter arity")
+        v = args[0]
+        cell = {"pos": 0}
+        items = self._cv_iter_items(v)
+        if items is not None:
+            return CV(t=T.PYOBJECT, kind="iter",
+                      names=("#static", tuple(items), cell))
+        if v.kind == "split":
+            cnt, item_at, _ = self._split_dynamic(v)
+            return CV(t=T.PYOBJECT, kind="iter",
+                      names=("#dyn", (cnt, item_at), cell))
+        raise NotCompilable("iter over unsupported value")
+
+    def _builtin_next(self, args: list[CV]) -> CV:
+        if len(args) not in (1, 2):
+            raise NotCompilable("next arity")
+        it = args[0]
+        if it.kind != "iter":
+            raise NotCompilable("next over non-iterator")
+        # consumption must be uniform across rows: under an if-branch mask,
+        # after a possible early return, or inside a loop with per-row
+        # exit/break masks, the trace-time cursor would advance for rows
+        # python skips (review r4: `if a == 'x': next(it)` silently
+        # misaligned the cursor) -> interpreter
+        if self.mask is not None or self.ret_val is not None:
+            raise NotCompilable("next under row-divergent control flow")
+        if any(lp.get("dyn") or lp["brk"] is not None
+               or lp["cont"] is not None for lp in self.loops):
+            raise NotCompilable("next under row-divergent control flow")
+        tag, src, cell = it.names
+        k = cell["pos"]
+        cell["pos"] = k + 1
+        default = args[1] if len(args) == 2 else None
+        if tag == "#static":
+            if k < len(src):
+                return src[k]
+            if default is None:
+                self.raise_where(jnp.ones(self.ctx.b, dtype=bool),
+                                 ExceptionCode.STOPITERATION)
+                return const_cv(None)
+            return default
+        cnt, item_at = src
+        if k >= _DYN_ITER_CAP:
+            raise NotCompilable("next past dynamic iterator cap")
+        has_k = cnt > k
+        val = item_at(k)
+        if default is None:
+            self.raise_where(~has_k, ExceptionCode.STOPITERATION)
+            return val
+        return merge_cv(self, has_k, val, default)
 
     def _builtin_sorted(self, args: list[CV]) -> CV:
         """sorted() over a static iterable via a compare-exchange network
