@@ -148,6 +148,12 @@ def main() -> None:
     print(json.dumps(_BEST))
 
 
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
 def child() -> None:
     platform = os.environ["TPX_BENCH_PLATFORM"]
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -178,10 +184,12 @@ def child() -> None:
     if not os.path.exists(base_data):
         zillow.generate_csv(base_data, BASELINE_ROWS, seed=42)
 
-    # --- pure-python interpreter baseline (same pipeline, same data gen) ---
-    t0 = time.perf_counter()
-    zillow.run_reference_python(base_data)
-    base_s = time.perf_counter() - t0
+    # --- pure-python interpreter baseline (same pipeline, same data gen).
+    # Best-of-N like the framework side: a single sample is the dominant
+    # noise source in vs_baseline on this 1-core box (r4 observed the same
+    # build swing 0.95-1.22x purely from baseline jitter) ---
+    base_s = min(_timed(lambda: zillow.run_reference_python(base_data))
+                 for _ in range(max(2, RUNS)))
     base_rate = BASELINE_ROWS / base_s
 
     # --- framework, warmup (compile) + timed runs --------------------------
@@ -307,9 +315,7 @@ def _suite(cache_dir: str, platform: str) -> None:
                                   "error": "fast path never ran"}),
                       file=sys.stderr)
                 continue
-            t0 = time.perf_counter()
-            ref()
-            py = time.perf_counter() - t0
+            py = min(_timed(ref) for _ in range(2))  # baseline jitter guard
             print(json.dumps({
                 "suite": name, "rows": n, "platform": platform,
                 "framework_s": round(fw, 3), "python_s": round(py, 3),

@@ -1358,3 +1358,27 @@ def test_next_under_branch_routes():
     ctx = tuplex_tpu.Context()
     got = ctx.parallelize(["y,p,q", "x,1,2"]).map(f).collect()
     assert got == [f(s) for s in ["y,p,q", "x,1,2"]]
+
+
+def test_regex_group_window_wide_source():
+    """r4 _GROUP_WIN: on sources wider than 48 bytes, groups <= 48 chars
+    come through exactly; longer captured groups route (never truncate);
+    boolean-only use never routes for width."""
+    import re
+
+    wide_tail = "x" * 80          # forces source width > 48
+    vals = ["key=abc " + wide_tail, "key=" + "v" * 60 + " " + wide_tail,
+            "nomatch " + wide_tail]
+
+    def f(s):
+        m = re.search(r"^key=(\S+)", s)
+        return "none" if m is None else m.group(1)
+
+    check(f, vals)   # row 1's 60-char group routes; parity via interpreter
+
+    def g(s):
+        return 1 if re.search(r"^key=(\S+)", s) else 0
+
+    # boolean-only: even the 60-char-group row stays on device
+    got = run_compiled(g, vals)
+    assert got == [1, 1, 0], got

@@ -279,3 +279,60 @@ def test_nfa_engines_agree_with_re(impl, monkeypatch):
         assert got == want, (impl, pat,
                              [s for s, g, w in zip(strings, got, want)
                               if g != w])
+
+
+def test_regex_rigid_deaths_are_authoritative():
+    """r4: deaths behind rigid run boundaries (disjoint follower) must NOT
+    route as suspects — malformed logs lines stay on device — while
+    overlapping-follower patterns keep their fail-safe routing."""
+    from tuplex_tpu.ops.regex import CompiledRegex
+
+    rigid = CompiledRegex(r"^(\d+) (\d+) \[(\w+)\]$")
+    assert rigid.first_var == len(rigid.steps)   # fully rigid
+    vals = ["12 34 [ok]", "broken line", "1 2 x", "", "9 9 [a b]"]
+    b, l = enc(vals)
+    matched, suspect, gs, ge = rigid.match(b, l)
+    assert not np.asarray(suspect).any()
+    import re as _re
+
+    want = [bool(_re.search(r"^(\d+) (\d+) \[(\w+)\]$", s))
+            for s in vals]
+    assert np.asarray(matched).tolist() == want
+
+    # logs-shaped pattern: '"' IN \S makes the quoted part soft (retreat),
+    # but rows dying EARLIER (at the [..] section) are still authoritative
+    lg = CompiledRegex(r'^(\S+) (\S+) \[(\w+)\] "(\S+)" (\d+)$')
+    assert 0 < lg.first_var < len(lg.steps)
+    vals2 = ["broken line", "a b nobracket rest", "a b"]
+    b2, l2 = enc(vals2)
+    m2, s2, _, _ = lg.match(b2, l2)
+    assert not np.asarray(m2).any()
+    assert not np.asarray(s2).any()     # early rigid deaths: no routing
+
+    # overlapping follower without retreat support: suspect from the run
+    soft = CompiledRegex(r"^(\w+)x$")
+    assert soft.first_var < len(soft.steps)
+    b2, l2 = enc(["aax", "aaa", "x"])
+    m2, s2, _, _ = soft.match(b2, l2)
+    # 'aaa': \w+ eats all, 'x' fails; backtracking can't help here but the
+    # engine must stay fail-safe (route), never claim an authoritative no
+    assert np.asarray(s2)[1]
+
+
+def test_regex_retreat_failures_still_route():
+    import re as _re
+
+    from tuplex_tpu.ops.regex import CompiledRegex
+
+    rx = CompiledRegex(r"^(\d+)0$")
+    vals = ["100", "90", "99", "0", "10"]
+    b, l = enc(vals)
+    matched, suspect, gs, ge = rx.match(b, l)
+    for i, s in enumerate(vals):
+        pym = _re.search(r"^(\d+)0$", s)
+        if np.asarray(suspect)[i]:
+            continue    # routed: interpreter decides (always correct)
+        assert bool(np.asarray(matched)[i]) == bool(pym), s
+        if pym:
+            g1 = s[np.asarray(gs[1])[i]:np.asarray(ge[1])[i]]
+            assert g1 == pym.group(1), (s, g1)

@@ -1202,12 +1202,43 @@ class Frame:
                       names=("#lazy_groups", _two_pass))
         matched, suspect, gs, ge = rx.match(sb, sl)
         self.raise_where(suspect & ~matched, ExceptionCode.PYTHON_FALLBACK)
-        elts = []
-        for g in range(rx.n_groups + 1):
-            bb, bl = S.slice_(sb, sl, gs[g], ge[g])
-            elts.append(CV(t=T.STR, sbytes=bb, slen=bl))
-        return CV(t=T.option(T.tuple_of(*[T.STR] * (rx.n_groups + 1))),
-                  elts=tuple(elts), valid=matched, kind="match")
+        t_match = T.option(T.tuple_of(*[T.STR] * (rx.n_groups + 1)))
+        win = self._GROUP_WIN
+        if sb.shape[1] <= win:
+            elts = []
+            for g in range(rx.n_groups + 1):
+                bb, bl = S.slice_(sb, sl, gs[g], ge[g])
+                elts.append(CV(t=T.STR, sbytes=bb, slen=bl))
+            return CV(t=t_match, elts=tuple(elts), valid=matched,
+                      kind="match")
+        # wide sources: capture groups slice to _GROUP_WIN instead of the
+        # source width — every downstream pass over a group column
+        # (parses, compares, output buffers, boxing) then reads 48
+        # bytes/row, not W. Rows with a longer group ROUTE in ONE combined
+        # raise (fail-safe, same contract as ops.strings._PARSE_WIN;
+        # per-group raises fragmented statement fusion 4x). Slicing AND
+        # routing are LAZY like the unanchored path: boolean-only
+        # consumers keep every row on device. Group 0 (the whole match)
+        # keeps full width.
+        cell: list = []
+
+        def _groups():
+            if not cell:
+                over = jnp.zeros(self.ctx.b, dtype=bool)
+                for g in range(1, rx.n_groups + 1):
+                    over = over | (ge[g] - gs[g] > win)
+                elts = []
+                for g in range(rx.n_groups + 1):
+                    bb, bl = S.slice_(sb, sl, gs[g], ge[g],
+                                      out_width=win if g else None)
+                    elts.append(CV(t=T.STR, sbytes=bb, slen=bl))
+                cell.append((tuple(elts), matched & over))
+            return cell[0]
+
+        return CV(t=t_match, elts=(), valid=matched, kind="match",
+                  names=("#lazy_groups", _groups))
+
+    _GROUP_WIN = 48
 
     def _re_sub(self, args: list[CV]) -> CV:
         """Compiled re.sub for the class-run subset ('[class]+' / '\\d+' /

@@ -154,6 +154,43 @@ def _byte_in_spec(byte: int, spec: tuple) -> bool:
     return hit != neg
 
 
+def _byte_set(spec: tuple) -> frozenset:
+    return frozenset(c for c in range(256) if _byte_in_spec(c, spec))
+
+
+def _suspect_threshold(steps: list) -> int:
+    """First step index at/after which a death may have unexplored
+    backtracking alternatives (see CompiledRegex.__init__). Walks each
+    variable-length run's reachable followers (skipping groups and min-0
+    disjoint classes) looking for class overlap."""
+    soft = len(steps)
+    for j, stj in enumerate(steps):
+        if stj.kind != "class" or \
+                (stj.max is not None and stj.min == stj.max):
+            continue    # fixed-width or non-run: no alternatives
+        bs = _byte_set(stj.spec)
+        k = j + 1
+        while k < len(steps):
+            sk = steps[k]
+            if sk.kind in ("open", "close"):
+                k += 1
+                continue
+            if sk.kind == "end":
+                break                       # '$' consumes nothing
+            if bs & _byte_set(sk.spec):
+                if sk.retreat_from == j:
+                    # one retreat level is exact; deeper lit occurrences
+                    # are not — deaths from the lit onward are suspect
+                    soft = min(soft, k)
+                else:
+                    soft = min(soft, j)
+                break
+            if sk.min >= 1:
+                break   # must consume a char the run can't supply: rigid
+            k += 1      # min-0 disjoint class can be empty: keep walking
+    return soft
+
+
 def _analyze_retreats(steps: list) -> None:
     """Mark single-char literal steps that can retreat into a preceding
     unbounded greedy class run (see module docstring for the exactness
@@ -223,16 +260,17 @@ class CompiledRegex:
         _analyze_retreats(steps)
         self.steps = steps
         self.n_groups = tree.state.groups - 1
-        # fail-safety: a row that dies at/after the first variable-length
-        # quantifier may have deeper backtracking alternatives our single
-        # retreat doesn't explore. Those rows are SUSPECT and must route to
-        # the interpreter; only pre-ambiguity failures are authoritative
-        # no-matches. Successes always equal python's first (greedy-maximal)
-        # accepted assignment, so they are exact by construction.
-        self.first_var = next(
-            (i for i, s in enumerate(steps)
-             if s.kind == "class" and (s.max is None or s.min != s.max)),
-            len(steps))
+        # fail-safety threshold: a greedy class-run whose reachable
+        # follower is DISJOINT from its class is RIGID — no shorter run can
+        # satisfy the follower (the boundary char stays in the run's
+        # class), so deaths behind it are authoritative no-matches, not
+        # suspects. Only runs with an OVERLAPPING follower admit deeper
+        # backtracking: with single-char lit overlap the retreat explores
+        # exactly python's first alternative (deaths at/after that lit are
+        # suspect); other overlaps are unexplored (suspect from the run
+        # itself). The logs headline's 3% malformed lines die at rigid
+        # boundaries and now stay on device instead of routing (r4).
+        self.first_var = _suspect_threshold(steps)
 
     def match(self, bytes_, lens):
         n, w = bytes_.shape
@@ -250,10 +288,11 @@ class CompiledRegex:
             return jnp.take_along_axis(bytes_, idx[:, None], 1)[:, 0]
 
         def note_deaths(si, before, after):
-            # a death AT the first variable step is deterministic (nothing
-            # variable precedes it): only strictly-later deaths are suspect
+            # deaths BEFORE the suspect threshold are authoritative (every
+            # earlier run is rigid); at/after it, unexplored backtracking
+            # may exist
             nonlocal died_late
-            if si > self.first_var:
+            if si >= self.first_var:
                 died_late = died_late | (before & ~after)
             return after
 
