@@ -1,0 +1,59 @@
+"""Directory-backed fake object store, importable by WORKER processes via
+TUPLEX_VFS_BACKENDS (tests/test_serverless.py drives serverless staging
+through a remote scheme with it). Unlike MemoryObjectStore it survives
+process boundaries — objects live under TUPLEX_DIRSTORE_ROOT."""
+
+import os
+
+from tuplex_tpu.io.vfs import _uri_matches
+
+
+class DirObjectStore:
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, uri: str) -> str:
+        key = uri.split("://", 1)[1]
+        return os.path.join(self.root, key)
+
+    def _uri(self, path: str, scheme: str) -> str:
+        rel = os.path.relpath(path, self.root)
+        return f"{scheme}://{rel}"
+
+    def ls(self, pattern: str):
+        # PRODUCTION glob semantics (vfs._uri_matches): '*' does not cross
+        # '/', non-glob patterns prefix-match — a divergent fake would let
+        # sweep/listing bugs pass the test suite (review r4)
+        scheme = pattern.split("://", 1)[0]
+        out = []
+        for dirpath, _, files in os.walk(self.root):
+            for f in files:
+                uri = self._uri(os.path.join(dirpath, f), scheme)
+                if _uri_matches(uri, pattern):
+                    out.append(uri)
+        return sorted(out)
+
+    def open_read(self, uri: str):
+        p = self._path(uri)
+        if not os.path.exists(p):
+            raise FileNotFoundError(uri)
+        return open(p, "rb")
+
+    def open_write(self, uri: str):
+        p = self._path(uri)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        return open(p, "wb")
+
+    def file_size(self, uri: str) -> int:
+        return os.path.getsize(self._path(uri))
+
+    def rm(self, uri: str) -> None:
+        try:
+            os.unlink(self._path(uri))
+        except FileNotFoundError:
+            pass
+
+
+def make_backend():
+    return DirObjectStore(os.environ["TUPLEX_DIRSTORE_ROOT"])

@@ -47,6 +47,9 @@ from .local import LocalBackend, StageResult
 log = get_logger("tuplex_tpu.serverless")
 
 
+
+from ..io.vfs import join_uri as _djoin  # noqa: E402
+
 class NotShippable(Exception):
     """Stage/UDF cannot be serialized for remote execution (no source, an
     unpicklable captured global, an unknown operator...). The driver falls
@@ -332,6 +335,17 @@ class ServerlessBackend(LocalBackend):
             os.path.join(options.get_str("tuplex.scratchDir",
                                          "/tmp/tuplex_tpu"), "serverless")
         self.scratch = scratch
+        # remote scratch (s3://...): the DATA plane (staged in-parts, task
+        # out-parts) rides the object store; the CONTROL plane (request
+        # pickles, worker logs, responses) stays host-local — the analog
+        # of the Invoke API payload vs S3 in the reference
+        # (AWSLambdaBackend.cc:306-330 + :410-430)
+        from ..io.vfs import is_remote_uri
+
+        self.scratch_remote = is_remote_uri(scratch)
+        self.control_root = os.path.join(
+            options.get_str("tuplex.scratchDir", "/tmp/tuplex_tpu"),
+            "serverless-ctl") if self.scratch_remote else scratch
 
     # -- dispatch ----------------------------------------------------------
     def execute_any(self, stage, partitions, context,
@@ -384,7 +398,7 @@ class ServerlessBackend(LocalBackend):
         per = -(-len(parts) // n_tasks)
         tasks = []
         for t, i in enumerate(range(0, len(parts), per)):
-            indir = os.path.join(run_dir, f"in-{t:04d}")
+            indir = _djoin(run_dir, f"in-{t:04d}")
             write_partitions_tuplex(indir, parts[i: i + per], backend=self)
             tasks.append({"indir": indir})
         return tasks
@@ -398,9 +412,12 @@ class ServerlessBackend(LocalBackend):
 
         t0 = time.perf_counter()
         fl_snap = len(self.failure_log)
-        run_dir = os.path.join(self.scratch, uuid.uuid4().hex[:12])
+        runid = uuid.uuid4().hex[:12]
+        run_dir = os.path.join(self.control_root, runid)
+        data_dir = _djoin(self.scratch, runid) if self.scratch_remote \
+            else run_dir
         os.makedirs(run_dir, exist_ok=True)
-        tasks = self._plan_tasks(stage, spec, partitions, run_dir)
+        tasks = self._plan_tasks(stage, spec, partitions, data_dir)
         if not tasks:
             return StageResult([], [], {"serverless_tasks": 0})
         if sink is not None:
@@ -416,9 +433,11 @@ class ServerlessBackend(LocalBackend):
                 check_interrupted()
                 while pending and len(procs) < self.max_conc:
                     t = pending.pop(0)
-                    procs[t] = (self._launch(run_dir, t, tasks[t], req_base),
+                    procs[t] = (self._launch(run_dir, data_dir, t,
+                                             tasks[t], req_base),
                                 time.perf_counter(), attempts[t])
-                self._reap(procs, done, pending, attempts, tasks, run_dir)
+                self._reap(procs, done, pending, attempts, tasks, run_dir,
+                           data_dir)
                 if procs:
                     time.sleep(0.02)
         finally:
@@ -438,9 +457,20 @@ class ServerlessBackend(LocalBackend):
             import shutil
 
             shutil.rmtree(run_dir, ignore_errors=True)
+            if self.scratch_remote:
+                from ..io.vfs import VirtualFileSystem as VFS
+
+                try:
+                    # PREFIX listing ("dir/"), not a glob: '*' does not
+                    # cross '/' in the object-store backends, so a glob
+                    # would miss every nested key (review r4)
+                    for uri in VFS.ls(data_dir.rstrip("/") + "/"):
+                        VFS.rm(uri)
+                except Exception:
+                    pass    # best-effort (reference leaves S3 scratch too)
         return result
 
-    def _launch(self, run_dir: str, task: int, tspec: dict,
+    def _launch(self, run_dir: str, data_dir: str, task: int, tspec: dict,
                 req_base: dict) -> subprocess.Popen:
         task_dir = os.path.join(run_dir, f"task-{task:04d}")
         os.makedirs(task_dir, exist_ok=True)
@@ -448,7 +478,7 @@ class ServerlessBackend(LocalBackend):
         req["task"] = task
         req["files"] = tspec.get("files")
         req["indir"] = tspec.get("indir")
-        req["outdir"] = os.path.join(task_dir, "out")
+        req["outdir"] = _djoin(_djoin(data_dir, f"task-{task:04d}"), "out")
         req_path = os.path.join(task_dir, "request.pkl")
         with open(req_path, "wb") as fp:
             pickle.dump(req, fp)
@@ -464,7 +494,8 @@ class ServerlessBackend(LocalBackend):
                 [sys.executable, "-m", "tuplex_tpu.exec.worker", req_path],
                 stdout=logf, stderr=subprocess.STDOUT, env=env)
 
-    def _reap(self, procs, done, pending, attempts, tasks, run_dir):
+    def _reap(self, procs, done, pending, attempts, tasks, run_dir,
+              data_dir):
         now = time.perf_counter()
         for t in list(procs):
             p, started, att = procs[t]
@@ -476,7 +507,7 @@ class ServerlessBackend(LocalBackend):
                 else:
                     continue
             del procs[t]
-            outdir = os.path.join(run_dir, f"task-{t:04d}", "out")
+            outdir = _djoin(_djoin(data_dir, f"task-{t:04d}"), "out")
             resp = os.path.join(run_dir, f"task-{t:04d}", "response.pkl")
             if rc == 0 and os.path.exists(resp):
                 done[t] = outdir

@@ -25,14 +25,27 @@ from ..runtime.spill import SpilledPartition, _leaves_to_npz_dict
 _MANIFEST = "tuplex_manifest.pkl"
 
 
+from .vfs import is_remote_uri as _is_remote  # noqa: E402
+from .vfs import join_uri as _join  # noqa: E402
+
+
 def write_partitions_tuplex(path: str, partitions: list,
                             backend=None) -> None:
-    """Atomic overwrite: part files carry a fresh run nonce so an existing
-    manifest stays consistent until the new manifest lands via os.replace
-    (the commit point); stale part files are swept only afterwards."""
+    """Atomic overwrite (local paths): part files carry a fresh run nonce
+    so an existing manifest stays consistent until the new manifest lands
+    via os.replace (the commit point); stale part files are swept only
+    afterwards. Remote schemes (s3://, the serverless scratch staging —
+    reference: S3 upload, AWSLambdaBackend.cc:306-330) go through the VFS
+    backend; object stores have no rename, so the manifest PUT is the
+    commit point there (same last-writer-wins semantics as the
+    reference's S3 output)."""
     import uuid
 
-    os.makedirs(path, exist_ok=True)
+    from .vfs import VirtualFileSystem as VFS
+
+    remote = _is_remote(path)
+    if not remote:
+        os.makedirs(path, exist_ok=True)
     nonce = uuid.uuid4().hex[:8]
     manifest: list[dict] = []
     for i, part in enumerate(partitions):
@@ -42,7 +55,11 @@ def write_partitions_tuplex(path: str, partitions: list,
         arrays = _leaves_to_npz_dict(part)
         obj_leaves = {p: leaf.values for p, leaf in part.leaves.items()
                       if isinstance(leaf, C.ObjectLeaf)}
-        np.savez(os.path.join(path, fname), **arrays)
+        if remote:
+            with VFS.open_write(_join(path, fname)) as fp:
+                np.savez(fp, **arrays)
+        else:
+            np.savez(_join(path, fname), **arrays)
         manifest.append({
             "file": fname,
             "schema": part.schema,
@@ -52,6 +69,14 @@ def write_partitions_tuplex(path: str, partitions: list,
             "fallback": dict(part.fallback),
             "obj_leaves": obj_leaves,
         })
+    if remote:
+        with VFS.open_write(_join(path, _MANIFEST)) as fp:
+            pickle.dump(manifest, fp)
+        keep = {e["file"] for e in manifest} | {_MANIFEST}
+        for uri in VFS.ls(_join(path, "part-*")):
+            if uri.rsplit("/", 1)[-1] not in keep:
+                VFS.rm(uri)
+        return
     tmp = os.path.join(path, f".{_MANIFEST}.{nonce}")
     with open(tmp, "wb") as fp:
         pickle.dump(manifest, fp)
@@ -78,8 +103,19 @@ class TuplexFileSourceOperator(L.LogicalOperator):
     def __init__(self, options, path: str):
         super().__init__([])
         self.path = path
-        with open(os.path.join(path, _MANIFEST), "rb") as fp:
-            self.manifest = pickle.load(fp)
+        if _is_remote(path):
+            from .vfs import VirtualFileSystem as VFS
+
+            try:
+                with VFS.open_read(_join(path, _MANIFEST)) as fp:
+                    self.manifest = pickle.load(fp)
+            except Exception as e:
+                raise TuplexException(
+                    f"not a readable tuplex dataset at {path!r}: "
+                    f"{type(e).__name__}: {e}") from e
+        else:
+            with open(os.path.join(path, _MANIFEST), "rb") as fp:
+                self.manifest = pickle.load(fp)
         if not self.manifest:
             raise TuplexException(f"empty tuplex dataset at {path!r}")
         self._schema = self.manifest[0]["schema"]
@@ -106,18 +142,34 @@ class TuplexFileSourceOperator(L.LogicalOperator):
         return list(self._sample)
 
     def _load(self, entries) -> list[C.Partition]:
+        from ..runtime.spill import load_leaves_npz
+
+        remote = _is_remote(self.path)
         parts = []
         for e in entries:
-            sp = SpilledPartition(
-                os.path.join(self.path, e["file"]),
-                {p: C.ObjectLeaf(v) for p, v in e["obj_leaves"].items()})
             try:
-                leaves = sp.load()
-            except FileNotFoundError:
+                if remote:
+                    from .vfs import VirtualFileSystem as VFS
+
+                    with VFS.open_read(_join(self.path, e["file"])) as fp:
+                        leaves = load_leaves_npz(fp)
+                else:
+                    leaves = load_leaves_npz(
+                        os.path.join(self.path, e["file"]))
+            except Exception as exc:
+                # remote stores raise store-specific classes for missing
+                # objects (botocore ClientError, google NotFound) — wrap
+                # them all in the uniform overwrite diagnosis
+                if not isinstance(exc, (FileNotFoundError, KeyError)) \
+                        and not _is_remote(self.path):
+                    raise
                 raise TuplexException(
                     f"tuplex dataset at {self.path!r} was overwritten "
-                    f"after this reader opened it; reopen with "
+                    f"after this reader opened it (or a part object is "
+                    f"missing: {type(exc).__name__}); reopen with "
                     f"tuplexfile()") from None
+            leaves.update({p: C.ObjectLeaf(v)
+                           for p, v in e["obj_leaves"].items()})
             parts.append(C.Partition(
                 schema=e["schema"], num_rows=e["num_rows"],
                 leaves=leaves, normal_mask=e["normal_mask"],
@@ -134,7 +186,8 @@ class TuplexFileSourceOperator(L.LogicalOperator):
 
 
 def make_tuplex_operator(options, path: str):
-    if not os.path.isdir(path) or not os.path.exists(
-            os.path.join(path, _MANIFEST)):
+    if not _is_remote(path) and (
+            not os.path.isdir(path) or not os.path.exists(
+                os.path.join(path, _MANIFEST))):
         raise TuplexException(f"not a tuplex dataset directory: {path!r}")
     return TuplexFileSourceOperator(options, path)

@@ -56,7 +56,7 @@ class VirtualFileSystem:
     def _remote(cls, scheme: str):
         b = cls._backends.get(scheme)
         if b is None:
-            b = _default_backend(scheme)
+            b = _default_backend(scheme) or _env_backend(scheme)
             if b is None:
                 raise ValueError(
                     f"{scheme}:// needs its cloud SDK (boto3 / "
@@ -315,6 +315,18 @@ def _uri_matches(uri: str, pattern: str) -> bool:
     return _re.fullmatch("".join(out), uri) is not None
 
 
+def is_remote_uri(path: str) -> bool:
+    """True for scheme-dispatched (object-store) paths; file:// is local."""
+    return "://" in path and not path.startswith("file://")
+
+
+def join_uri(base: str, name: str) -> str:
+    """Path join that keeps remote URI schemes intact."""
+    if "://" in base:
+        return f"{base.rstrip('/')}/{name}"
+    return os.path.join(base, name)
+
+
 def _default_backend(scheme: str):
     try:
         if scheme == "s3":
@@ -323,6 +335,35 @@ def _default_backend(scheme: str):
             return GCSBackend()
     except ImportError:
         return None
+    return None
+
+
+def _env_backend(scheme: str):
+    """Backend factory from TUPLEX_VFS_BACKENDS="scheme=module:fn,..." —
+    how detached worker PROCESSES (serverless backend) install custom
+    object stores: register_backend() is process-local, but workers
+    inherit the environment (reference analog: the Lambda handler gets
+    its S3 client from its runtime environment, lambda_main.cc)."""
+    spec = os.environ.get("TUPLEX_VFS_BACKENDS", "")
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry or "=" not in entry:
+            continue
+        sch, target = entry.split("=", 1)
+        if sch.strip() != scheme or ":" not in target:
+            continue
+        mod_name, fn_name = target.rsplit(":", 1)
+        import importlib
+
+        try:
+            return getattr(importlib.import_module(mod_name), fn_name)()
+        except Exception as e:
+            # a CONFIGURED backend that fails to build must fail loudly —
+            # falling through to the "needs its cloud SDK" error buries
+            # the real cause (review r4)
+            raise ValueError(
+                f"TUPLEX_VFS_BACKENDS entry {entry!r} failed to build: "
+                f"{type(e).__name__}: {e}") from e
     return None
 
 

@@ -48,6 +48,35 @@ def _leaves_to_npz_dict(part: C.Partition) -> dict:
     return out
 
 
+def load_leaves_npz(src) -> dict:
+    """npz image (path or open binary file) -> leaf dict; the read half of
+    _leaves_to_npz_dict. Shared by local spill files and the tuplexfile
+    format's remote-scheme reads (io/tuplexfmt.py)."""
+    leaves: dict = {}
+    with np.load(src) as z:
+        names = set(z.files)
+        seen: set = set()
+        for f in names:
+            kind, key, _ = f.split("!", 2)
+            if key in seen:
+                continue
+            path = key.replace("%23", "#")
+            if kind == "n":
+                leaves[path] = C.NumericLeaf(
+                    z[f"n!{key}!data"],
+                    z[f"n!{key}!valid"] if f"n!{key}!valid" in names
+                    else None)
+            elif kind == "s":
+                leaves[path] = C.StrLeaf(
+                    z[f"s!{key}!bytes"], z[f"s!{key}!len"],
+                    z[f"s!{key}!valid"] if f"s!{key}!valid" in names
+                    else None)
+            elif kind == "z":
+                leaves[path] = C.NullLeaf(int(z[f"z!{key}!n"][0]))
+            seen.add(key)
+    return leaves
+
+
 class SpilledPartition:
     """Disk image of a partition's array leaves."""
 
@@ -56,28 +85,7 @@ class SpilledPartition:
         self.obj_leaves = obj_leaves  # ObjectLeafs kept live
 
     def load(self) -> dict:
-        leaves: dict = {}
-        with np.load(self.path) as z:
-            names = set(z.files)
-            seen: set = set()
-            for f in names:
-                kind, key, _ = f.split("!", 2)
-                if key in seen:
-                    continue
-                path = key.replace("%23", "#")
-                if kind == "n":
-                    leaves[path] = C.NumericLeaf(
-                        z[f"n!{key}!data"],
-                        z[f"n!{key}!valid"] if f"n!{key}!valid" in names
-                        else None)
-                elif kind == "s":
-                    leaves[path] = C.StrLeaf(
-                        z[f"s!{key}!bytes"], z[f"s!{key}!len"],
-                        z[f"s!{key}!valid"] if f"s!{key}!valid" in names
-                        else None)
-                elif kind == "z":
-                    leaves[path] = C.NullLeaf(int(z[f"z!{key}!n"][0]))
-                seen.add(key)
+        leaves = load_leaves_npz(self.path)
         leaves.update(self.obj_leaves)
         return leaves
 
