@@ -130,5 +130,7 @@ def test_bulk_transfer_speedup_1m():
           f"({enc_py / enc_fast:.1f}x)")
     print(f"decode 1M rows: native {dec_fast:.3f}s vs python {dec_py:.3f}s "
           f"({dec_py / dec_fast:.1f}x)")
-    assert enc_py / enc_fast > 1.5
-    assert dec_py / dec_fast > 1.5
+    # conservative floors: the real margins are ~50x / ~2x, but CI runs
+    # contended on one core — the gate only guards losing the native path
+    assert enc_py / enc_fast > 3.0
+    assert dec_py / dec_fast > 1.1
