@@ -237,3 +237,56 @@ def test_general_case_tier_string_widening(ctx, tmp_path):
     assert got == want
     # all ~182 violating rows resolved on the compiled general tier
     assert interp_rows["n"] == 0, interp_rows
+
+
+def test_projection_through_aggregate_boundary(tmp_path):
+    """r4: the aggregate breaker's reads (keys + UDF row subscripts) narrow
+    the upstream stage's source projection — dead columns stop being
+    decoded; parity holds on the compiled AND interpreter paths."""
+    import tuplex_tpu
+    from tuplex_tpu.plan.physical import plan_stages
+
+    path = tmp_path / "wide.csv"
+    rows = [(i % 3, f"g{i % 4}", i * 1.5, i * 2.0, f"dead{i}", i)
+            for i in range(400)]
+    with open(path, "w") as fp:
+        fp.write("k1,k2,v1,deadf,deads,v2\n")
+        for r in rows:
+            fp.write(",".join(map(str, r)) + "\n")
+
+    def agg(a, x):
+        return (a[0] + x["v1"], a[1] + x["v2"])
+
+    c = tuplex_tpu.Context()
+    ds = (c.csv(str(path))
+          .filter(lambda x: x["k1"] != 99)
+          .aggregateByKey(lambda a, b: (a[0] + b[0], a[1] + b[1]),
+                          agg, (0.0, 0), ["k2"]))
+    stages = plan_stages(ds._op, c.options_store)
+    st0 = stages[0]
+    assert st0.source_projection is not None
+    assert set(st0.source_projection) == {"k1", "k2", "v1", "v2"}, \
+        st0.source_projection
+    assert "deads" not in (st0.output_columns or ())
+
+    want = {}
+    for k1, k2, v1, deadf, deads, v2 in rows:
+        a = want.get(k2, (0.0, 0))
+        want[k2] = (a[0] + v1, a[1] + v2)
+    got = dict((k, (a, b)) for k, a, b in
+               [(r[0], r[1], r[2]) for r in ds.collect()])
+    assert set(got) == set(want)
+    for k in want:
+        assert abs(got[k][0] - want[k][0]) < 1e-9
+        assert got[k][1] == want[k][1]
+
+    # interpreter path: same plan, forced off-device (exercises the
+    # zero-row/pruned-schema alignment the review flagged)
+    c2 = tuplex_tpu.Context({"tuplex.tpu.interpretOnly": True})
+    ds2 = (c2.csv(str(path))
+           .filter(lambda x: x["k1"] != 99)
+           .aggregateByKey(lambda a, b: (a[0] + b[0], a[1] + b[1]),
+                           agg, (0.0, 0), ["k2"]))
+    got2 = sorted(map(repr, ds2.collect()))
+    got1 = sorted(map(repr, ds.collect()))
+    assert got1 == got2

@@ -28,15 +28,9 @@ def udf_read_columns(udf) -> Optional[set[str]]:
     p = params[0]
     if udf.source == "":
         return ALL
-    reads: set[str] = set()
-    for node in ast.walk(udf.tree):
-        if isinstance(node, ast.Subscript) and \
-                isinstance(node.value, ast.Name) and node.value.id == p:
-            if isinstance(node.slice, ast.Constant) and \
-                    isinstance(node.slice.value, str):
-                reads.add(node.slice.value)
-            else:
-                return ALL
+    reads = _param_subscript_reads(udf.tree, p)
+    if reads is ALL:
+        return ALL
     # any OTHER use of the param leaks the whole row
     for node in ast.walk(udf.tree):
         if isinstance(node, ast.Name) and node.id == p:
@@ -44,6 +38,23 @@ def udf_read_columns(udf) -> Optional[set[str]]:
             pass
     leaks = _param_leaks(udf.tree, p)
     return ALL if leaks else reads
+
+
+def _param_subscript_reads(tree: ast.AST, p: str):
+    """Constant-string subscript reads of param `p` (`p['col']`), or ALL
+    when any subscript of `p` has a non-const-str key. Shared by the
+    single-param (udf_read_columns) and aggregate row-param
+    (agg_required_columns) analyses."""
+    reads: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Subscript) and \
+                isinstance(node.value, ast.Name) and node.value.id == p:
+            if isinstance(node.slice, ast.Constant) and \
+                    isinstance(node.slice.value, str):
+                reads.add(node.slice.value)
+            else:
+                return ALL
+    return reads
 
 
 def _param_leaks(tree: ast.AST, p: str) -> bool:
@@ -94,10 +105,38 @@ def op_reads(op: L.LogicalOperator, current_columns) -> Optional[set[str]]:
     return ALL  # unknown operator: be safe
 
 
+def agg_required_columns(agg_op) -> Optional[set[str]]:
+    """Columns an aggregate breaker reads from its input stage's OUTPUT:
+    key columns + the row-param subscripts of the aggregate UDF (the `x`
+    in `lambda a, x: ...`). None = whole row (unique, leaking UDFs).
+    Feeds projection pushdown across the stage boundary — tpch q1's
+    lineitem tax/shipdate columns stop being decoded/staged."""
+    from . import aggregates as A
+
+    if not isinstance(agg_op, (A.AggregateOperator,
+                               A.AggregateByKeyOperator)):
+        return None
+    udf = agg_op.aggregate_udf
+    if udf.source == "" or len(udf.params) != 2:
+        return None
+    p = udf.params[1]
+    if _param_leaks(udf.tree, p):
+        return None
+    reads = _param_subscript_reads(udf.tree, p)
+    if reads is ALL:
+        return None
+    reads.update(getattr(agg_op, "key_columns", []) or [])
+    return reads
+
+
 def required_source_columns(source_columns: tuple[str, ...],
-                            ops: list[L.LogicalOperator]) -> Optional[list[str]]:
+                            ops: list[L.LogicalOperator],
+                            output_required: Optional[set] = None
+                            ) -> Optional[list[str]]:
     """Minimal subset of source columns the chain needs, in source order;
-    None if the whole row is required somewhere."""
+    None if the whole row is required somewhere. `output_required` narrows
+    the stage-output liveness to the columns a downstream breaker
+    actually consumes (everything, when None)."""
     alias: dict[str, Optional[str]] = {c: c for c in source_columns}
     required: set[str] = set()
     cur_cols: Optional[list[str]] = list(source_columns)
@@ -144,8 +183,15 @@ def required_source_columns(source_columns: tuple[str, ...],
                            else c)
             alias = {c: alias.get(c) for c in sel}
             cur_cols = list(sel)
-    # whatever survives to the stage output is needed
-    required |= {s for s in alias.values() if s}
+    # stage-output liveness: everything that survives — or, when the
+    # downstream breaker declared its reads, just those columns
+    if output_required is None:
+        required |= {s for s in alias.values() if s}
+    else:
+        for name in output_required:
+            src = alias.get(name)
+            if src:
+                required.add(src)
     return [c for c in source_columns if c in required]
 
 

@@ -623,9 +623,18 @@ def plan_stages(sink: L.LogicalOperator, options=None):
             if isinstance(st, TransformStage):
                 st.ops = reorder_filters(st.ops)
     # projection pushdown into file sources (reference: csv.selectionPushdown)
-    for st in stages:
+    for i, st in enumerate(stages):
         if isinstance(st, TransformStage):
-            _apply_projection(st)
+            out_req = None
+            nxt = stages[i + 1] if i + 1 < len(stages) else None
+            if isinstance(nxt, AggregateStage):
+                # the aggregate declares which stage-output columns it
+                # reads (keys + UDF row subscripts): dead columns stop
+                # being parsed/decoded/staged (tpch q1: tax, shipdate)
+                from .optimizer import agg_required_columns
+
+                out_req = agg_required_columns(nxt.op)
+            _apply_projection(st, out_req)
     # segment each transform stage so one non-compilable UDF doesn't sink
     # the whole fused pipeline to the interpreter
     out: list = []
@@ -651,7 +660,7 @@ def plan_stages(sink: L.LogicalOperator, options=None):
     return out
 
 
-def _apply_projection(stage: TransformStage) -> None:
+def _apply_projection(stage: TransformStage, output_required=None) -> None:
     """Prune unread columns at the Arrow read: unread columns are never
     parsed, decoded, or staged to HBM."""
     from ..io.csvsource import CSVSourceOperator
@@ -660,7 +669,8 @@ def _apply_projection(stage: TransformStage) -> None:
     src = stage.source
     if not isinstance(src, CSVSourceOperator):
         return
-    req = required_source_columns(tuple(src.stat.columns), stage.ops)
+    req = required_source_columns(tuple(src.stat.columns), stage.ops,
+                                  output_required)
     if req is None or len(req) >= len(src.stat.columns):
         return
     stage.source_projection = list(req)
@@ -687,8 +697,31 @@ def _apply_projection(stage: TransformStage) -> None:
             new_ops.append(L.SelectColumnsOperator(op.parent, names))
         else:
             new_ops.append(op)
-    stage.ops = new_ops
     stage.input_schema = T.row_of(req, [T.option(T.STR)] * len(req))
+    # RE-LINK the chain through the pruned decode (shallow copies with
+    # cleared schema caches): ops still point at the unpruned DAG, and
+    # consumers key off stage.output_schema/output_columns — a stale
+    # unpruned schema would misalign the aggregate's key indices for
+    # zero-row fallback partitions (review r4). Op ids survive the copy,
+    # so metrics/history attribution is unchanged.
+    import copy as _copy
+
+    relinked = []
+    prev: L.LogicalOperator = src
+    for op in new_ops:
+        if op.parents and op.parent is not prev:
+            op = _copy.copy(op)
+            op.parents = [prev]
+            op._schema_cache = None
+        relinked.append(op)
+        prev = op
+    stage.ops = relinked
+    try:
+        last = relinked[-1] if relinked else src
+        stage.output_schema = last.schema()
+        stage.output_columns = last.columns()
+    except Exception:
+        pass    # schema inference unchanged on failure (pre-existing state)
 
 
 _op_compiles_cache: dict = {}
