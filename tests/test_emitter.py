@@ -1382,3 +1382,58 @@ def test_regex_group_window_wide_source():
     # boolean-only: even the 60-char-group row stays on device
     got = run_compiled(g, vals)
     assert got == [1, 1, 0], got
+
+
+def test_dyn_genexp_reductions():
+    """Reductions over genexps with RUNTIME-length iterables (the last
+    IteratorContextProxy surface): sum/any/all/min/max with filters."""
+    check(lambda s: sum(int(t) for t in s.split(",")),
+          ["1,2,3", "10", "", "4,x"])
+    check(lambda s: sum(len(t) for t in s.split() if t != "skip"),
+          ["a bb skip ccc", "", "skip skip", "one"])
+    check(lambda s: any(t == "hit" for t in s.split(",")),
+          ["a,hit,b", "miss", "", "hit"])
+    check(lambda s: all(len(t) > 1 for t in s.split(",")),
+          ["aa,bb", "aa,b", "", "xyz"])
+    check(lambda s: min(int(t) for t in s.split(",")),
+          ["3,1,2", "7", "9,9", "x,1"])
+    check(lambda s: max(len(t) for t in s.split(" ")),
+          ["a bb ccc", "q", ""])
+    check(lambda s: min(t for t in s.split(",")),   # string min
+          ["b,a,c", "z", "m,m"])
+
+
+def test_dyn_genexp_semantics_guards():
+    import pytest as _pytest
+
+    # one-shot: a generator consumed twice must NOT re-trace (python
+    # exhausts it) — refuse to compile, interpreter is exact
+    def twice(s):
+        g = (int(t) for t in s.split(","))
+        return sum(g) + sum(g)
+
+    with _pytest.raises(NotCompilable):
+        run_compiled(twice, ["1,2,3"])
+    import tuplex_tpu
+
+    ctx = tuplex_tpu.Context()
+    assert ctx.parallelize(["1,2,3"]).map(twice).collect() == [6]
+
+    # helper-frame closure: the genexp's free names bind in the DEFINING
+    # scope, not the consumer's
+    def helper(s):
+        n = 2
+        return (int(t) * n for t in s.split(","))
+
+    def udf(s):
+        n = 10
+        return sum(helper(s)) + n - n
+
+    check(udf, ["1,2", "5"])
+
+    # sum(genexp, '') must reproduce python's TypeError, never concatenate
+    def strsum(s):
+        return sum((t for t in s.split(",")), "")
+
+    with _pytest.raises(NotCompilable):
+        run_compiled(strsum, ["a,b"])

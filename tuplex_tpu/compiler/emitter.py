@@ -368,12 +368,7 @@ class Frame:
         if dyn is None:
             raise NotCompilable("for over non-static iterable")
         count, item_at, bound = dyn
-        # unroll only as wide as the iterable can be: a static bound
-        # (zip with a tuple, maxsplit) beats the blanket cap
-        width = _DYN_ITER_CAP if bound is None else min(bound,
-                                                        _DYN_ITER_CAP)
-        if bound is None or bound > _DYN_ITER_CAP:
-            self.raise_where(count > width, ExceptionCode.LOOPCAPEXCEEDED)
+        width = self._unroll_width(count, bound)
         # python leaves the loop target unbound when the iterable is empty;
         # a pre-bound name keeps its value (the masked merge reproduces
         # that). For unbound targets the empty rows must interpret — a
@@ -682,6 +677,18 @@ class Frame:
             raise NotCompilable("async comprehension")
         items = self._static_iter_items(gen.iter)
         if items is None:
+            if isinstance(node, ast.GeneratorExp):
+                # a genexp over a RUNTIME-length iterable has no static
+                # shape, but the REDUCERS (sum/any/all/min/max) consume it
+                # lazily with masked iteration — hand them the recipe
+                dyn = self._dynamic_iter(gen.iter)
+                if dyn is not None:
+                    # capture the DEFINING env (a helper's genexp must not
+                    # rebind free names to the consumer's locals) and a
+                    # one-shot cell (python generators exhaust)
+                    return CV(t=T.PYOBJECT, kind="dyngen",
+                              names=(node, dyn, dict(self.env),
+                                     {"consumed": False}))
             raise NotCompilable("comprehension over non-static iterable")
         saved = dict(self.env)
         outs: list[CV] = []
@@ -2559,17 +2566,79 @@ class Frame:
                 vals[j + 1] = merge_cv(self, lt, a, b)
         return tuple_cv(vals, kind="list")
 
+    def _unroll_width(self, count, bound) -> int:
+        """Masked-unroll width for a runtime-length iterable: the static
+        bound when one exists, else the cap — rows iterating past it raise
+        LOOPCAPEXCEEDED and resolve exactly on the interpreter. Shared by
+        dynamic for-loops and genexp reductions."""
+        width = _DYN_ITER_CAP if bound is None else min(bound,
+                                                        _DYN_ITER_CAP)
+        if bound is None or bound > _DYN_ITER_CAP:
+            self.raise_where(count > width, ExceptionCode.LOOPCAPEXCEEDED)
+        return width
+
+    def _dyn_genexp_steps(self, v: CV):
+        """Iterate a dyngen CV (lazy genexp over a runtime-length iterable,
+        _comprehension): yields (value CV, active-mask) per unrolled step,
+        with loop masks arranged so element-expression errors raise only
+        for rows still iterating AND passing the filters (reference:
+        IteratorContextProxy-driven reductions). Element expressions
+        evaluate under the genexp's DEFINING env; a second consumption
+        refuses to compile (python generators exhaust — re-tracing would
+        double-count)."""
+        node, (count, item_at, bound), def_env, cell = v.names
+        if cell["consumed"]:
+            raise NotCompilable("generator consumed twice")
+        cell["consumed"] = True
+        gen = node.generators[0]
+        width = self._unroll_width(count, bound)
+        saved = self.env
+        self.env = dict(def_env)
+        lp = {"brk": None, "cont": None, "done": None, "dyn": True}
+        self.loops.append(lp)
+        steps = []
+        try:
+            for k in range(width):
+                lp["done"] = count <= k
+                lp["cont"] = None
+                self._assign_target(gen.target, item_at(k))
+                mask = count > k
+                for cond_node in gen.ifs:
+                    ctr = self.truthy(self.eval(cond_node))
+                    mask = mask & ctr
+                    # rows failing the filter skip the element expression
+                    # (its errors must not fire for them)
+                    drop = self.active() & ~ctr
+                    lp["cont"] = drop if lp["cont"] is None \
+                        else lp["cont"] | drop
+                val = self.eval(node.elt)
+                steps.append((val, mask))
+        finally:
+            self.loops.pop()
+            self.env = saved
+        return steps
+
     def _builtin_sum(self, args: list[CV]) -> CV:
         if len(args) not in (1, 2):
             raise NotCompilable("sum() arity")
+        start: CV = args[1] if len(args) == 2 else const_cv(0)
+        if start.base is T.STR or (start.is_const
+                                   and isinstance(start.const, str)):
+            # python forbids sum() over strings (TypeError): the
+            # interpreter path reproduces the exact error — applies to the
+            # dyngen branch too (review r4: it silently concatenated)
+            raise NotCompilable("sum() can't sum strings")
+        if args[0].kind == "dyngen":
+            steps = self._dyn_genexp_steps(args[0])
+            acc = start
+            for val, mask in steps:
+                acc = merge_cv(self, mask,
+                               self._binop(ast.Add(), acc, val), acc)
+            return acc
         items = self._cv_iter_items(args[0])
         if items is None:
             raise NotCompilable("sum over non-static iterable")
-        acc: CV = args[1] if len(args) == 2 else const_cv(0)
-        if acc.base is T.STR or (acc.is_const and isinstance(acc.const, str)):
-            # python forbids sum() over strings (TypeError): the interpreter
-            # path reproduces the exact error
-            raise NotCompilable("sum() can't sum strings")
+        acc = start
         for it in items:
             acc = self._binop(ast.Add(), acc, it)
         return acc
@@ -2583,6 +2652,14 @@ class Frame:
     def _any_all(self, args: list[CV], any_mode: bool) -> CV:
         if len(args) != 1:
             raise NotCompilable("any/all arity")
+        if args[0].kind == "dyngen":
+            steps = self._dyn_genexp_steps(args[0])
+            acc = jnp.full(self.ctx.b, not any_mode, dtype=bool)
+            for val, mask in steps:
+                t = self.truthy(val)
+                acc = (acc | (mask & t)) if any_mode \
+                    else (acc & (~mask | t))
+            return CV(t=T.BOOL, data=acc)
         items = self._cv_iter_items(args[0])
         if items is None:
             raise NotCompilable("any/all over non-static iterable")
@@ -2603,6 +2680,27 @@ class Frame:
         return self._minmax(args, jnp.maximum)
 
     def _minmax(self, args: list[CV], fn) -> CV:
+        if len(args) == 1 and args[0].kind == "dyngen":
+            steps = self._dyn_genexp_steps(args[0])
+            want_min = fn is jnp.minimum
+            acc: Optional[CV] = None
+            seen = jnp.zeros(self.ctx.b, dtype=bool)
+            for val, mask in steps:
+                if acc is None:
+                    acc, seen = val, mask
+                    continue
+                res = self._compare(ast.Lt() if want_min else ast.Gt(),
+                                    val, acc)
+                cmp = self.truthy(res) if isinstance(res, CV) else res
+                acc = merge_cv(self, mask & (~seen | cmp), val, acc)
+                seen = seen | mask
+            if acc is None:     # zero-width unroll: every row is empty
+                self.raise_where(jnp.ones(self.ctx.b, dtype=bool),
+                                 ExceptionCode.VALUEERROR)
+                return const_cv(None)
+            # python: min()/max() of an EMPTY iterable raises ValueError
+            self.raise_where(~seen, ExceptionCode.VALUEERROR)
+            return acc
         if len(args) == 1:
             items = self._cv_iter_items(args[0])
             if not items:
