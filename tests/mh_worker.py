@@ -29,11 +29,15 @@ def main() -> None:
     jax.config.update("jax_platforms", "cpu")   # post-import: beats the
     # force-registered axon plugin (see tests/conftest.py)
     import tuplex_tpu
-    from tuplex_tpu.exec.multihost import init_multihost
     from tuplex_tpu.models import nyc311
 
-    init_multihost(f"localhost:{port}", nproc, pid)
-    assert jax.process_count() == nproc
+    os.environ["TUPLEX_COORDINATOR"] = f"localhost:{port}"
+    os.environ["TUPLEX_NUM_PROCESSES"] = str(nproc)
+    os.environ["TUPLEX_PROCESS_ID"] = str(pid)
+    from tuplex_tpu.exec.deploy import init_from_env, preflight
+
+    init_from_env()     # the deploy-helper path (reference: distributed.py)
+    preflight(expected_processes=nproc, expected_devices_per_process=2)
 
     ctx = tuplex_tpu.Context({
         "tuplex.backend": "multihost",

@@ -1,0 +1,120 @@
+"""Multi-host pod bring-up helpers (reference analog:
+python/tuplex/distributed.py:37-123 — the AWS one-time setup that creates
+the IAM role, scratch bucket, and Lambda deployment before the first
+distributed run; here the control plane is jax.distributed, so "setup"
+means wiring N hosts to one coordinator and validating the pod).
+
+On a real TPU pod slice, `jax.distributed.initialize()` auto-detects the
+topology from the TPU metadata — `init_multihost()` with no arguments is
+the whole setup. These helpers cover everything else: CPU/GPU clusters
+(explicit coordinator), launch-plan generation for N hosts, and a
+preflight that catches the classic bring-up mistakes before a job wedges
+in a collective.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger("deploy")
+
+
+def default_coordinator(port: int = 8476) -> str:
+    """Coordinator address for process 0: first non-loopback address of
+    this host (the analog of the reference's default_scratch_dir
+    convenience — a sane default the caller can override)."""
+    host = socket.gethostname()
+    try:
+        addr = socket.gethostbyname(host)
+        if addr.startswith("127."):
+            # hostname resolves to loopback: derive the egress interface
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                s.connect(("10.255.255.255", 1))
+                addr = s.getsockname()[0]
+            finally:
+                s.close()
+    except OSError:
+        addr = "127.0.0.1"
+    return f"{addr}:{port}"
+
+
+def launch_plan(num_hosts: int, coordinator: Optional[str] = None,
+                workdir: str = ".", backend: str = "multihost") -> list[str]:
+    """One shell command per host that brings up the SPMD job — the
+    operator-facing artifact the reference's setup prints for Lambda
+    deployment. Every host runs the SAME driver script; only
+    TUPLEX_PROCESS_ID differs."""
+    coordinator = coordinator or default_coordinator()
+    cmds = []
+    for pid in range(num_hosts):
+        cmds.append(
+            f"cd {workdir} && "
+            f"TUPLEX_COORDINATOR={coordinator} "
+            f"TUPLEX_NUM_PROCESSES={num_hosts} "
+            f"TUPLEX_PROCESS_ID={pid} "
+            f"python -c 'from tuplex_tpu.exec.deploy import init_from_env; "
+            f"init_from_env(); "
+            f"# ... your pipeline (tuplex.backend={backend}) ...'"
+            f"  # host {pid}")
+    return cmds
+
+
+def init_from_env() -> None:
+    """Initialize jax.distributed from TUPLEX_COORDINATOR /
+    TUPLEX_NUM_PROCESSES / TUPLEX_PROCESS_ID (set by launch_plan's
+    commands), or auto-detect when none are set (TPU pod metadata)."""
+    from .multihost import init_multihost
+
+    coord = os.environ.get("TUPLEX_COORDINATOR")
+    if coord is None:
+        init_multihost()        # TPU pod: topology auto-detection
+        return
+    nproc = os.environ.get("TUPLEX_NUM_PROCESSES")
+    pid = os.environ.get("TUPLEX_PROCESS_ID")
+    # partial env is a configuration mistake worth naming precisely — a
+    # raw KeyError would not say which knob is missing
+    if (nproc is None) != (pid is None):
+        raise RuntimeError(
+            "set BOTH TUPLEX_NUM_PROCESSES and TUPLEX_PROCESS_ID with "
+            "TUPLEX_COORDINATOR (or none of the three for pod "
+            "auto-detection)")
+    init_multihost(coord,
+                   None if nproc is None else int(nproc),
+                   None if pid is None else int(pid))
+
+
+def preflight(expected_processes: Optional[int] = None,
+              expected_devices_per_process: Optional[int] = None) -> dict:
+    """Post-init sanity report (raises on the classic bring-up mistakes).
+    Call AFTER init_from_env()/init_multihost() on every host."""
+    import jax
+
+    info = {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+        "platform": jax.devices()[0].platform,
+    }
+    if expected_processes is not None and \
+            info["process_count"] != expected_processes:
+        raise RuntimeError(
+            f"pod has {info['process_count']} processes, expected "
+            f"{expected_processes} — a host failed to join the coordinator")
+    if expected_devices_per_process is not None and \
+            info["local_devices"] != expected_devices_per_process:
+        raise RuntimeError(
+            f"process {info['process_index']} sees "
+            f"{info['local_devices']} local devices, expected "
+            f"{expected_devices_per_process}")
+    if info["global_devices"] != \
+            info["local_devices"] * info["process_count"]:
+        log.warning("uneven device/process split: %d global, %d local x %d "
+                    "processes", info["global_devices"],
+                    info["local_devices"], info["process_count"])
+    return info
