@@ -1,0 +1,17 @@
+#!/bin/bash
+# Standing TPU-liveness watch: probe every 30 min; on success, leave a loud
+# marker file so the round's bench can switch to the chip.
+while true; do
+  ts=$(date -u +%FT%TZ)
+  timeout -s KILL 240 python /root/repo/tpu_diag/probe_basic.py > /tmp/tpu_probe_last.log 2>&1
+  # require an actual TPU device line, not just PROBE_OK: a fast-failing
+  # plugin could fall back to CPU and still complete the probe
+  if grep -q PROBE_OK /tmp/tpu_probe_last.log && \
+     grep -iq "devices:.*tpu" /tmp/tpu_probe_last.log; then
+    echo "$ts PROBE_OK — TUNNEL ALIVE" >> /root/repo/tpu_diag/watch.log
+    cp /tmp/tpu_probe_last.log /root/repo/tpu_diag/probe_SUCCESS.log
+  else
+    echo "$ts wedge (no PROBE_OK)" >> /root/repo/tpu_diag/watch.log
+  fi
+  sleep 1800
+done
