@@ -1,0 +1,36 @@
+"""Worker for examples/06_distributed.py (one per process)."""
+
+import os
+import pickle
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# append-if-absent (a user's --xla_dump_to etc. must survive)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2").strip()
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from tuplex_tpu.exec.deploy import init_from_env, preflight  # noqa: E402
+
+init_from_env()             # TUPLEX_COORDINATOR/... from the environment
+info = preflight(expected_processes=2, expected_devices_per_process=2)
+
+import tuplex_tpu  # noqa: E402
+
+c = tuplex_tpu.Context({"tuplex.backend": "multihost",
+                        "tuplex.scratchDir": os.environ["SCRATCH"]})
+got = sorted(
+    c.parallelize([(i % 5, i) for i in range(2000)], columns=["g", "v"])
+    .filter(lambda x: x["v"] % 2 == 0)
+    .aggregateByKey(lambda a, b: a + b,
+                    lambda a, x: a + x["v"], 0, ["g"])
+    .collect())
+print(f"[process {info['process_index']}/{info['process_count']} on "
+      f"{info['global_devices']} devices] groups: {got}", flush=True)
+with open(os.environ["RESULT"], "wb") as fp:
+    pickle.dump(got, fp)

@@ -93,16 +93,18 @@ def test_decode_columns_parity(monkeypatch):
 
 
 @pytest.mark.slow
-def test_bulk_transfer_speedup_1m():
+def test_bulk_transfer_speedup_at_scale():
     """VERDICT r3 #7: the native mixed-tuple paths must clearly beat the
-    python boxing loop at scale. Numbers print for STATUS; the assert is a
-    conservative floor so timing noise can't flake CI."""
+    python boxing loop at scale. Numbers print for STATUS (the 1M-row
+    measurement there: encode 49-69x, decode ~2x); the test runs 400k so
+    full-suite memory pressure can't page-fault both sides into a
+    compressed ratio (observed twice at 1M under the complete suite)."""
     import time
 
     from tuplex_tpu import native as N
     from tuplex_tpu.runtime import columns as C
 
-    n = 1_000_000
+    n = 400_000
     vals = [(i, f"name_{i % 9973}", i * 0.5, i % 3 == 0) for i in range(n)]
     schema = T.row_of(["a", "b", "c", "d"], [T.I64, T.STR, T.F64, T.BOOL])
 
@@ -126,11 +128,10 @@ def test_bulk_transfer_speedup_1m():
     finally:
         N._mod, N._tried = mod, tried
     assert out_p == out
-    print(f"\nencode 1M rows: native {enc_fast:.3f}s vs python {enc_py:.3f}s "
-          f"({enc_py / enc_fast:.1f}x)")
-    print(f"decode 1M rows: native {dec_fast:.3f}s vs python {dec_py:.3f}s "
-          f"({dec_py / dec_fast:.1f}x)")
-    # conservative floors: the real margins are ~50x / ~2x, but CI runs
-    # contended on one core — the gate only guards losing the native path
-    assert enc_py / enc_fast > 3.0
-    assert dec_py / dec_fast > 1.1
+    print(f"\nencode {n} rows: native {enc_fast:.3f}s vs python "
+          f"{enc_py:.3f}s ({enc_py / enc_fast:.1f}x)")
+    print(f"decode {n} rows: native {dec_fast:.3f}s vs python "
+          f"{dec_py:.3f}s ({dec_py / dec_fast:.1f}x)")
+    # floors guard losing the native path, with headroom for CI contention
+    assert enc_py / enc_fast > 5.0
+    assert dec_py / dec_fast > 1.2
