@@ -47,6 +47,29 @@ def main() -> None:
     results = {}
     results["nyc311"] = nyc311.build_pipeline(ctx, data_csv).collect()
 
+    # host-sharded TEXT reads: each process reads ONLY its byte range of
+    # the log file; the global batch assembles from per-host blocks and
+    # interpreter rows (malformed lines etc.) exchange over DCN
+    from tuplex_tpu.io.vfs import VirtualFileSystem
+    from tuplex_tpu.models import logs as logs_model
+
+    log_txt = data_csv + ".logs.txt"
+    if pid == 0 and not os.path.exists(log_txt):
+        # write-then-rename: the other process's existence barrier must
+        # never observe a partially written file
+        logs_model.generate_log(log_txt + ".tmp", 3000)
+        os.rename(log_txt + ".tmp", log_txt)
+    import time as _t
+    for _ in range(200):
+        if os.path.exists(log_txt):
+            break
+        _t.sleep(0.05)
+    else:
+        raise RuntimeError(f"log file never appeared: {log_txt}")
+    assert VirtualFileSystem.file_size(log_txt) > 0
+    results["logs"] = logs_model.build_pipeline(
+        ctx.text(log_txt), "strip").collect()
+
     # psum-combined aggregate over DCN
     data = [(float(i % 50) / 100, float(i % 7)) for i in range(4096)]
     results["agg"] = (ctx.parallelize(data, columns=["disc", "price"])

@@ -61,9 +61,40 @@ def test_two_process_distributed_parity(tmp_path):
     want_join = sorted((i, i % 37, (i % 37) * 10)
                        for i in range(2048) if i % 37 < 30)
 
+    from tuplex_tpu.models import logs as logs_model
+
+    want_logs = logs_model.run_reference_python(data_csv + ".logs.txt",
+                                                "strip")
     for pid in range(nproc):
         with open(f"{out}.p{pid}", "rb") as fp:
             got = pickle.load(fp)
         assert got["nyc311"] == want_nyc, f"p{pid} nyc311 mismatch"
         assert abs(got["agg"][0] - want_agg) < 1e-6 * max(1.0, abs(want_agg))
         assert got["join"] == want_join, f"p{pid} join mismatch"
+        # host-sharded text reads: identical output on every process, in
+        # file order (merge-in-order across host blocks)
+        assert got["logs"] == want_logs, f"p{pid} logs mismatch"
+
+
+def test_range_reader_exactness(tmp_path):
+    """The byte-range text reader must partition the file EXACTLY: union
+    over hosts == readlines, no duplicates, any split count."""
+    import random
+
+    from tuplex_tpu.parallel.hostio import read_text_lines_range
+
+    rng = random.Random(11)
+    for trial in range(25):
+        lines = ["".join(rng.choice("xyz,. ") for _ in
+                         range(rng.randint(0, 40)))
+                 for _ in range(rng.randint(0, 30))]
+        body = "\n".join(lines) + ("\n" if lines and rng.random() < 0.7
+                                   else "")
+        p = tmp_path / f"t{trial}.txt"
+        p.write_text(body)
+        want = body.splitlines()
+        for nproc in (1, 2, 3, 4, 7):
+            got = []
+            for pid in range(nproc):
+                got.extend(read_text_lines_range(str(p), pid, nproc))
+            assert got == want, (trial, nproc)

@@ -559,7 +559,38 @@ class TextSourceOperator(L.LogicalOperator):
         return [Row((ln,), None)
                 for ln in self._null_map(self._sample_lines)]
 
+    def _host_sharded(self, context) -> bool:
+        """Per-host byte-range reads apply under REAL multi-process SPMD on
+        a single-file source (reference analog: per-worker S3 input ranges,
+        AWSLambdaBackend.cc:410-430). Option-gated; everything else reads
+        whole files."""
+        if len(self.files) != 1 or not context.options_store.get_bool(
+                "tuplex.tpu.hostShardedReads", True):
+            return False
+        from ..exec.multihost import MultiHostBackend
+
+        if not isinstance(context.backend, MultiHostBackend):
+            return False
+        import jax
+
+        return jax.process_count() > 1
+
     def load_partitions(self, context, projection=None) -> list[C.Partition]:
+        if self._host_sharded(context):
+            import jax
+
+            from ..parallel.hostio import allgather_obj, \
+                read_text_lines_range
+
+            pid, nproc = jax.process_index(), jax.process_count()
+            lines = self._null_map(
+                read_text_lines_range(self.files[0], pid, nproc))
+            counts = allgather_obj(len(lines))
+            part = C.build_partition(lines, self._schema,
+                                     start_index=sum(counts[:pid]))
+            part.host_block = {"pid": pid, "nproc": nproc,
+                               "counts": counts}
+            return [part]
         parts = []
         offset = 0
         for f in self.files:

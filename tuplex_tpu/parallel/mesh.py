@@ -108,6 +108,43 @@ def shard_stage_fn(raw_fn, mesh, axis: str = DATA_AXIS):
     return dispatch
 
 
+def hostblock_stage_fn(raw_fn, mesh, block_rows: int, axis: str = DATA_AXIS):
+    """Multi-process dispatch where each process's LOCAL staged batch IS
+    its shard: the global batch is [host0 block | host1 block | ...] with
+    every block `block_rows` slots (tail-padded per host), assembled via
+    make_array_from_process_local_data. block_rows must divide evenly
+    over each process's devices. Outputs replicate (every host
+    materializes the full result). Powers host-sharded reads
+    (parallel/hostio): the data a process stages is only what IT read."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shard = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+    nproc = jax.process_count()
+
+    def replicated_out(arrays):
+        out = raw_fn(arrays)
+        return jax.tree.map(
+            lambda o: jax.lax.with_sharding_constraint(o, repl), out)
+
+    jfn = jax.jit(replicated_out)
+
+    def dispatch(local_arrays):
+        placed = {}
+        for k, v in local_arrays.items():
+            if np.ndim(v) == 0:
+                placed[k] = jax.device_put(v, repl)
+                continue
+            v = np.ascontiguousarray(np.asarray(v))
+            assert v.shape[0] == block_rows, (k, v.shape, block_rows)
+            gshape = (block_rows * nproc,) + v.shape[1:]
+            placed[k] = jax.make_array_from_process_local_data(
+                shard, v, gshape)
+        return jfn(placed)
+
+    return dispatch
+
+
 def materialize_np(x) -> np.ndarray:
     """Host-materialize a mesh output. Single-process (or replicated /
     fully-addressable) arrays convert directly; under jax.distributed a

@@ -467,18 +467,24 @@ def partition_seed(part: Partition):
     return np.uint32((part.start_index * 2654435761 + 97531) & 0xFFFFFFFF)
 
 
-def stage_partition(part: Partition, bucket_mode: str = "q8") -> DeviceBatch:
+def stage_partition(part: Partition, bucket_mode: str = "q8",
+                    force_b: Optional[int] = None,
+                    force_widths: Optional[dict] = None) -> DeviceBatch:
+    """`force_b` / `force_widths` override the data-derived bucket sizes —
+    multi-process host-block staging must agree on GLOBAL shapes across
+    hosts whose local data differs (parallel/hostio)."""
     dv = getattr(part, "device_batch", None)
     if dv is not None:
         # one-shot: drop the partition's reference either way so device
         # memory is released as soon as the consumer's dispatch retires
         # (host leaves stay authoritative for any retry)
         part.device_batch = None
-        if dv.n == part.num_rows \
+        if force_b is None and force_widths is None \
+                and dv.n == part.num_rows \
                 and dv.b == bucket_size(part.num_rows, bucket_mode):
             return dv   # device-resident view from the producing stage
     n = part.num_rows
-    b = bucket_size(n, bucket_mode)
+    b = force_b if force_b is not None else bucket_size(n, bucket_mode)
     arrays: dict[str, np.ndarray] = {}
     for path, leaf in part.leaves.items():
         ks = _leaf_keys(path, leaf)
@@ -487,7 +493,9 @@ def stage_partition(part: Partition, bucket_mode: str = "q8") -> DeviceBatch:
         if isinstance(leaf, NumericLeaf):
             arrays[path] = pad_to(leaf.data, b)
         else:   # StrLeaf
-            wb = bucket_size(max(leaf.width, 1), bucket_mode, minimum=8)
+            wb = None if force_widths is None else force_widths.get(path)
+            if wb is None:
+                wb = bucket_size(max(leaf.width, 1), bucket_mode, minimum=8)
             arrays[path + "#bytes"] = pad_to(pad_to(leaf.bytes, b, 0), wb, 1)
             arrays[path + "#len"] = pad_to(leaf.lengths, b)
         if path + "#valid" in ks:
