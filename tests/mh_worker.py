@@ -46,6 +46,11 @@ def main() -> None:
 
     results = {}
     results["nyc311"] = nyc311.build_pipeline(ctx, data_csv).collect()
+    # record whether the csv source really took the host-sharded path
+    src_op = ctx.csv(data_csv)._op
+    while src_op.parents:
+        src_op = src_op.parent
+    results["nyc311_sharded"] = bool(src_op._host_sharded(ctx))
 
     # host-sharded TEXT reads: each process reads ONLY its byte range of
     # the log file; the global batch assembles from per-host blocks and
@@ -69,6 +74,24 @@ def main() -> None:
     assert VirtualFileSystem.file_size(log_txt) > 0
     results["logs"] = logs_model.build_pipeline(
         ctx.text(log_txt), "strip").collect()
+
+    # quoted CSV: the EXACT quote gate must fall back to whole reads and
+    # still produce correct (quote-aware) results
+    qcsv = data_csv + ".quoted.csv"
+    if pid == 0 and not os.path.exists(qcsv):
+        with open(qcsv + ".tmp", "w") as fp:
+            fp.write("a,b\n")
+            for i in range(500):
+                fp.write(f'"x,{i}",{i}\n')
+        os.rename(qcsv + ".tmp", qcsv)
+    for _ in range(200):
+        if os.path.exists(qcsv):
+            break
+        _t.sleep(0.05)
+    else:
+        raise RuntimeError("quoted csv never appeared")
+    results["quoted"] = ctx.csv(qcsv).map(
+        lambda x: (x["a"], x["b"] * 2)).collect()
 
     # psum-combined aggregate over DCN
     data = [(float(i % 50) / 100, float(i % 7)) for i in range(4096)]

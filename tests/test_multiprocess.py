@@ -69,11 +69,15 @@ def test_two_process_distributed_parity(tmp_path):
         with open(f"{out}.p{pid}", "rb") as fp:
             got = pickle.load(fp)
         assert got["nyc311"] == want_nyc, f"p{pid} nyc311 mismatch"
+        # quote-free generated csv: the sharded-read gate must have fired
+        assert got["nyc311_sharded"] is True
         assert abs(got["agg"][0] - want_agg) < 1e-6 * max(1.0, abs(want_agg))
         assert got["join"] == want_join, f"p{pid} join mismatch"
         # host-sharded text reads: identical output on every process, in
         # file order (merge-in-order across host blocks)
         assert got["logs"] == want_logs, f"p{pid} logs mismatch"
+        # quoted csv fell back to whole reads, quoting intact
+        assert got["quoted"] == [(f"x,{i}", i * 2) for i in range(500)]
 
 
 def test_range_reader_exactness(tmp_path):

@@ -199,6 +199,22 @@ class CSVStatistic:
 # logical operators
 # ---------------------------------------------------------------------------
 
+
+def _host_sharded_gate(files: list, context) -> bool:
+    """Common preconditions for per-host byte-range reads: real
+    multi-process SPMD on the multihost backend, single-file source,
+    option enabled."""
+    if len(files) != 1 or not context.options_store.get_bool(
+            "tuplex.tpu.hostShardedReads", True):
+        return False
+    from ..exec.multihost import MultiHostBackend
+
+    if not isinstance(context.backend, MultiHostBackend):
+        return False
+    import jax
+
+    return jax.process_count() > 1
+
 class CSVSourceOperator(L.LogicalOperator):
     """Raw-cell CSV source: every column is Option[str] (missing cell = None).
 
@@ -241,7 +257,20 @@ class CSVSourceOperator(L.LogicalOperator):
         return out
 
     # -- bulk read ----------------------------------------------------------
+    def _host_sharded(self, context) -> bool:
+        """Per-host byte-range CSV reads under REAL multi-process SPMD
+        (reference splits CSV inputs by byte range the same way,
+        inputSplitSize tasks). Newline alignment is exact only without
+        quoted newlines; _load_host_sharded verifies quote-freeness over
+        the WHOLE file (each host checks its own fragment, verdicts
+        allgather) and falls back to whole reads otherwise."""
+        return _host_sharded_gate(self.files, context)
+
     def load_partitions(self, context, projection=None) -> list[C.Partition]:
+        if self._host_sharded(context):
+            sharded = self._load_host_sharded(context, projection)
+            if sharded is not None:
+                return sharded
         parts: list[C.Partition] = []
         offset = 0
         for path in self.files:
@@ -249,6 +278,86 @@ class CSVSourceOperator(L.LogicalOperator):
                 parts.append(p)
                 offset += p.num_rows
         return parts
+
+    def _load_host_sharded(self, context, projection=None):
+        """ONE host-block partition from this process's byte range of the
+        file (parallel/hostio; executed by
+        MultiHostBackend._execute_hostblock) — or None when the exact
+        quote gate rejects the file (caller falls back to whole reads)."""
+        import pyarrow as pa
+        import pyarrow.csv as pacsv
+
+        import jax
+
+        from ..parallel.hostio import allgather_obj, read_bytes_range
+
+        pid, nproc = jax.process_index(), jax.process_count()
+        stat = self.stat
+        frag = read_bytes_range(self.files[0], pid, nproc)
+        # EXACT quote gate: the fragments cover every byte of the file, so
+        # one allgathered verdict proves quote-freeness globally (a quote
+        # anywhere could hide a quoted newline a byte-range split would
+        # sever — potentially silently, if the severed halves still parse
+        # with k cells). Quoted files re-read whole; rare and correct.
+        qc = (getattr(stat, "quotechar", '"') or '"').encode()
+        if any(allgather_obj(qc in frag)):
+            return None
+        has_header = stat.has_header and pid == 0
+        bad_rows: list[tuple[int, str]] = []
+
+        def on_invalid(row):
+            bad_rows.append((row.number or 0, row.text or ""))
+            return "skip"
+
+        out_columns = list(projection) if projection else stat.columns
+        raw_schema = T.row_of(out_columns,
+                              [T.option(T.STR)] * len(out_columns))
+        proj_idx = [stat.columns.index(c) for c in out_columns]
+        max_w = context.options_store.get_int("tuplex.tpu.maxStrBytes",
+                                              4096)
+        if frag.strip():
+            table = pacsv.read_csv(
+                pa.BufferReader(frag),
+                read_options=pacsv.ReadOptions(
+                    use_threads=True, block_size=1 << 24,
+                    column_names=stat.columns,
+                    skip_rows=1 if has_header else 0,
+                    autogenerate_column_names=False),
+                parse_options=pacsv.ParseOptions(
+                    delimiter=stat.delimiter,
+                    quote_char=getattr(stat, "quotechar", '"'),
+                    invalid_row_handler=on_invalid),
+                convert_options=pacsv.ConvertOptions(
+                    column_types={c: pa.string() for c in stat.columns},
+                    include_columns=list(projection) if projection
+                    else None,
+                    strings_can_be_null=False))
+        else:
+            table = pa.table({c: pa.array([], pa.string())
+                              for c in out_columns})
+        if bad_rows:
+            scanned = _scan_bad_records(
+                self.files[0], stat,
+                text=frag.decode("utf-8", errors="replace"),
+                skip_header=has_header)
+        else:
+            scanned = []
+        if bad_rows and len(scanned) == len(bad_rows):
+            total = table.num_rows + len(scanned)
+            part = next(_spliced_partitions(
+                table, scanned, raw_schema, proj_idx, max_w,
+                max(total, 1), 0))
+        else:
+            part = _table_to_partition(table, raw_schema, max_w, 0)
+            if bad_rows:    # positions unrecoverable: trail them (rare)
+                tail = _bad_rows_partition(bad_rows, stat, proj_idx,
+                                           raw_schema, part.num_rows)
+                vals = C.partition_to_pylist(part) +                     C.partition_to_pylist(tail)
+                part = C.build_partition(vals, raw_schema, start_index=0)
+        counts = allgather_obj(part.num_rows)
+        part.start_index = sum(counts[:pid])
+        part.host_block = {"pid": pid, "nproc": nproc, "counts": counts}
+        return [part]
 
     def iter_partitions(self, context, projection=None):
         """STREAMING read: yield partitions as Arrow record batches arrive,
@@ -397,18 +506,20 @@ def _bad_rows_partition(bad_rows: list, stat: "CSVStatistic",
     return C.build_partition(vals, raw_schema, start_index=start_index)
 
 
-def _scan_bad_records(path: str, stat: "CSVStatistic"
-                      ) -> list[tuple[int, list]]:
+def _scan_bad_records(path: str, stat: "CSVStatistic", text=None,
+                      skip_header=None) -> list[tuple[int, list]]:
     """[(data-row ordinal, cells)] for records whose cell count != k —
     python-csv replica of Arrow's invalid-row criterion, used to recover the
     original positions Arrow doesn't report. Ordinals count ALL non-empty
-    data records (good + bad) in file order, excluding the header."""
+    data records (good + bad) in file order, excluding the header.
+    `text` scans a fragment instead of the file (host-sharded reads)."""
     k = stat.num_columns
     out: list[tuple[int, list]] = []
-    with VirtualFileSystem.open_read(path, "rb") as fp:
-        text = fp.read().decode("utf-8", errors="replace")
+    if text is None:
+        with VirtualFileSystem.open_read(path, "rb") as fp:
+            text = fp.read().decode("utf-8", errors="replace")
     ordinal = 0
-    skip_header = stat.has_header
+    skip_header = stat.has_header if skip_header is None else skip_header
     for rec in _pycsv.reader(_io.StringIO(text), delimiter=stat.delimiter,
                              quotechar=getattr(stat, "quotechar", '"')):
         if not rec:
@@ -564,16 +675,7 @@ class TextSourceOperator(L.LogicalOperator):
         a single-file source (reference analog: per-worker S3 input ranges,
         AWSLambdaBackend.cc:410-430). Option-gated; everything else reads
         whole files."""
-        if len(self.files) != 1 or not context.options_store.get_bool(
-                "tuplex.tpu.hostShardedReads", True):
-            return False
-        from ..exec.multihost import MultiHostBackend
-
-        if not isinstance(context.backend, MultiHostBackend):
-            return False
-        import jax
-
-        return jax.process_count() > 1
+        return _host_sharded_gate(self.files, context)
 
     def load_partitions(self, context, projection=None) -> list[C.Partition]:
         if self._host_sharded(context):

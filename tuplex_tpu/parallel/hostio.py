@@ -13,19 +13,19 @@ from typing import Any
 import numpy as np
 
 
-def read_text_lines_range(path: str, pid: int, nproc: int) -> list[str]:
-    """Lines of the file whose STARTING byte falls in this host's range
-    [size*pid/nproc, size*(pid+1)/nproc) — the classic newline-aligned
-    byte split (reference: tuplex.inputSplitSize range tasks,
-    LocalBackend.cc:552-611). Union over hosts == full readlines; no line
-    is read twice."""
+def read_bytes_range(path: str, pid: int, nproc: int) -> bytes:
+    """The file bytes of every LINE whose starting byte falls in this
+    host's range [size*pid/nproc, size*(pid+1)/nproc) — the classic
+    newline-aligned byte split (reference: tuplex.inputSplitSize range
+    tasks, LocalBackend.cc:552-611). Concatenation over hosts == the
+    whole file; no byte is read twice."""
     from ..io.vfs import VirtualFileSystem
 
     size = VirtualFileSystem.file_size(path)
     start = size * pid // nproc
     end = size * (pid + 1) // nproc
     if start >= end:
-        return []
+        return b""
     with VirtualFileSystem.open_read(path, "rb") as fp:
         if start > 0:
             # a line STARTING at `start` belongs to us only if the previous
@@ -45,8 +45,14 @@ def read_text_lines_range(path: str, pid: int, nproc: int) -> list[str]:
                 break
             chunks.append(line)
             pos += len(line)
-    text = b"".join(chunks).decode("utf-8", errors="replace")
-    return text.splitlines()
+    return b"".join(chunks)
+
+
+def read_text_lines_range(path: str, pid: int, nproc: int) -> list[str]:
+    """read_bytes_range decoded and split: union over hosts == the
+    whole-file readlines."""
+    return read_bytes_range(path, pid, nproc).decode(
+        "utf-8", errors="replace").splitlines()
 
 
 def allgather_obj(obj: Any) -> list:
