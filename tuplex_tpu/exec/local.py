@@ -695,7 +695,8 @@ class LocalBackend:
     # ------------------------------------------------------------------
     def _general_case_pass(self, stage: TransformStage, part: C.Partition,
                            fallback_idx: set, resolved: dict,
-                           device_codes: Optional[dict] = None) -> None:
+                           device_codes: Optional[dict] = None,
+                           local_jit: bool = False) -> None:
         """Compiled middle tier: re-run normal-case-violating rows through
         the stage fn traced under the GENERAL-CASE schema (Option/supertype
         widened decode). Rows it completes fold back like resolved python
@@ -705,7 +706,8 @@ class LocalBackend:
         """
         import jax
 
-        gkey = "general/" + stage.key() + "/" + part.schema.name
+        gkey = "general/" + stage.key() + "/" + part.schema.name \
+            + ("/local" if local_jit else "")
         if gkey in self._not_compilable:
             return
         # input-boxed rows can't ride the columnar general path; rows whose
@@ -720,9 +722,12 @@ class LocalBackend:
         if not cand:
             return
         try:
+            # local_jit: the caller's rows are HOST-LOCAL (host-block
+            # resolve) — the mesh dispatch would violate SPMD lockstep,
+            # so build a plain single-host jit instead
             gfn = self.jit_cache.get_or_build(
                 ("stagefn", gkey),
-                lambda: self._jit_stage_fn(
+                lambda: (jax.jit if local_jit else self._jit_stage_fn)(
                     stage.build_device_fn(part.schema, general=True)))
         except NotCompilable:
             self._not_compilable.add(gkey)

@@ -154,8 +154,9 @@ class MultiHostBackend(LocalBackend):
         on the host that owns their raw data with the boxed results
         exchanged over DCN (reference analog: workers read their own S3
         ranges and ship exception rows back, AWSLambdaBackend.cc:410-506;
-        here the exchange is an allgather). The compiled general tier is
-        skipped on this path — err rows go straight to the interpreter."""
+        here the exchange is an allgather). The compiled general tier runs
+        HOST-LOCALLY (plain jit over each host's own err rows) before the
+        interpreter, same ladder as the local backend."""
         import time
 
         import jax
@@ -250,9 +251,24 @@ class MultiHostBackend(LocalBackend):
                             part.normal_mask is None
                             or part.normal_mask[i]))]
 
+        # ---- compiled general tier on the OWNING host --------------------
+        # (same ladder as the local backend: supertype re-trace first,
+        # interpreter only for rows that still err; each host runs it over
+        # ITS OWN rows and the results ride the same exchange)
+        resolved_local: dict = {}
+        if local_fb and not self.interpret_only:
+            t1 = time.perf_counter()
+            try:
+                self._general_case_pass(stage, part, set(local_fb),
+                                        resolved_local, local_jit=True)
+            except Exception:
+                resolved_local = {}
+            metrics["general_path_s"] = time.perf_counter() - t1
+
         # ---- interpreter on the OWNING host + result exchange ------------
         t1 = time.perf_counter()
-        payload = []
+        payload = [(lo + i, "ok", row) for i, row in resolved_local.items()]
+        local_fb = [i for i in local_fb if i not in resolved_local]
         if local_fb:
             pipeline = stage.python_pipeline(part.user_columns)
             for i, row in zip(local_fb, C.decode_rows(part, local_fb)):
