@@ -253,22 +253,40 @@ class MultiHostBackend(LocalBackend):
 
         # ---- compiled general tier on the OWNING host --------------------
         # (same ladder as the local backend: supertype re-trace first,
-        # interpreter only for rows that still err; each host runs it over
-        # ITS OWN rows and the results ride the same exchange)
+        # interpreter only for rows the general tier neither resolved nor
+        # FILTERED — its filter verdicts are final, like the local
+        # backend's; each host runs over ITS OWN rows and the results ride
+        # the same exchange). device_codes prunes rows whose fast-path
+        # code is already an exact Python exception class.
         resolved_local: dict = {}
-        if local_fb and not self.interpret_only:
+        fb_set = set(local_fb)
+        if fb_set and not self.interpret_only:
+            from ..core.errors import unpack_device_code
+
+            dc = {}
+            if err is not None:
+                dc = {i: unpack_device_code(int(err[lo + i]))
+                      for i in local_fb}
             t1 = time.perf_counter()
             try:
-                self._general_case_pass(stage, part, set(local_fb),
-                                        resolved_local, local_jit=True)
-            except Exception:
+                self._general_case_pass(stage, part, fb_set,
+                                        resolved_local, device_codes=dc,
+                                        local_jit=True)
+            except Exception as e:
+                from ..utils.logging import get_logger
+
+                get_logger("exec").warning(
+                    "host-local general tier failed (%s: %s); rows stay "
+                    "on the interpreter", type(e).__name__, e)
                 resolved_local = {}
+                fb_set = set(local_fb)
             metrics["general_path_s"] = time.perf_counter() - t1
 
         # ---- interpreter on the OWNING host + result exchange ------------
         t1 = time.perf_counter()
         payload = [(lo + i, "ok", row) for i, row in resolved_local.items()]
-        local_fb = [i for i in local_fb if i not in resolved_local]
+        local_fb = [i for i in local_fb
+                    if i in fb_set and i not in resolved_local]
         if local_fb:
             pipeline = stage.python_pipeline(part.user_columns)
             for i, row in zip(local_fb, C.decode_rows(part, local_fb)):
