@@ -184,18 +184,15 @@ def child() -> None:
     if not os.path.exists(base_data):
         zillow.generate_csv(base_data, BASELINE_ROWS, seed=42)
 
-    # --- pure-python interpreter baseline (same pipeline, same data gen).
-    # Best-of-N like the framework side: a single sample is the dominant
-    # noise source in vs_baseline on this 1-core box (r4 observed the same
-    # build swing 0.95-1.22x purely from baseline jitter) ---
-    base_s = min(_timed(lambda: zillow.run_reference_python(base_data))
-                 for _ in range(max(2, RUNS)))
-    base_rate = BASELINE_ROWS / base_s
-
-    # --- framework, warmup (compile) + timed runs --------------------------
+    # --- framework + pure-python baseline, INTERLEAVED -------------------
+    # The 1-core box drifts minute to minute (r4 measured the interpreter
+    # baseline swinging 105-156k rows/s across a day, moving vs_baseline
+    # 0.94-1.22x with no code change). Alternating fw/py samples makes
+    # both sides see the same machine state; best-of-N per side.
     ctx = tuplex_tpu.Context()
     got = None
     times = []
+    base_times = []
     for i in range(RUNS + 1):
         t0 = time.perf_counter()
         ds = zillow.build_pipeline(ctx.csv(data))
@@ -203,8 +200,11 @@ def child() -> None:
         dt = time.perf_counter() - t0
         if i > 0:  # first run includes XLA compile
             times.append(dt)
+        base_times.append(_timed(
+            lambda: zillow.run_reference_python(base_data)))
     best = min(times)
     rate = N_ROWS / best
+    base_rate = BASELINE_ROWS / min(base_times)
 
     # --- correctness gate --------------------------------------------------
     want = zillow.run_reference_python(data)
