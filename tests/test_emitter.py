@@ -1437,3 +1437,29 @@ def test_dyn_genexp_semantics_guards():
 
     with _pytest.raises(NotCompilable):
         run_compiled(strsum, ["a,b"])
+
+
+def test_case_predicates():
+    vals = ["Hello World", "abc", "", "  x  ", "AbC123", "HELLO", "hello",
+            "Hello", "A B", "a b", "123", "  ", "Abc Def", "Abc dEf",
+            "ABC def", "x9y", "9X"]
+    check(lambda s: s.islower(), vals)
+    check(lambda s: s.isupper(), vals)
+    check(lambda s: s.istitle(), vals)
+    check(lambda s: s.isnumeric(), vals)
+
+
+def test_char_class_nonascii_routes():
+    # python: '²'.isdigit() is True — byte-level kernels must ROUTE
+    # non-ASCII rows, never answer for them (guard added r4)
+    check(lambda s: s.isdigit(), ["12", "²", "x", ""])
+    check(lambda s: s.isnumeric(), ["12", "Ⅻ", "x"])
+    check(lambda s: s.islower(), ["abc", "ß", "ABC"])
+
+
+def test_case_transforms_nonascii_route():
+    # 'équipe'.upper() == 'ÉQUIPE' in python; the byte kernel can't do
+    # that — such rows must route (review r4)
+    check(lambda s: s.upper(), ["abc", "équipe", "ÉQUIPE"])
+    check(lambda s: s.lower(), ["ABC", "ÉQUIPE"])
+    check(lambda s: s.title(), ["ab cd", "über uns"])

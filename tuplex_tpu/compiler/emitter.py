@@ -1991,6 +1991,9 @@ class Frame:
                              CV(t=T.STR, sbytes=sb2, slen=sl2),
                              CV(t=T.STR, sbytes=tb, slen=tl)])
         if name in ("lower", "upper", "swapcase"):
+            # byte-level case maps cover ASCII only: 'équipe'.upper() must
+            # route, not return 'éQUIPE' (review r4)
+            self._ascii_guard(rb, rl)
             fb, fl = getattr(S, name)(rb, rl)
             return CV(t=T.STR, sbytes=fb, slen=fl)
         if name in ("strip", "lstrip", "rstrip"):
@@ -2027,12 +2030,19 @@ class Frame:
             needle = need_const_str(0)
             cnt = S.count_const(rb, rl, needle)
             return CV(t=T.I64, data=cnt.astype(jnp.int64))
-        if name in ("isdigit", "isdecimal", "isalpha", "isalnum", "isspace"):
+        if name in ("isdigit", "isdecimal", "isnumeric", "isalpha",
+                    "isalnum", "isspace"):
+            self._ascii_guard(rb, rl)
             return CV(t=T.BOOL, data=S.char_class_all(rb, rl, name))
+        if name in ("islower", "isupper", "istitle"):
+            self._ascii_guard(rb, rl)
+            return CV(t=T.BOOL, data=S.case_pred(rb, rl, name))
         if name == "capitalize":
+            self._ascii_guard(rb, rl)
             fb, fl = S.capitalize(rb, rl)
             return CV(t=T.STR, sbytes=fb, slen=fl)
         if name == "title":
+            self._ascii_guard(rb, rl)
             fb, fl = S.title(rb, rl)
             return CV(t=T.STR, sbytes=fb, slen=fl)
         if name == "format":

@@ -668,13 +668,14 @@ def count_const(bytes_, lens, needle: str):
 
 
 def char_class_all(bytes_, lens, kind: str):
-    """isdigit/isdecimal/isalpha/isalnum/isspace — ASCII semantics, all chars
+    """isdigit/isdecimal/isnumeric/isalpha/isalnum/isspace — ASCII
+    semantics (the caller's ascii guard routes multibyte rows), all chars
     in class AND non-empty."""
     is_digit = (bytes_ >= 48) & (bytes_ <= 57)
     is_alpha = ((bytes_ >= 65) & (bytes_ <= 90)) | \
         ((bytes_ >= 97) & (bytes_ <= 122))
-    if kind in ("isdigit", "isdecimal"):
-        cls = is_digit
+    if kind in ("isdigit", "isdecimal", "isnumeric"):
+        cls = is_digit     # identical over ASCII
     elif kind == "isalpha":
         cls = is_alpha
     elif kind == "isalnum":
@@ -685,6 +686,28 @@ def char_class_all(bytes_, lens, kind: str):
         raise ValueError(kind)
     inside = _pos_mask(bytes_.shape[1], lens)
     return jnp.all(cls | ~inside, axis=1) & (lens > 0)
+
+
+def case_pred(bytes_, lens, kind: str):
+    """islower/isupper/istitle — ASCII semantics (ascii-guarded callers).
+
+    python: islower = at least one cased char and no uppercase; isupper
+    symmetric; istitle = at least one cased char, uppercase only at the
+    start of cased runs, lowercase only inside them."""
+    inside = _pos_mask(bytes_.shape[1], lens)
+    up = (bytes_ >= 65) & (bytes_ <= 90) & inside
+    lo = (bytes_ >= 97) & (bytes_ <= 122) & inside
+    cased = up | lo
+    has_cased = jnp.any(cased, axis=1)
+    if kind == "islower":
+        return has_cased & ~jnp.any(up, axis=1)
+    if kind == "isupper":
+        return has_cased & ~jnp.any(lo, axis=1)
+    if kind == "istitle":
+        prev_cased = jnp.pad(cased[:, :-1], ((0, 0), (1, 0)))
+        bad = (up & prev_cased) | (lo & ~prev_cased)
+        return has_cased & ~jnp.any(bad, axis=1)
+    raise ValueError(kind)
 
 
 def capitalize(bytes_, lens):
