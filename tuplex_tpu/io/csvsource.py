@@ -213,7 +213,14 @@ def _host_sharded_gate(files: list, context) -> bool:
         return False
     import jax
 
-    return jax.process_count() > 1
+    nproc = jax.process_count()
+    # host-block slot quantization assumes devices split evenly across
+    # processes (hostblock_stage_fn pads each block to 8*ldev slots); an
+    # uneven split would mis-assemble make_array_from_process_local_data,
+    # so fall back to whole reads for odd topologies (3 devices / 2 hosts)
+    if nproc <= 1 or context.backend.n_devices % nproc != 0:
+        return False
+    return True
 
 class CSVSourceOperator(L.LogicalOperator):
     """Raw-cell CSV source: every column is Option[str] (missing cell = None).

@@ -25,6 +25,23 @@ from ..runtime.spill import SpilledPartition, _leaves_to_npz_dict
 _MANIFEST = "tuplex_manifest.pkl"
 
 
+def _is_not_found(exc: Exception) -> bool:
+    """True for missing-object errors from any store (local, S3, GCS).
+    SDK classes are matched structurally so neither SDK is required:
+    botocore ClientError carries an error Code, google-cloud raises a
+    class literally named NotFound."""
+    if isinstance(exc, (FileNotFoundError, KeyError)):
+        return True
+    code = ""
+    try:
+        code = str(exc.response["Error"]["Code"])  # type: ignore[attr-defined]
+    except Exception:
+        pass
+    if code in ("404", "NoSuchKey", "NoSuchBucket"):
+        return True
+    return type(exc).__name__ in ("NotFound", "BlobNotFoundError")
+
+
 from .vfs import is_remote_uri as _is_remote  # noqa: E402
 from .vfs import join_uri as _join  # noqa: E402
 
@@ -157,17 +174,16 @@ class TuplexFileSourceOperator(L.LogicalOperator):
                     leaves = load_leaves_npz(
                         os.path.join(self.path, e["file"]))
             except Exception as exc:
-                # remote stores raise store-specific classes for missing
-                # objects (botocore ClientError, google NotFound) — wrap
-                # them all in the uniform overwrite diagnosis
-                if not isinstance(exc, (FileNotFoundError, KeyError)) \
-                        and not _is_remote(self.path):
+                # only MISSING-object errors mean the dataset was
+                # overwritten under us; transient network/auth failures
+                # from remote SDKs must surface as themselves
+                if not _is_not_found(exc):
                     raise
                 raise TuplexException(
                     f"tuplex dataset at {self.path!r} was overwritten "
                     f"after this reader opened it (or a part object is "
                     f"missing: {type(exc).__name__}); reopen with "
-                    f"tuplexfile()") from None
+                    f"tuplexfile()") from exc
             leaves.update({p: C.ObjectLeaf(v)
                            for p, v in e["obj_leaves"].items()})
             parts.append(C.Partition(

@@ -339,7 +339,15 @@ static PyObject *decode_columns(PyObject *, PyObject *args) {
       }
       col.has_valid = true;
     }
-    // bounds: every row index must stay inside the provided buffers
+    // bounds: every row index must stay inside the provided buffers; a
+    // negative width would make `need` vacuously small and let
+    // buf + i*w index backwards, so reject it outright (w == 0 is a
+    // legal degenerate: every row decodes to the empty string)
+    if (col.kind == 3 && col.w < 0) {
+      PyErr_SetString(PyExc_ValueError, "string column width must be >= 0");
+      arg_ok = false;
+      break;
+    }
     Py_ssize_t need = col.kind == 3 ? n * col.w
                       : col.kind == 2 ? n
                                       : n * 8;

@@ -32,10 +32,6 @@ def udf_read_columns(udf) -> Optional[set[str]]:
     if reads is ALL:
         return ALL
     # any OTHER use of the param leaks the whole row
-    for node in ast.walk(udf.tree):
-        if isinstance(node, ast.Name) and node.id == p:
-            # find whether this Name is the value of a const-str Subscript
-            pass
     leaks = _param_leaks(udf.tree, p)
     return ALL if leaks else reads
 
