@@ -148,3 +148,48 @@ def test_dirty_rows_before_compaction(tmp_path):
         want.append((av, s.upper(), b, int(s[1:]) + b))
     assert got == want
     assert sum(ds.exception_counts().values()) >= 15000 // 211
+
+
+def test_pruned_decode_sample_alignment(tmp_path):
+    """Projection pushdown prunes the DecodeOperator to a column subset; its
+    sample must select parent cells BY NAME, not positionally. A positional
+    zip fed the wrong raw columns to every downstream sample (q6's filter
+    selectivities all read 0.0), collapsing the compaction bucket to its
+    64-row floor and forcing an overflow re-run on clean data."""
+    p = tmp_path / "w.csv"
+    with open(p, "w") as f:
+        # columns: keep1, pruned, keep2 — projection selects (keep1, keep2)
+        f.write("k1,px,k2\n")
+        for i in range(9000):
+            f.write(f"{i},junk{i},{i % 7}\n")
+    ctx = tuplex_tpu.Context()
+    ds = (ctx.csv(str(p))
+          .filter(lambda x: x["k2"] < 3)
+          .map(lambda x: (x["k1"], x["k2"] * 10)))
+    from tuplex_tpu.plan import logical as L, physical as P
+
+    captured = {}
+    orig = P._compaction_plan
+
+    def spy(ops):
+        for op in ops:
+            if isinstance(op, L.FilterOperator):
+                base = op.parents[0].cached_sample()
+                captured["frac"] = len(op.cached_sample()) / max(len(base), 1)
+                captured["row0"] = base[0]
+        return orig(ops)
+
+    P._compaction_plan = spy
+    try:
+        got = ds.collect()
+    finally:
+        P._compaction_plan = orig
+    assert got == [(i, (i % 7) * 10) for i in range(9000) if i % 7 < 3]
+    # the decoded sample rows carry the PROJECTED columns with the right
+    # values (k2 is the small modulo, not the junk string), and the filter
+    # selectivity matches the data (3/7), not 0
+    assert captured, "compaction plan never consulted"
+    r0 = captured["row0"]
+    assert tuple(r0.columns) == ("k1", "k2") and r0.values[1] in range(7)
+    assert abs(captured["frac"] - 3 / 7) < 0.1
+    assert not ctx.backend._compaction_off

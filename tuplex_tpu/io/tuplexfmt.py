@@ -30,7 +30,7 @@ def _is_not_found(exc: Exception) -> bool:
     SDK classes are matched structurally so neither SDK is required:
     botocore ClientError carries an error Code, google-cloud raises a
     class literally named NotFound."""
-    if isinstance(exc, (FileNotFoundError, KeyError)):
+    if isinstance(exc, FileNotFoundError):
         return True
     code = ""
     try:

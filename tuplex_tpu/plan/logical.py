@@ -470,10 +470,23 @@ class DecodeOperator(LogicalOperator):
 
     def sample(self) -> list[Row]:
         out = []
+        cols = self.declared.columns
+        sel = None   # parent-row indices when this decode is projection-
+        # pruned: the parent sample still carries the FULL source row, so
+        # cells must be selected by name — a positional zip would silently
+        # decode the wrong columns (and feed garbage to every downstream
+        # sample, e.g. filter selectivities of 0 for compaction planning)
         for r in self.parent.cached_sample():
+            if sel is None:
+                if cols and r.columns and tuple(r.columns) != tuple(cols) \
+                        and all(c in r.columns for c in cols):
+                    sel = [r.columns.index(c) for c in cols]
+                else:
+                    sel = []
+            vin = [r.values[i] for i in sel] if sel else r.values
             vals = [decode_cell_python(v, t, self.null_values)
-                    for v, t in zip(r.values, self.declared.types)]
-            out.append(Row(vals, self.declared.columns))
+                    for v, t in zip(vin, self.declared.types)]
+            out.append(Row(vals, cols))
         return out
 
 

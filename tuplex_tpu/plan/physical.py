@@ -154,11 +154,11 @@ class TransformStage:
                 row, keep, names = _emit_op(ctx, op, row, keep, names,
                                             general=general)
                 row, keep = _fusion_barrier(ctx, row, keep)
-                frac = plan.get(op.id)
+                frac = plan.get(op.id)   # already margin-padded
                 if frac is not None and bcur >= 8192:
                     from ..runtime.columns import bucket_size
 
-                    target = int(b * frac * _COMPACT_MARGIN) + 64
+                    target = int(b * frac) + 64
                     b2 = bucket_size(min(bcur, target), "q8")
                     if b2 < bcur:
                         (row, keep, rowidx, full_err,
@@ -218,8 +218,9 @@ def _fusion_barrier(ctx: EmitCtx, row: CV, keep):
     return row2, keep2
 
 
-_COMPACT_MARGIN = 1.15   # headroom over the sample estimate (~9 sigma for a
-_COMPACT_GATHER = 0.5    # 1000-row sample); gather cost in per-op-pass units
+_COMPACT_MARGIN = 1.15   # multiplicative headroom over the sample estimate
+_COMPACT_Z = 5.0         # + this many binomial standard errors (see pad())
+_COMPACT_GATHER = 0.5    # gather cost in per-op-pass units
 
 
 def _emit_fused_fold(outs: dict, spec, row: CV, names, fin, bcur) -> None:
@@ -277,10 +278,24 @@ def _compaction_plan(ops) -> dict[int, float]:
         base = len(base_op.cached_sample())
         if base < 32:
             return {}
+        import math
+
+        def pad(f: float) -> float:
+            # upper confidence bound on the live fraction: the fixed
+            # multiplicative margin alone is <1 sigma of binomial sampling
+            # noise at small fractions (q6's 1.8% live rate), so add
+            # _COMPACT_Z standard errors. The variance uses a Wilson-style
+            # smoothed fraction so an observed 0 still gets real headroom
+            # (raw sqrt(f(1-f)) vanishes at f=0, exactly where a small
+            # sample most understates the true rate).
+            fs = (f * base + _COMPACT_Z ** 2 / 2) / (base + _COMPACT_Z ** 2)
+            return min(1.0, f * _COMPACT_MARGIN
+                       + _COMPACT_Z * math.sqrt(fs * (1.0 - fs) / base))
+
         fracs = {}   # position in ops -> cumulative live fraction after it
         for k, op in enumerate(ops):
             if isinstance(op, L.FilterOperator):
-                fracs[k] = len(op.cached_sample()) / base
+                fracs[k] = pad(len(op.cached_sample()) / base)
         # candidates must leave >=2 real compute ops downstream
         cand = [k for k in fracs
                 if sum(1 for o in ops[k + 1:]
@@ -294,9 +309,9 @@ def _compaction_plan(ops) -> dict[int, float]:
             for k, op in enumerate(ops):
                 total += factor
                 if k in subset:
-                    # bucketed batch after compacting here (~6% pad waste)
-                    new = min(factor,
-                              fracs[k] * _COMPACT_MARGIN * 1.06 + 0.01)
+                    # bucketed batch after compacting here (~6% pad waste);
+                    # fracs[] already carry the confidence-bound margin
+                    new = min(factor, fracs[k] * 1.06 + 0.01)
                     if new < factor:
                         total += _COMPACT_GATHER * factor
                         factor = new
