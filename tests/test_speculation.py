@@ -1,0 +1,208 @@
+"""Sample-driven branch speculation (compiler/branchprof.py + emitter
+_spec_arms): arms the sample never took are not emitted; rows entering a
+pruned arm raise NORMALCASEVIOLATION and resolve exactly on the
+general/interpreter ladder.
+
+Reference analog: RemoveDeadBranchesVisitor.cc:1-147 prunes branches the
+TraceVisitor sample annotations (TraceVisitor.h:25-80) marked dead, with
+violating rows falling to the general case the same way.
+"""
+
+import contextlib
+
+import numpy as np
+import pytest
+
+import tuplex_tpu
+
+
+@contextlib.contextmanager
+def _fallback_spy():
+    """Counts rows that left the fast path: python-pipeline builds and
+    general-tier passes are only entered when fallback_idx is nonempty."""
+    from tuplex_tpu.exec.local import LocalBackend
+    from tuplex_tpu.plan.physical import TransformStage
+
+    calls = {"pipeline": 0, "general": 0}
+    orig_pp = TransformStage.python_pipeline
+    orig_gp = LocalBackend._general_case_pass
+
+    def spy_pp(self, *a, **k):
+        calls["pipeline"] += 1
+        return orig_pp(self, *a, **k)
+
+    def spy_gp(self, *a, **k):
+        calls["general"] += 1
+        return orig_gp(self, *a, **k)
+
+    TransformStage.python_pipeline = spy_pp
+    LocalBackend._general_case_pass = spy_gp
+    try:
+        yield calls
+    finally:
+        TransformStage.python_pipeline = orig_pp
+        LocalBackend._general_case_pass = orig_gp
+
+
+def _expensive_cold(x):
+    # cold arm (sample = first 1000 rows, all < 5000) with REAL work in it,
+    # so the arm-weight heuristic prunes it
+    if x >= 5000:
+        return int(str(x).replace("0", "1")) * 2
+    return x + 1
+
+
+def test_cold_arm_rows_resolve_exactly():
+    data = list(range(8000))
+    want = [_expensive_cold(x) for x in data]
+    ctx = tuplex_tpu.Context()
+    ds = ctx.parallelize(data).map(_expensive_cold)
+    with _fallback_spy() as calls:
+        assert ds.collect() == want
+    # the cold rows really were pruned off the fast path: they took the
+    # resolve ladder, and the profile shows the dead arm
+    assert calls["pipeline"] + calls["general"] > 0
+    prof = ds._op.branch_profile()
+    assert any(v == (False, True) for v in prof.values())
+
+
+def test_speculation_off_keeps_everything_compiled():
+    data = list(range(8000))
+    want = [_expensive_cold(x) for x in data]
+    ctx = tuplex_tpu.Context({"tuplex.optimizer.speculateBranches": False})
+    ds = ctx.parallelize(data).map(_expensive_cold)
+    with _fallback_spy() as calls:
+        assert ds.collect() == want
+    assert calls["pipeline"] == 0 and calls["general"] == 0
+
+
+def test_trivial_cold_arm_not_pruned():
+    """Arm-weight gate: a cold arm that is a cheap assignment stays
+    predicated — the violation bookkeeping would cost more than it saves,
+    and no row should leave the fast path."""
+    def f(x):
+        y = 0
+        if x >= 5000:     # cold for the sample, but the arm is trivial
+            y = 1
+        return x + y
+
+    data = list(range(8000))
+    ctx = tuplex_tpu.Context()
+    ds = ctx.parallelize(data).map(f)
+    with _fallback_spy() as calls:
+        assert ds.collect() == [f(x) for x in data]
+    assert calls["pipeline"] == 0 and calls["general"] == 0
+
+
+def test_ifexp_cold_arm_parity():
+    def f(x):
+        return x + 1 if x < 5000 else int(str(x)[::-1])
+
+    data = list(range(8000))
+    ctx = tuplex_tpu.Context()
+    assert ctx.parallelize(data).map(f).collect() == [f(x) for x in data]
+
+
+def test_cold_arm_resolves_on_general_tier(tmp_path):
+    """With a csv source (general-case decode exists), violating rows must
+    resolve on the VECTORIZED general tier, not row-by-row."""
+    p = tmp_path / "g.csv"
+    with open(p, "w") as f:
+        f.write("a,s\n")
+        for i in range(9000):
+            f.write(f"{i},v{i}\n")
+
+    def udf(x):
+        if x["a"] >= 6000:    # cold in the sniffing sample
+            return int(x["s"][1:]) * 7
+        return x["a"]
+
+    ctx = tuplex_tpu.Context()
+    ds = ctx.csv(str(p)).map(udf)
+    with _fallback_spy() as calls:
+        got = ds.collect()
+    assert got == [udf({"a": i, "s": f"v{i}"}) for i in range(9000)]
+    assert calls["general"] > 0
+
+
+def test_branch_profile_records_both_arms():
+    data = [i % 10 for i in range(2000)]
+
+    def f(x):
+        if x < 5:
+            return int(str(x) * 2)
+        return -x
+
+    ctx = tuplex_tpu.Context()
+    ds = ctx.parallelize(data).map(f)
+    assert ds.collect() == [f(x) for x in data]
+    prof = ds._op.branch_profile()
+    # both arms observed -> nothing prunable, nothing falls off
+    assert all(v == (True, True) for v in prof.values())
+
+
+def test_nested_cold_branch_inside_hot_arm():
+    def f(x):
+        if x % 2 == 0:                 # both arms hot
+            if x >= 5000:              # cold inner
+                return int(str(x).replace("1", "2"))
+            return x * 2
+        return x
+
+    data = list(range(8000))
+    ctx = tuplex_tpu.Context()
+    assert ctx.parallelize(data).map(f).collect() == [f(x) for x in data]
+
+
+def test_filter_with_cold_branch():
+    def pred(x):
+        if x >= 5000:                  # cold, expensive arm
+            return len(str(x).replace("9", "")) > 2
+        return x % 3 == 0
+
+    data = list(range(8000))
+    ctx = tuplex_tpu.Context()
+    got = ctx.parallelize(data).filter(pred).collect()
+    assert got == [x for x in data if pred(x)]
+
+
+def test_fresh_dataset_gets_fresh_kernel():
+    """stage.key() carries the branch-profile signature: a second dataset
+    whose sample takes the previously-cold arm must NOT reuse the kernel
+    pruned for the first dataset (which would bounce every row to the
+    resolve ladder)."""
+    ctx = tuplex_tpu.Context()
+    d1 = list(range(8000))          # >=5000 arm cold in the sample
+    assert ctx.parallelize(d1).map(_expensive_cold).collect() == \
+        [_expensive_cold(x) for x in d1]
+    d2 = [x + 5000 for x in range(8000)]   # >=5000 arm HOT in the sample
+    with _fallback_spy() as calls:
+        assert ctx.parallelize(d2).map(_expensive_cold).collect() == \
+            [_expensive_cold(x) for x in d2]
+    # d2's own profile keeps its hot arm; nothing may leave the fast path
+    assert calls["pipeline"] == 0 and calls["general"] == 0
+
+
+def test_speculation_rescues_noncompilable_cold_arm():
+    """A cold arm containing a construct the emitter rejects: with
+    speculation the op still compiles (the arm is never emitted) and cold
+    rows resolve on the interpreter; without it the op segments to the
+    interpreter entirely. Both exact."""
+    def f(x):
+        if x >= 5000:               # cold; locals() is not compilable
+            return len(locals()) + x
+        return x * 3
+
+    data = list(range(8000))
+    want = [f(x) for x in data]
+    ctx = tuplex_tpu.Context()
+    ds = ctx.parallelize(data).map(f)
+    with _fallback_spy() as calls:
+        assert ds.collect() == want
+    # compiled fast path stayed alive: only the cold rows fell back (the
+    # general tier rightly refuses — it never speculates)
+    assert not any(not k.startswith("general/")
+                   for k in ctx.backend._not_compilable)
+    assert calls["pipeline"] >= 1
+    ctx2 = tuplex_tpu.Context({"tuplex.optimizer.speculateBranches": False})
+    assert ctx2.parallelize(data).map(f).collect() == want

@@ -1,0 +1,129 @@
+"""Sample-driven branch profiles for speculative if/else pruning.
+
+The reference prunes UDF branches its row sample never takes and lets
+violating rows fall to the general/interpreter ladder (reference:
+codegen/src/RemoveDeadBranchesVisitor.cc:1-147, fed by TraceVisitor branch
+annotations, core/include/TraceVisitor.h:25-80). The emitter here predicates
+both arms of every if/else under boolean masks — correct, but every row pays
+device compute for arms almost no row takes.
+
+This module produces the evidence: it instruments a copy of the UDF's AST so
+every `If`/`IfExp` test routes through a recorder, runs the instrumented
+function over the operator's existing sample rows, and reports which arms the
+sample observed. The emitter then emits ONLY the observed arm and raises
+NORMALCASEVIOLATION for rows that would enter a cold arm (they resolve
+exactly on the general tier / interpreter, like every other normal-case
+violation).
+
+Profiles are keyed by (node kind, lineno, col_offset) of the ORIGINAL
+`udf.tree` nodes — the instrumented tree is a deepcopy, so locations match
+without re-parsing (the tree may come from a larger enclosing parse whose
+line numbers a re-parse of `udf.source` would not reproduce).
+"""
+
+from __future__ import annotations
+
+import ast
+import copy
+from typing import Callable
+
+_PROFILE_ROW_CAP = 1000
+
+
+def branch_key(node: ast.AST) -> tuple:
+    return (type(node).__name__, node.lineno, node.col_offset)
+
+
+class _WrapTests(ast.NodeTransformer):
+    """Wrap every If/IfExp test in `__tpx_b__(<key index>, test)`."""
+
+    def __init__(self):
+        self.keys: list[tuple] = []
+
+    def _wrap(self, node):
+        node = self.generic_visit(node)
+        idx = len(self.keys)
+        self.keys.append(branch_key(node))
+        call = ast.Call(func=ast.Name(id="__tpx_b__", ctx=ast.Load()),
+                        args=[ast.Constant(value=idx), node.test],
+                        keywords=[])
+        ast.copy_location(call, node.test)
+        node.test = call
+        return node
+
+    visit_If = _wrap
+    visit_IfExp = _wrap
+
+
+def _build_instrumented(udf) -> tuple[Callable, dict, list]:
+    tree = copy.deepcopy(udf.tree)
+    w = _WrapTests()
+    tree = w.visit(tree)
+    ast.fix_missing_locations(tree)
+    hits: dict[int, list[bool]] = {}
+
+    def rec(i, v):
+        s = hits.setdefault(i, [False, False])
+        s[0 if v else 1] = True
+        return v
+
+    g = dict(udf.globals)
+    g["__tpx_b__"] = rec
+    if isinstance(tree, ast.Lambda):
+        expr = ast.Expression(body=tree)
+        ast.fix_missing_locations(expr)
+        f = eval(compile(expr, "<branchprof>", "eval"), g)
+    else:
+        mod = ast.Module(body=[tree], type_ignores=[])
+        ast.fix_missing_locations(mod)
+        exec(compile(mod, "<branchprof>", "exec"), g)
+        f = g[tree.name]
+    return f, hits, w.keys
+
+
+_CHEAP_CALLS = {"len", "abs", "min", "max", "ord", "chr", "bool"}
+
+
+def arm_weight(arm) -> int:
+    """Static cost estimate of a branch arm (stmt list or expr): method
+    calls / casts are columnar kernels (string scans, parses), loops and
+    comprehensions unroll — those make pruning pay. Pure assignments of
+    cheap expressions cost nothing under predication, so pruning them only
+    buys an error-lattice update."""
+    stmts = arm if isinstance(arm, list) else [arm]
+    w = 0
+    for s in stmts:
+        for n in ast.walk(s):
+            if isinstance(n, ast.Call):
+                f = n.func
+                if isinstance(f, ast.Name) and f.id in _CHEAP_CALLS:
+                    continue
+                w += 1
+            elif isinstance(n, (ast.For, ast.While, ast.ListComp,
+                                ast.SetComp, ast.DictComp,
+                                ast.GeneratorExp)):
+                w += 3
+    return w
+
+
+def profile_branches(udf, rows, call: Callable) -> dict:
+    """{branch_key: (saw_true, saw_false)} from running the instrumented UDF
+    over `rows` via `call(f, row)` (the operator's own calling convention).
+    Rows that raise contribute whatever branches they reached before the
+    error — same evidence the reference's TraceVisitor collects. Returns {}
+    when the UDF has no branches or cannot be instrumented (no pruning)."""
+    if not rows:
+        return {}
+    if not any(isinstance(n, (ast.If, ast.IfExp))
+               for n in ast.walk(udf.tree)):
+        return {}
+    try:
+        f, hits, keys = _build_instrumented(udf)
+    except Exception:
+        return {}
+    for r in rows[:_PROFILE_ROW_CAP]:
+        try:
+            call(f, r)
+        except Exception:
+            pass
+    return {keys[i]: tuple(v) for i, v in hits.items()}

@@ -90,9 +90,13 @@ class EmitCtx:
 
 
 class Emitter:
-    def __init__(self, ctx: EmitCtx, globals_: dict[str, Any]):
+    def __init__(self, ctx: EmitCtx, globals_: dict[str, Any],
+                 branch_profile: Optional[dict] = None):
         self.ctx = ctx
         self.globals = globals_
+        # sample branch observations for speculative arm pruning
+        # (compiler/branchprof.py); None/{} disables speculation
+        self.branch_profile = branch_profile or None
 
     # ------------------------------------------------------------------ UDF
     def eval_udf(self, udf: UDFSource, args: list[CV]) -> CV:
@@ -163,11 +167,16 @@ class Frame:
                     m = ~lp[k] if m is None else m & ~lp[k]
         return m
 
-    def raise_where(self, cond, code: ExceptionCode):
+    def raise_where(self, cond, code: ExceptionCode, barrier: bool = True):
         hit = self.active() & cond & (self.ctx.err == 0)
         self.ctx.err = jnp.where(hit, jnp.int32(self.ctx.coded(code)),
                                  self.ctx.err)
         self.ctx.active = self.ctx.active & ~hit
+        if not barrier:
+            # speculation raises: the condition is an already-materialized
+            # branch predicate, not a fused error chain — cutting fusion
+            # here would cost more than it saves
+            return
         # cut the error lattice's producer chain HERE: lambda UDFs and the
         # fused decode have no statement boundaries, so without this the
         # final #err kLoop fusion re-pulls (and per-element RECOMPUTES)
@@ -291,11 +300,50 @@ class Frame:
             return
         self._assign_target(node.target, self.eval(node.value))
 
+    def _spec_arms(self, node) -> tuple[bool, bool]:
+        """(prune_then, prune_else): arms the operator's sample NEVER took
+        (branch speculation, reference RemoveDeadBranchesVisitor.cc:1-147).
+        An arm is prunable only with positive evidence the OTHER arm ran —
+        a node the sample never reached proves nothing about either arm —
+        and only when its body is worth skipping: predicated execution of a
+        cheap assignment costs less than the violation bookkeeping."""
+        prof = self.em.branch_profile
+        if not prof:
+            return False, False
+        from .branchprof import arm_weight, branch_key
+
+        rec = prof.get(branch_key(node))
+        if rec is None:
+            return False, False
+        saw_t, saw_f = rec
+        return (not saw_t and saw_f and arm_weight(node.body) >= 1,
+                not saw_f and saw_t and bool(node.orelse)
+                and arm_weight(node.orelse) >= 1)
+
     def exec_If(self, node: ast.If) -> None:
+        prune_then, prune_else = self._spec_arms(node)
         cond = self.truthy(self.eval(node.test))
         outer = self.mask
         then_m = cond if outer is None else outer & cond
         else_m = ~cond if outer is None else outer & ~cond
+        if prune_then:
+            # sample never entered the then-arm: emit only the else-arm;
+            # rows taking the cold arm violate the normal case and resolve
+            # exactly on the general/interpreter ladder
+            self.raise_where(cond, ExceptionCode.NORMALCASEVIOLATION,
+                             barrier=False)
+            if node.orelse:
+                self.mask = else_m
+                self.exec_block(node.orelse)
+            self.mask = outer
+            return
+        if prune_else and node.orelse:
+            self.raise_where(~cond, ExceptionCode.NORMALCASEVIOLATION,
+                             barrier=False)
+            self.mask = then_m
+            self.exec_block(node.body)
+            self.mask = outer
+            return
         self.mask = then_m
         self.exec_block(node.body)
         if node.orelse:
@@ -919,8 +967,23 @@ class Frame:
         return CV(t=T.BOOL, data=acc)
 
     def eval_IfExp(self, node: ast.IfExp) -> CV:
+        prune_then, prune_else = self._spec_arms(node)
         cond = self.truthy(self.eval(node.test))
         outer = self.mask
+        if prune_then:
+            self.raise_where(cond, ExceptionCode.NORMALCASEVIOLATION,
+                             barrier=False)
+            self.mask = ~cond if outer is None else outer & ~cond
+            b = self.eval(node.orelse)
+            self.mask = outer
+            return b
+        if prune_else:
+            self.raise_where(~cond, ExceptionCode.NORMALCASEVIOLATION,
+                             barrier=False)
+            self.mask = cond if outer is None else outer & cond
+            a = self.eval(node.body)
+            self.mask = outer
+            return a
         self.mask = cond if outer is None else outer & cond
         a = self.eval(node.body)
         self.mask = ~cond if outer is None else outer & ~cond

@@ -52,6 +52,7 @@ DEFAULTS: dict[str, str] = {
     "tuplex.logDir": ".",
     "tuplex.normalcaseThreshold": "0.9",
     "tuplex.optimizer.nullValueOptimization": "true",
+    "tuplex.optimizer.speculateBranches": "true",
     "tuplex.optimizer.filterPushdown": "true",
     "tuplex.optimizer.selectionPushdown": "true",
     "tuplex.optimizer.operatorReordering": "false",

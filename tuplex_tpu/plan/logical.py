@@ -25,6 +25,7 @@ _op_ids = itertools.count(1)
 # every UDF over the sample (the reference reuses per-UDF hint results the
 # same way via its source_vault + JIT cache keying).
 _cross_job_samples: dict[str, list] = {}
+_cross_job_branchprofs: dict[str, dict] = {}
 _cross_job_schemas: dict[str, Any] = {}
 
 
@@ -45,11 +46,13 @@ def record_sample_exc(op: "LogicalOperator", e: Exception, row) -> None:
         lst.append(entry)
 
 
-def apply_udf_python(udf: UDFSource, row: Row) -> Any:
+def apply_udf_python(udf: UDFSource, row: Row, func=None) -> Any:
     """Interpreter-path calling convention shared by sampling and the
     fallback pipeline (reference: PythonPipelineBuilder's generated Row class,
-    core/src/physical/PythonPipelineBuilder.cc:1-60)."""
-    f = udf.func
+    core/src/physical/PythonPipelineBuilder.cc:1-60). `func` substitutes an
+    instrumented clone of the UDF (branch profiling) under the same
+    convention."""
+    f = func if func is not None else udf.func
     nparams = len(udf.params) if udf.params else 1
     if nparams > 1 and len(row.values) == nparams:
         return f(*row.values)
@@ -182,6 +185,34 @@ class UDFOperator(LogicalOperator):
         super().__init__([parent])
         self.udf = get_udf_source(func)
         self._schema_cache: Optional[T.RowType] = None
+
+    def branch_profile(self) -> dict:
+        """Which if/else arms the operator's sample observed (reference:
+        TraceVisitor branch annotations feeding RemoveDeadBranchesVisitor).
+        Keyed by (kind, lineno, col) of the udf.tree node; memoized — the
+        instrumented re-run costs one python pass over the sample."""
+        memo = getattr(self, "_branch_prof_memo", None)
+        if memo is None:
+            ck = self.chain_key()
+            hit = _cross_job_branchprofs.get(ck) if ck is not None else None
+            if hit is not None:
+                memo = hit
+            else:
+                from ..compiler.branchprof import profile_branches
+
+                rows = self.parent.cached_sample()
+                # too little evidence to call any arm dead
+                memo = {} if len(rows) < 32 else profile_branches(
+                    self.udf, rows, self._profile_call)
+                if ck is not None:
+                    if len(_cross_job_branchprofs) > 256:
+                        _cross_job_branchprofs.clear()
+                    _cross_job_branchprofs[ck] = memo
+            self._branch_prof_memo = memo
+        return memo
+
+    def _profile_call(self, f, row) -> None:
+        apply_udf_python(self.udf, row, func=f)
 
     def schema(self) -> T.RowType:
         if self._schema_cache is None:
@@ -345,6 +376,13 @@ class MapColumnOperator(UDFOperator):
             vals[ci] = v
             out.append(Row(vals, r.columns))
         return out
+
+    def _profile_call(self, f, row) -> None:
+        ci = getattr(self, "_prof_ci", None)
+        if ci is None:
+            ci = self._prof_ci = \
+                self.parent.schema().columns.index(self.column)
+        f(row.values[ci])
 
 
 class SelectColumnsOperator(LogicalOperator):

@@ -48,6 +48,8 @@ class TransformStage:
     force_interpret = False   # set on segments around non-compilable ops
     fold_op = None            # AggregateOperator whose pattern fold is fused
                               # into this stage's device fn (plan_stages)
+    speculate_branches = True  # prune if/else arms the sample never took
+                              # (tuplex.optimizer.speculateBranches)
 
     @property
     def has_resolvers(self) -> bool:
@@ -86,6 +88,13 @@ class TransformStage:
         if self.fold_op is not None:
             h.update(b"fold")
             h.update(_op_identity(self.fold_op).encode())
+        if self.speculate_branches:
+            # the emitted kernel is specialized on the (data-dependent)
+            # sample branch profile — a different dataset with the same UDF
+            # chain must not reuse a kernel pruned for this one's sample
+            h.update(b"specbr")
+            for op in self.ops:
+                h.update(_branch_profile_sig(op).encode())
         return h.hexdigest()[:16]
 
     # ------------------------------------------------------------------
@@ -152,7 +161,8 @@ class TransformStage:
             for op in ops:
                 ctx.cur_op = op.id
                 row, keep, names = _emit_op(ctx, op, row, keep, names,
-                                            general=general)
+                                            general=general,
+                                            speculate=self.speculate_branches)
                 row, keep = _fusion_barrier(ctx, row, keep)
                 frac = plan.get(op.id)   # already margin-padded
                 if frac is not None and bcur >= 8192:
@@ -402,9 +412,22 @@ def runtime_output_columns(input_schema: T.RowType,
 
 
 def _emit_op(ctx: EmitCtx, op: L.LogicalOperator, row: CV, keep,
-             names: Optional[tuple], general: bool = False):
+             names: Optional[tuple], general: bool = False,
+             speculate: bool = False):
+    prof = None
+    if speculate and not general:
+        # the GENERAL tier must never speculate: it is where cold-arm rows
+        # land, so pruning there would bounce them straight to the
+        # interpreter
+        bp = getattr(op, "branch_profile", None)
+        if bp is not None:
+            try:
+                prof = bp()
+            except Exception:
+                prof = None
     em = Emitter(ctx, getattr(op, "udf", None).globals
-                 if getattr(op, "udf", None) else {})
+                 if getattr(op, "udf", None) else {},
+                 branch_profile=prof)
     frame = Frame(em, {})
     if isinstance(op, L.MapOperator):
         res = em.eval_udf(op.udf, [row])
@@ -650,6 +673,15 @@ def plan_stages(sink: L.LogicalOperator, options=None):
 
                 out_req = agg_required_columns(nxt.op)
             _apply_projection(st, out_req)
+    # sample-driven branch speculation (reference: normal-case dead-branch
+    # removal, RemoveDeadBranchesVisitor.cc; on by default there too).
+    # Applied BEFORE segmentation so the compile probes see the same
+    # speculation state the execution will.
+    if options is not None and not options.get_bool(
+            "tuplex.optimizer.speculateBranches", True):
+        for st in stages:
+            if isinstance(st, TransformStage):
+                st.speculate_branches = False
     # segment each transform stage so one non-compilable UDF doesn't sink
     # the whole fused pipeline to the interpreter
     out: list = []
@@ -744,22 +776,40 @@ import itertools as _it
 _uid_counter = _it.count()
 
 
-def op_compiles(op: L.LogicalOperator, input_schema: T.RowType) -> bool:
+def op_compiles(op: L.LogicalOperator, input_schema: T.RowType,
+                speculate: bool = True) -> bool:
     """Abstract-trace ONE operator against its input schema (tiny shapes,
     jax.eval_shape: no device work) — False if the emitter rejects it.
-    Cached per (op, schema): operators are immutable once planned and this
-    runs on EVERY action otherwise (~100ms per probe)."""
+    Cached per (op, schema, speculation state): operators are immutable
+    once planned, but the probe's verdict can depend on the branch profile
+    (a pruned cold arm may hide a non-compilable construct), so the key
+    carries the same profile signature the jit cache does."""
     if isinstance(op, (L.ResolveOperator, L.IgnoreOperator, L.TakeOperator)):
         return True
-    ck = (_op_identity(op), input_schema.name)
+    ck = (_op_identity(op), input_schema.name,
+          _branch_profile_sig(op) if speculate else None)
     hit = _op_compiles_cache.get(ck)
     if hit is not None:
         return hit
-    result = _op_compiles_uncached(op, input_schema)
+    result = _op_compiles_uncached(op, input_schema, speculate)
     if len(_op_compiles_cache) > 4096:
         _op_compiles_cache.clear()
     _op_compiles_cache[ck] = result
     return result
+
+
+def _branch_profile_sig(op) -> str:
+    """Stable signature of an operator's sample branch observations (empty
+    when the op has none). Feeds every cache whose value depends on the
+    speculated kernel: stage.key() and the compile-probe cache."""
+    bp = getattr(op, "branch_profile", None)
+    if bp is None:
+        return ""
+    try:
+        prof = bp()
+    except Exception:
+        return ""
+    return repr(sorted(prof.items())) if prof else ""
 
 
 def _op_identity(op: L.LogicalOperator) -> str:
@@ -832,7 +882,8 @@ def abstract_batch_arrays(input_schema: T.RowType):
 
 
 def _op_compiles_uncached(op: L.LogicalOperator,
-                          input_schema: T.RowType) -> bool:
+                          input_schema: T.RowType,
+                          speculate: bool = True) -> bool:
     from ..runtime.jaxcfg import jax
 
     arrays = abstract_batch_arrays(input_schema)
@@ -844,6 +895,7 @@ def _op_compiles_uncached(op: L.LogicalOperator,
     # input_op=op is wrong for schema purposes; build fn against the given
     # input schema directly
     probe.input_schema = input_schema
+    probe.speculate_branches = speculate
     fn = probe.build_device_fn()
     try:
         jax.eval_shape(fn, arrays)
@@ -870,7 +922,8 @@ def segment_stage(stage: TransformStage) -> list:
         if isinstance(op, (L.ResolveOperator, L.IgnoreOperator)):
             flags.append(None)
         else:
-            flags.append(op_compiles(op, schema))
+            flags.append(op_compiles(op, schema,
+                                     speculate=stage.speculate_branches))
             schema = op.schema()
     if all(f is not False for f in flags):
         return [stage]
@@ -903,6 +956,7 @@ def segment_stage(stage: TransformStage) -> list:
                                  input_schema=schemas_before[start],
                                  input_op=ops_run[0])
         seg.force_interpret = bad
+        seg.speculate_branches = stage.speculate_branches
         segments.append(seg)
     segments[-1].limit = stage.limit
     return segments
