@@ -101,6 +101,13 @@ def unpack_device_code(packed: int) -> tuple[int, int]:
     return packed & 0xFF, packed >> 8
 
 
+def unpack_device_codes(codes):
+    """Vectorized unpack over a numpy int array -> iterator of (code,
+    op_id) tuples. Same layout as unpack_device_code; per-row python calls
+    measurably hurt at zillow's ~6% error-row rate."""
+    return zip((codes & 0xFF).tolist(), (codes >> 8).tolist())
+
+
 class TuplexException(Exception):
     """Driver-side framework error (not a per-row exception)."""
 

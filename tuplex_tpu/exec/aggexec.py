@@ -573,8 +573,6 @@ def _factorize_keys(part: C.Partition, kidx: list[int], ok_mask: np.ndarray):
     sub = mat[ok_mask]
     if len(sub) == 0:
         return np.zeros(0, np.int32), np.zeros(0, np.int64)
-    view = sub.view([("v", np.void, sub.shape[1])]).ravel()
-    uniq, first_idx, inverse = np.unique(view, return_index=True,
-                                         return_inverse=True)
+    inverse, first_idx = C.unique_rows(sub)
     ok_rows = np.nonzero(ok_mask)[0]
-    return inverse.astype(np.int32), ok_rows[first_idx]
+    return inverse, ok_rows[first_idx]

@@ -24,7 +24,8 @@ import numpy as np
 from ..core import typesys as T
 from ..core.errors import (ExceptionCode, NotCompilable, TuplexException,
                            code_for_exception, exception_class_for_code,
-                           exception_name, unpack_device_code)
+                           exception_name, unpack_device_code,
+                           unpack_device_codes)
 from ..core.row import Row
 from ..plan import logical as L
 from ..plan.physical import TransformStage
@@ -601,8 +602,7 @@ class LocalBackend:
             # change its outcome, so it skips that tier either way.
             codes = err[err_idx]
             device_codes.update(
-                zip(err_idx.tolist(),
-                    map(unpack_device_code, codes.tolist())))
+                zip(err_idx.tolist(), unpack_device_codes(codes)))
             compiled_ok = rowvalid & keep & (err == 0)
             fold_vals = []
             while f"#fold{len(fold_vals)}" in outs:
@@ -764,8 +764,7 @@ class LocalBackend:
             bad_j = np.nonzero(~ok)[0]
             codes = err[bad_j]
             device_codes.update(
-                zip(idx[bad_j].tolist(),
-                    map(unpack_device_code, codes.tolist())))
+                zip(idx[bad_j].tolist(), unpack_device_codes(codes)))
         if not ok.any():
             return
         out_arrays = {kk: np.asarray(v) for kk, v in outs.items()}

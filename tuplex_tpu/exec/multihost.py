@@ -261,12 +261,14 @@ class MultiHostBackend(LocalBackend):
         resolved_local: dict = {}
         fb_set = set(local_fb)
         if fb_set and not self.interpret_only:
-            from ..core.errors import unpack_device_code
+            from ..core.errors import unpack_device_codes
 
             dc = {}
             if err is not None:
-                dc = {i: unpack_device_code(int(err[lo + i]))
-                      for i in local_fb}
+                import numpy as _np
+
+                codes = _np.asarray(err)[_np.asarray(local_fb) + lo]
+                dc = dict(zip(local_fb, unpack_device_codes(codes)))
             t1 = time.perf_counter()
             try:
                 self._general_case_pass(stage, part, fb_set,

@@ -709,6 +709,38 @@ def gather_partition(part: Partition, out_positions: np.ndarray,
                      start_index=part.start_index)
 
 
+def unique_rows(sub: np.ndarray):
+    """np.unique(view_as_void, return_index, return_inverse) semantics over
+    the rows of a [N, W] uint8 matrix — (inverse int32, first_idx int64),
+    groups numbered in byte-lexicographic order, first_idx = smallest
+    original row index per group.
+
+    np.unique on a void view argsorts with generic memcmp comparisons
+    (~38ms for 60k x 24 on one core — half of tpch q1's aggregate cost);
+    a stable lexsort over big-endian u64 lanes is typed and ~10x faster."""
+    n, w = sub.shape
+    if n == 0:
+        return np.zeros(0, np.int32), np.zeros(0, np.int64)
+    wp = -(-max(w, 1) // 8) * 8
+    if wp != w:
+        sub = np.pad(sub, ((0, 0), (0, wp - w)))
+    # big-endian lanes: u64 numeric order == byte-lexicographic order
+    cols = np.ascontiguousarray(sub).view(">u8").reshape(n, wp // 8)
+    order = np.lexsort(cols.T[::-1])     # primary key = first lane
+    s = cols[order]
+    bound = np.empty(n, dtype=bool)
+    bound[0] = True
+    if n > 1:
+        np.any(s[1:] != s[:-1], axis=1, out=bound[1:])
+    gid_sorted = np.cumsum(bound) - 1
+    inverse = np.empty(n, dtype=np.int64)
+    inverse[order] = gid_sorted
+    # lexsort is stable -> the boundary row of each group carries the
+    # smallest original index among its equals
+    first_idx = order[np.nonzero(bound)[0]]
+    return inverse.astype(np.int32), first_idx.astype(np.int64)
+
+
 def key_signature_matrix(part: Partition, cis: Sequence[int],
                          reject_nan: bool = True) -> Optional[np.ndarray]:
     """[N, W] canonical byte-signature matrix over the given key columns,
