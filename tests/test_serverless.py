@@ -381,3 +381,36 @@ def test_serverless_remote_scheme_staging(tmp_path, monkeypatch, request):
     files_left = [f for _, _, fs in os.walk(root) for f in fs]
     assert not files_left, f"sweep left objects behind: {files_left}"
     assert not c.backend.failure_log, c.backend.failure_log
+
+
+def test_worker_task_events_stream_to_dashboard(tmp_path):
+    """VERDICT r4 #8: fan-out tasks must be visible in the history while
+    the job runs — workers append events.jsonl, the driver's poll loop
+    streams them into the recorder, the dashboard renders per-task rows."""
+    import json
+
+    c = _ctx(tmp_path,
+             **{"tuplex.webui.enable": True,
+                "tuplex.logDir": str(tmp_path),
+                "tuplex.webui.autostart": False})
+    data = [(i, f"s{i}") for i in range(5000)]
+    got = (c.parallelize(data, columns=["a", "s"])
+           .map(lambda x: (x["a"] * 2, x["s"]))
+           .collect())
+    assert got == [(a * 2, s) for a, s in data]
+    hist = tmp_path / "tuplex_history.jsonl"
+    recs = [json.loads(ln) for ln in open(hist)]
+    task_evs = [r for r in recs if r.get("event") == "task"]
+    assert task_evs, "no worker task events reached the history"
+    started = {r["task"] for r in task_evs if r.get("kind") == "started"}
+    done = {r["task"] for r in task_evs if r.get("kind") == "done"}
+    assert started and done and done <= started
+    # done events carry rows + exception counts
+    d0 = next(r for r in task_evs if r.get("kind") == "done")
+    assert "rows" in d0 and "exceptions" in d0 and d0.get("pid")
+    # the dashboard renders per-task rows
+    from tuplex_tpu.history.recorder import render_report
+
+    out = render_report(str(tmp_path), str(tmp_path / "report.html"))
+    html_doc = open(out).read()
+    assert "task 0" in html_doc

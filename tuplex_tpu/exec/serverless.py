@@ -428,6 +428,8 @@ class ServerlessBackend(LocalBackend):
         done: dict[int, Optional[str]] = {}   # task -> outdir (None = local)
         pending = list(range(len(tasks)))
         attempts = {t: 0 for t in pending}
+        recorder = getattr(context, "recorder", None)
+        ev_offsets: dict[int, int] = {}
         try:
             while pending or procs:
                 check_interrupted()
@@ -437,7 +439,12 @@ class ServerlessBackend(LocalBackend):
                                              tasks[t], req_base),
                                 time.perf_counter(), attempts[t])
                 self._reap(procs, done, pending, attempts, tasks, run_dir,
-                           data_dir)
+                           data_dir, recorder=recorder,
+                           ev_offsets=ev_offsets)
+                # only RUNNING tasks can grow their events file; completed
+                # tasks drain once inside _reap at the transition
+                self._pump_task_events(run_dir, ev_offsets, recorder,
+                                       list(procs))
                 if procs:
                     time.sleep(0.02)
         finally:
@@ -495,7 +502,7 @@ class ServerlessBackend(LocalBackend):
                 stdout=logf, stderr=subprocess.STDOUT, env=env)
 
     def _reap(self, procs, done, pending, attempts, tasks, run_dir,
-              data_dir):
+              data_dir, recorder=None, ev_offsets=None):
         now = time.perf_counter()
         for t in list(procs):
             p, started, att = procs[t]
@@ -507,6 +514,10 @@ class ServerlessBackend(LocalBackend):
                 else:
                     continue
             del procs[t]
+            # drain the worker's remaining events exactly once, at the
+            # transition — its file cannot grow after the process exits
+            if ev_offsets is not None:
+                self._pump_task_events(run_dir, ev_offsets, recorder, [t])
             outdir = _djoin(_djoin(data_dir, f"task-{t:04d}"), "out")
             resp = os.path.join(run_dir, f"task-{t:04d}", "response.pkl")
             if rc == 0 and os.path.exists(resp):
@@ -521,10 +532,54 @@ class ServerlessBackend(LocalBackend):
                             t, rc, att + 1, self.retries)
                 attempts[t] = att + 1
                 pending.append(t)
+                if recorder is not None and getattr(recorder, "enabled",
+                                                    False):
+                    recorder.worker_task_event(
+                        t, {"event": "retry", "rc": rc,
+                            "attempt": att + 1})
             else:
                 log.warning("task %d failed after %d attempts; running "
                             "on the driver", t, att + 1)
                 done[t] = None   # degrade: in-process fallback
+                if recorder is not None and getattr(recorder, "enabled",
+                                                    False):
+                    # terminal event: the archival dashboard must not show
+                    # a finished job's task as perpetually running
+                    recorder.worker_task_event(
+                        t, {"event": "fallback", "rc": rc,
+                            "attempt": att + 1})
+
+    @staticmethod
+    def _pump_task_events(run_dir: str, offsets: dict, recorder,
+                          tasks) -> None:
+        """Stream NEW lines of each task's events.jsonl into the history
+        recorder (per-task live updates while the fan-out runs — reference:
+        HistoryServerConnector.cc:102-198; thserver/rest.py task routes).
+        Offsets persist across polls so each event forwards exactly once."""
+        if recorder is None or not getattr(recorder, "enabled", False):
+            return
+        import json
+
+        for t in tasks:
+            path = os.path.join(run_dir, f"task-{t:04d}", "events.jsonl")
+            try:
+                with open(path, "rb") as fp:
+                    base = offsets.get(t, 0)
+                    fp.seek(base)
+                    chunk = fp.read()
+            except OSError:
+                continue
+            # consume only complete lines; a torn tail re-reads next poll
+            last_nl = chunk.rfind(b"\n")
+            if last_nl < 0:
+                continue
+            offsets[t] = base + last_nl + 1
+            for line in chunk[:last_nl].splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                recorder.worker_task_event(t, rec)
 
     @staticmethod
     def _log_tail(run_dir: str, task: int, n: int = 800) -> str:

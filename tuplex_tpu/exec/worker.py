@@ -38,6 +38,27 @@ def main(argv: list[str]) -> int:
     with open(argv[0], "rb") as fp:
         req = pickle.load(fp)
 
+    import json
+    import time
+
+    task_dir = os.path.dirname(os.path.abspath(argv[0]))
+    t_start = time.time()
+
+    def emit(kind: str, **fields) -> None:
+        """Append a live task event for the driver's poll loop to stream
+        into the history dashboard (reference: Lambda workers posting task
+        status back, HistoryServerConnector.cc:102-198). Best-effort: an
+        unwritable control dir must never fail the task."""
+        try:
+            with open(os.path.join(task_dir, "events.jsonl"), "a") as efp:
+                efp.write(json.dumps(
+                    {"event": kind, "pid": os.getpid(), **fields}) + "\n")
+        except OSError:
+            pass
+
+    emit("started", task=req.get("task"),
+         input=(req.get("files") or req.get("indir") or "memory"))
+
     from ..core.options import ContextOptions
     from ..exec.local import LocalBackend
     from ..io.tuplexfmt import (TuplexFileSourceOperator,
@@ -87,6 +108,9 @@ def main(argv: list[str]) -> int:
             "metrics": result.metrics,
             "exceptions": result.exceptions,
             "failure_log": list(backend.failure_log)}
+    emit("done", task=req.get("task"), rows=resp["rows"],
+         exceptions=len(result.exceptions),
+         wall_s=round(time.time() - t_start, 3))
     tmp = os.path.join(os.path.dirname(argv[0]), ".response.tmp")
     with open(tmp, "wb") as fp:
         pickle.dump(resp, fp)

@@ -79,6 +79,16 @@ class JobRecorder:
                      "kind": type(stage).__name__,
                      "metrics": metrics, "exception_sample": sample})
 
+    def worker_task_event(self, task: int, rec: dict) -> None:
+        """LIVE event from a fan-out worker (started / finished, rows,
+        exception count) — streamed off the task's events.jsonl by the
+        driver's poll loop, so remote tasks are visible in the dashboard
+        WHILE the job runs (reference: executors push per-task status to
+        the history server, HistoryServerConnector.cc:102-198)."""
+        self._write({"event": "task", "task": task,
+                     **{k: v for k, v in rec.items() if k != "event"},
+                     "kind": rec.get("event", "update")})
+
     def job_done(self, rows: int, wall_s: float, exc_counts: dict) -> None:
         self._write({"event": "job_done", "rows": rows,
                      "wall_s": round(wall_s, 4),
@@ -146,6 +156,25 @@ def _render_doc(log_dir: str, live: bool) -> str:
                 f"<td>{fast:.3f}</td><td>{slow:.3f}</td>"
                 f"<td>{html.escape(json.dumps(excs)) if excs else '—'}"
                 f"</td></tr>")
+        # per-task rows from fan-out workers (serverless/multihost)
+        tasks: dict = {}
+        for e in events:
+            if e.get("event") == "task":
+                tasks.setdefault(e.get("task"), []).append(e)
+        for t in sorted(tasks, key=lambda x: (x is None, x)):
+            last = tasks[t][-1]
+            if last.get("kind") == "done":
+                desc = (f"done — {last.get('rows', '?')} rows, "
+                        f"{last.get('exceptions', 0)} exception(s), "
+                        f"{last.get('wall_s', '?')}s")
+            elif last.get("kind") == "fallback":
+                desc = (f"failed after {last.get('attempt', '?')} "
+                        f"attempt(s) — completed on the driver")
+            else:
+                desc = f"{last.get('kind', 'running')} (pid {last.get('pid', '?')})"
+            rows_html.append(
+                f"<tr class=task><td colspan=7>&nbsp;&nbsp;task "
+                f"{html.escape(str(t))}: {html.escape(desc)}</td></tr>")
         for e in stages:
             for s in e.get("exception_sample", []):
                 rows_html.append(
@@ -163,6 +192,7 @@ def _render_doc(log_dir: str, live: bool) -> str:
            border-bottom: 1px solid #ddd; }}
  th {{ background: #f5f5f5; }}
  tr.exc td {{ color: #a33; font-size: 12px; border-bottom: none; }}
+ tr.task td {{ color: #567; font-size: 12px; border-bottom: none; }}
  tr.running td {{ color: #0a6; font-style: italic; }}
  code {{ background: #f0f0f0; padding: 0 .3em; }}
 </style>
