@@ -1,0 +1,237 @@
+"""Exercise the REAL S3Backend code path (tuplex_tpu/io/vfs.py:S3Backend)
+against a local S3-compatible HTTP server — NOT MemoryObjectStore.
+
+boto3 is not importable in this image, so the boto3 *client* is a minimal
+stand-in implementing exactly the client surface S3Backend consumes
+(get_paginator("list_objects_v2"), get_object, put_object, head_object,
+delete_object) over a real HTTP hop to a local server speaking S3-style
+REST (XML ListBucketResult with continuation-token pagination, GET/PUT/
+HEAD/DELETE on /bucket/key). Every byte crosses a socket; list results
+arrive paginated so S3Backend.ls's paginator loop runs multiple pages.
+
+Reference: io/src/S3FileSystemImpl.cc (the reference's S3 path is tested
+only against live AWS; this keeps the same backend code CI-testable).
+"""
+
+import io
+import threading
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from xml.etree import ElementTree
+from xml.sax.saxutils import escape
+
+import pytest
+
+from tuplex_tpu.io.vfs import S3Backend, VirtualFileSystem
+
+PAGE_SIZE = 2  # force multi-page listings even for tiny buckets
+
+
+class _S3Handler(BaseHTTPRequestHandler):
+    """S3-flavored REST over a dict of objects: enough of the protocol for
+    list-objects-v2 (prefix + continuation-token + max-keys), GET, PUT,
+    HEAD, DELETE."""
+
+    server_version = "StubS3/1.0"
+
+    def log_message(self, fmt, *args):  # keep pytest output clean
+        pass
+
+    def _split(self):
+        parsed = urllib.parse.urlparse(self.path)
+        parts = parsed.path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+        query = urllib.parse.parse_qs(parsed.query)
+        return bucket, key, query
+
+    def _respond(self, code: int, body: bytes = b"",
+                 ctype: str = "application/xml"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(body)
+
+    def do_GET(self):
+        bucket, key, query = self._split()
+        store = self.server.objects
+        if not key and "list-type" in query:
+            prefix = query.get("prefix", [""])[0]
+            token = query.get("continuation-token", [""])[0]
+            keys = sorted(k for (b, k) in store if b == bucket
+                          and k.startswith(prefix) and k > token)
+            page, rest = keys[:PAGE_SIZE], keys[PAGE_SIZE:]
+            contents = "".join(
+                f"<Contents><Key>{escape(k)}</Key>"
+                f"<Size>{len(store[(bucket, k)])}</Size></Contents>"
+                for k in page)
+            trunc = "true" if rest else "false"
+            nxt = (f"<NextContinuationToken>{escape(page[-1])}"
+                   f"</NextContinuationToken>") if rest else ""
+            body = (f"<ListBucketResult><IsTruncated>{trunc}</IsTruncated>"
+                    f"{nxt}{contents}</ListBucketResult>").encode()
+            self._respond(200, body)
+            return
+        data = store.get((bucket, key))
+        if data is None:
+            self._respond(404, b"<Error><Code>NoSuchKey</Code></Error>")
+            return
+        self._respond(200, data, ctype="application/octet-stream")
+
+    def do_HEAD(self):
+        bucket, key, _ = self._split()
+        data = self.server.objects.get((bucket, key))
+        if data is None:
+            self._respond(404)
+            return
+        self._respond(200, data, ctype="application/octet-stream")
+
+    def do_PUT(self):
+        bucket, key, _ = self._split()
+        n = int(self.headers.get("Content-Length", "0"))
+        self.server.objects[(bucket, key)] = self.rfile.read(n)
+        self._respond(200)
+
+    def do_DELETE(self):
+        bucket, key, _ = self._split()
+        self.server.objects.pop((bucket, key), None)
+        self._respond(204)
+
+
+class _StubS3Paginator:
+    def __init__(self, endpoint: str):
+        self._endpoint = endpoint
+
+    def paginate(self, Bucket: str, Prefix: str = ""):
+        token = ""
+        while True:
+            q = {"list-type": "2", "prefix": Prefix,
+                 "max-keys": str(PAGE_SIZE)}
+            if token:
+                q["continuation-token"] = token
+            url = (f"{self._endpoint}/{Bucket}?"
+                   f"{urllib.parse.urlencode(q)}")
+            with urllib.request.urlopen(url) as resp:
+                root = ElementTree.fromstring(resp.read())
+            page = {"Contents": [
+                {"Key": c.findtext("Key"),
+                 "Size": int(c.findtext("Size"))}
+                for c in root.iter("Contents")]}
+            yield page
+            if root.findtext("IsTruncated") != "true":
+                return
+            token = root.findtext("NextContinuationToken") or ""
+
+
+class _StubBoto3Client:
+    """The exact boto3.client('s3') surface S3Backend consumes, speaking
+    HTTP to the stub server. Errors surface as exceptions like botocore's
+    ClientError would (S3Backend does not catch them)."""
+
+    def __init__(self, endpoint: str):
+        self._endpoint = endpoint
+
+    def _url(self, bucket: str, key: str) -> str:
+        return f"{self._endpoint}/{bucket}/{urllib.parse.quote(key)}"
+
+    def get_paginator(self, name: str):
+        assert name == "list_objects_v2"
+        return _StubS3Paginator(self._endpoint)
+
+    def get_object(self, Bucket: str, Key: str):
+        with urllib.request.urlopen(self._url(Bucket, Key)) as resp:
+            return {"Body": io.BytesIO(resp.read())}
+
+    def put_object(self, Bucket: str, Key: str, Body: bytes):
+        req = urllib.request.Request(self._url(Bucket, Key), data=Body,
+                                     method="PUT")
+        urllib.request.urlopen(req).close()
+        return {}
+
+    def head_object(self, Bucket: str, Key: str):
+        req = urllib.request.Request(self._url(Bucket, Key), method="HEAD")
+        with urllib.request.urlopen(req) as resp:
+            return {"ContentLength": int(resp.headers["Content-Length"])}
+
+    def delete_object(self, Bucket: str, Key: str):
+        req = urllib.request.Request(self._url(Bucket, Key),
+                                     method="DELETE")
+        urllib.request.urlopen(req).close()
+        return {}
+
+
+@pytest.fixture()
+def s3_http():
+    """A live stub-S3 server + the real S3Backend registered for s3://."""
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _S3Handler)
+    server.objects = {}
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    endpoint = f"http://127.0.0.1:{server.server_address[1]}"
+    backend = S3Backend(client=_StubBoto3Client(endpoint))
+    prev = VirtualFileSystem._backends.get("s3")
+    VirtualFileSystem.register_backend("s3", backend)
+    try:
+        yield server
+    finally:
+        if prev is None:
+            VirtualFileSystem._backends.pop("s3", None)
+        else:
+            VirtualFileSystem.register_backend("s3", prev)
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=5)
+
+
+def test_s3_backend_object_ops(s3_http):
+    vfs = VirtualFileSystem
+    with vfs.open_write("s3://bkt/dir/a.txt") as f:
+        f.write(b"hello s3")
+    assert s3_http.objects[("bkt", "dir/a.txt")] == b"hello s3"
+    assert vfs.file_size("s3://bkt/dir/a.txt") == 8
+    with vfs.open_read("s3://bkt/dir/a.txt") as f:
+        assert f.read() == b"hello s3"
+    vfs.rm("s3://bkt/dir/a.txt")
+    assert ("bkt", "dir/a.txt") not in s3_http.objects
+
+
+def test_s3_backend_ls_paginates(s3_http):
+    # 5 keys at PAGE_SIZE=2 -> the paginator loop must walk 3 pages
+    for i in range(5):
+        s3_http.objects[("bkt", f"data/part{i}.csv")] = b"x"
+    s3_http.objects[("bkt", "data/nested/deep.csv")] = b"y"
+    got = VirtualFileSystem.ls("s3://bkt/data/*.csv")
+    assert got == [f"s3://bkt/data/part{i}.csv" for i in range(5)]
+    got_all = VirtualFileSystem.ls("s3://bkt/data/**.csv")
+    assert "s3://bkt/data/nested/deep.csv" in got_all
+
+
+def test_s3_csv_roundtrip_pipeline(s3_http):
+    """csv -> compiled stage -> tocsv entirely through s3:// URIs, with
+    multi-file input (paginated listing) and part-file output."""
+    import tuplex_tpu
+
+    vfs = VirtualFileSystem
+    rows = [(i, f"n{i}") for i in range(30)]
+    for shard in range(3):
+        lines = ["a,b"] + [f"{i},{s}" for i, s in rows[shard::3]]
+        with vfs.open_write(f"s3://bkt/in/part{shard}.csv") as f:
+            f.write(("\n".join(lines) + "\n").encode())
+
+    ctx = tuplex_tpu.Context()
+    (ctx.csv("s3://bkt/in/*.csv")
+        .filter(lambda x: x["a"] % 2 == 0)
+        .withColumn("c", lambda x: x["a"] * 10)
+        .tocsv("s3://bkt/out/"))
+
+    parts = vfs.ls("s3://bkt/out/**")
+    assert parts, "no output objects written to s3://bkt/out/"
+    text = "".join(vfs.open_read(p).read().decode() for p in parts)
+    lines = [ln for ln in text.splitlines() if ln and not ln.startswith("a,")]
+    got = sorted(tuple(c.strip('"') for c in ln.split(","))
+                 for ln in lines)
+    want = sorted((str(i), s, str(i * 10)) for i, s in rows if i % 2 == 0)
+    assert got == want
