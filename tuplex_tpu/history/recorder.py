@@ -85,8 +85,10 @@ class JobRecorder:
         driver's poll loop, so remote tasks are visible in the dashboard
         WHILE the job runs (reference: executors push per-task status to
         the history server, HistoryServerConnector.cc:102-198)."""
-        self._write({"event": "task", "task": task,
-                     **{k: v for k, v in rec.items() if k != "event"},
+        self._write({**{k: v for k, v in rec.items()
+                        if k not in ("event", "task", "kind", "no")},
+                     "event": "task", "task": task,
+                     "no": self._stage_no + 1,
                      "kind": rec.get("event", "update")})
 
     def job_done(self, rows: int, wall_s: float, exc_counts: dict) -> None:
@@ -160,8 +162,12 @@ def _render_doc(log_dir: str, live: bool) -> str:
         tasks: dict = {}
         for e in events:
             if e.get("event") == "task":
-                tasks.setdefault(e.get("task"), []).append(e)
-        for t in sorted(tasks, key=lambda x: (x is None, x)):
+                # key on (stage, task): a job with several fan-out stages
+                # reuses task numbers per stage
+                tasks.setdefault((e.get("no"), e.get("task")), []).append(e)
+        multi_stage = len({k[0] for k in tasks}) > 1
+        for t in sorted(tasks, key=lambda x: (x[0] is None, x[0],
+                                              x[1] is None, x[1])):
             last = tasks[t][-1]
             if last.get("kind") == "done":
                 desc = (f"done — {last.get('rows', '?')} rows, "
@@ -172,9 +178,11 @@ def _render_doc(log_dir: str, live: bool) -> str:
                         f"attempt(s) — completed on the driver")
             else:
                 desc = f"{last.get('kind', 'running')} (pid {last.get('pid', '?')})"
+            label = (f"stage {t[0]} task {t[1]}" if multi_stage
+                     else f"task {t[1]}")
             rows_html.append(
-                f"<tr class=task><td colspan=7>&nbsp;&nbsp;task "
-                f"{html.escape(str(t))}: {html.escape(desc)}</td></tr>")
+                f"<tr class=task><td colspan=7>&nbsp;&nbsp;"
+                f"{html.escape(label)}: {html.escape(desc)}</td></tr>")
         for e in stages:
             for s in e.get("exception_sample", []):
                 rows_html.append(
