@@ -1,0 +1,100 @@
+"""Count device round-trips in one warm zillow run on the live chip.
+
+Patches the three host<->device seams (device_put staging, compiled stage-fn
+executions, D2H materialization) and reports count + wall per seam for the
+steady-state (2nd) run. The ~62ms/execution tunnel tax (perf_probe.py) makes
+round-trip count the dominant perf variable.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+COUNTS = {}
+
+
+def _tick(name, sec):
+    c, s = COUNTS.get(name, (0, 0.0))
+    COUNTS[name] = (c + 1, s + sec)
+
+
+_orig_put = jax.device_put
+
+
+def put(x, *a, **k):
+    t0 = time.perf_counter()
+    r = _orig_put(x, *a, **k)
+    _tick("device_put", time.perf_counter() - t0)
+    return r
+
+
+jax.device_put = put
+
+_orig_asarray = np.asarray
+
+
+def asarray(x, *a, **k):
+    isdev = isinstance(x, jax.Array) and not isinstance(x, np.ndarray)
+    t0 = time.perf_counter()
+    r = _orig_asarray(x, *a, **k)
+    if isdev:
+        _tick("np.asarray(devarr)", time.perf_counter() - t0)
+    return r
+
+
+np.asarray = asarray
+
+import tuplex_tpu
+from tuplex_tpu.exec.local import LocalBackend
+
+_orig_jit = LocalBackend._jit_stage_fn
+
+
+def jit_counted(self, raw_fn):
+    fn = _orig_jit(self, raw_fn)
+
+    def wrapped(*a, **k):
+        t0 = time.perf_counter()
+        leaves = jax.tree.leaves((a, k))
+        nbytes = sum(getattr(x, "nbytes", 0) for x in leaves)
+        da, dk = _orig_put((a, k))
+        jax.block_until_ready(jax.tree.leaves((da, dk)))
+        t1 = time.perf_counter()
+        _tick(f"h2d_stage_args[{nbytes >> 20}MB]", t1 - t0)
+        out = fn(*da, **dk)
+        jax.block_until_ready(out)
+        t2 = time.perf_counter()
+        _tick(f"stage_fn_exec[{nbytes >> 20}MB]", t2 - t1)
+        host = jax.device_get(out)
+        obytes = sum(getattr(x, "nbytes", 0) for x in jax.tree.leaves(host))
+        _tick(f"d2h_outputs[{obytes >> 20}MB]", time.perf_counter() - t2)
+        return host
+
+    return wrapped
+
+
+LocalBackend._jit_stage_fn = jit_counted
+
+from tuplex_tpu.models import zillow
+
+path = "/tmp/tuplex_tpu_bench/zillow_100000.csv"
+if not os.path.exists(path):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    zillow.generate_csv(path, 100000)
+
+ctx = tuplex_tpu.Context()
+zillow.build_pipeline(ctx.csv(path)).collect()  # warm: compile + transfers
+COUNTS.clear()
+t0 = time.perf_counter()
+rows = zillow.build_pipeline(ctx.csv(path)).collect()
+total = time.perf_counter() - t0
+print(f"steady run: {total:.3f}s  rows={len(rows)}")
+acc = 0.0
+for name, (c, s) in sorted(COUNTS.items(), key=lambda kv: -kv[1][1]):
+    acc += s
+    print(f"  {name:24s} calls={c:5d}  wall={s:.3f}s")
+print(f"  {'(unattributed host)':24s}              wall={total-acc:.3f}s")

@@ -1,0 +1,70 @@
+"""Measure the x64 emulation tax on the live chip for framework-shaped ops.
+
+v5e has no native i64/f64: XLA emulates both. The framework traces under
+jax_enable_x64=True for CPython parity; this probe prices that choice on the
+byte-matrix kernels' dominant primitives so narrowing work can be targeted.
+"""
+import json
+import time
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import numpy as np
+
+
+def t(fn, n=5):
+    fn()
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+R, W = 106496, 96
+mat = jax.device_put(np.random.randint(48, 58, (R, W), np.uint8))
+mat.block_until_ready()
+
+for name, dt in (("i32", jnp.int32), ("i64", jnp.int64)):
+    f = jax.jit(lambda m, dt=dt: jnp.cumsum(m.astype(dt), axis=1)[:, -1])
+    sec = t(lambda: f(mat).block_until_ready())
+    print(json.dumps({"probe": f"cumsum_{name}_{R}x{W}", "sec": round(sec, 5)}),
+          flush=True)
+
+for name, dt in (("f32", jnp.float32), ("f64", jnp.float64)):
+    f = jax.jit(lambda m, dt=dt: (m.astype(dt) * 1.0001 + 3.0).sum(axis=1))
+    sec = t(lambda: f(mat).block_until_ready())
+    print(json.dumps({"probe": f"fma_{name}_{R}x{W}", "sec": round(sec, 5)}),
+          flush=True)
+
+# digit-parse shape: per-row positional powers (the int-parse kernel's core)
+for name, dt in (("i32", jnp.int32), ("i64", jnp.int64)):
+    pw = jnp.cumprod(jnp.full((W,), 10, dt)[::-1])[::-1]
+
+    def parse(m, pw=pw, dt=dt):
+        d = (m - 48).astype(dt)
+        return (d * pw[None, :]).sum(axis=1)
+
+    f = jax.jit(parse)
+    sec = t(lambda: f(mat).block_until_ready())
+    print(json.dumps({"probe": f"digitparse_{name}", "sec": round(sec, 5)}),
+          flush=True)
+
+# sort (replace-deletion kernel core)
+key = jax.device_put(np.random.randint(0, 1 << 20, (R, 64), np.int32))
+key.block_until_ready()
+for name, dt in (("i32", jnp.int32), ("i64", jnp.int64)):
+    f = jax.jit(lambda k, dt=dt: jnp.sort(k.astype(dt), axis=1))
+    sec = t(lambda: f(key).block_until_ready())
+    print(json.dumps({"probe": f"rowsort_{name}_{R}x64", "sec": round(sec, 5)}),
+          flush=True)
+
+# gather (string indexing / compaction core)
+idx = jax.device_put(np.random.randint(0, R, (R,), np.int32))
+idx.block_until_ready()
+f = jax.jit(lambda m, i: m[i])
+sec = t(lambda: f(mat, idx).block_until_ready())
+print(json.dumps({"probe": "gather_rows_u8", "sec": round(sec, 5)}), flush=True)

@@ -1,0 +1,86 @@
+"""Price TPU alternatives to per-row u8 gathers (the profiled hot spot).
+
+take_along_axis on u8[N,W] runs on the scalar core (~48ms for [81920,56] in
+the zillow stage profile). Candidates:
+  B. shift-sum: for idx = start+arange(W) (slices/shifts), accumulate W
+     statically-shifted copies weighted by (start == s).
+  C. one-hot bf16 matmul: out[n,j] = sum_k B[n,k] * (idx[n,j] == k) — exact
+     for byte values (<=255 fits bf16's 8-bit mantissa; one term per sum).
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+
+def t(fn, n=5):
+    fn()
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+N, W = 81920, 56
+B = jax.device_put(np.random.randint(32, 127, (N, W), np.uint8))
+start = jax.device_put(np.random.randint(0, W, (N,), np.int32))
+idx = jax.device_put(np.random.randint(0, W, (N, W), np.int32))
+jax.block_until_ready((B, start, idx))
+
+
+@jax.jit
+def gatherA(b, ix):
+    return jnp.take_along_axis(b, ix, axis=1)
+
+
+@jax.jit
+def shiftB(b, s):
+    pad = jnp.pad(b, ((0, 0), (0, W)))
+    acc = jnp.zeros((N, W), jnp.uint8)
+    for sh in range(W):
+        acc = acc + jnp.where((s == sh)[:, None], pad[:, sh:sh + W], 0)
+    return acc
+
+
+@jax.jit
+def onehotC(b, ix):
+    oh = (ix[:, :, None] == jnp.arange(W, dtype=jnp.int32)[None, None, :])
+    out = jnp.einsum("njk,nk->nj", oh.astype(jnp.bfloat16),
+                     b.astype(jnp.bfloat16),
+                     preferred_element_type=jnp.float32)
+    return out.astype(jnp.uint8)
+
+
+@jax.jit
+def onehotC_shift(b, s):
+    ix = jnp.clip(s[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :],
+                  0, W - 1)
+    oh = (ix[:, :, None] == jnp.arange(W, dtype=jnp.int32)[None, None, :])
+    out = jnp.einsum("njk,nk->nj", oh.astype(jnp.bfloat16),
+                     b.astype(jnp.bfloat16),
+                     preferred_element_type=jnp.float32)
+    return out.astype(jnp.uint8)
+
+
+ixs = jnp.clip(start[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :],
+               0, W - 1)
+want_shift = np.asarray(gatherA(B, ixs))
+want_arb = np.asarray(gatherA(B, idx))
+
+for name, fn, args, want in (
+        ("A_take_along_shift", gatherA, (B, ixs), want_shift),
+        ("A_take_along_arb", gatherA, (B, idx), want_arb),
+        ("B_shiftsum", shiftB, (B, start), want_shift),
+        ("C_onehot_arb", onehotC, (B, idx), want_arb),
+        ("C_onehot_shift", onehotC_shift, (B, start), want_shift)):
+    got = np.asarray(fn(*args))
+    ok = bool((got == want).all())
+    sec = t(lambda: fn(*args).block_until_ready())
+    print(json.dumps({"probe": name, "sec": round(sec, 5), "exact": ok}),
+          flush=True)

@@ -1,0 +1,76 @@
+"""Device-time comparison of u8 per-row gather formulations (xplane-based:
+block_until_ready is async-unreliable over the tunnel, so host wall lies;
+the profiler's device timestamps don't)."""
+import collections
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+N, W = 81920, 56
+B = np.random.randint(32, 127, (N, W), np.uint8)
+IX = np.random.randint(0, W, (N, W), np.int32)
+db, dix = jax.device_put(B), jax.device_put(IX)
+jax.block_until_ready((db, dix))
+
+
+def take(b, ix):
+    return jnp.take_along_axis(b, ix, axis=1)
+
+
+def onehot(b, ix):
+    oh = (ix[:, :, None] == jnp.arange(W, dtype=jnp.int32)[None, None, :])
+    o = jnp.einsum("njk,nk->nj", oh.astype(jnp.bfloat16),
+                   b.astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32)
+    return o.astype(jnp.uint8)
+
+
+def take_i32(b, ix):
+    return jnp.take_along_axis(b.astype(jnp.int32), ix, axis=1) \
+        .astype(jnp.uint8)
+
+
+fns = {"take_u8": take, "onehot_mxu": onehot, "take_i32": take_i32}
+compiled = {k: jax.jit(v) for k, v in fns.items()}
+for k, f in compiled.items():
+    got = np.asarray(f(db, dix))
+    want = np.take_along_axis(B, IX, axis=1)
+    assert (got == want).all(), k
+
+TR = "/tmp/tpx_trace_gather"
+os.system(f"rm -rf {TR}")
+with jax.profiler.trace(TR):
+    for k, f in compiled.items():
+        for _ in range(3):
+            f(db, dix).block_until_ready()
+
+from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+xs = sorted(glob.glob(f"{TR}/**/*.xplane.pb", recursive=True),
+            key=os.path.getmtime)
+sp = xplane_pb2.XSpace()
+sp.ParseFromString(open(xs[-1], "rb").read())
+for plane in sp.planes:
+    if "TPU" not in plane.name:
+        continue
+    md = plane.event_metadata
+    for line in plane.lines:
+        if line.name != "XLA Modules":
+            continue
+        agg = collections.Counter()
+        cnt = collections.Counter()
+        for ev in line.events:
+            name = md[ev.metadata_id].name
+            agg[name] += ev.duration_ps / 1e6
+            cnt[name] += 1
+        for name, us in agg.most_common(10):
+            print(json.dumps({"module": name.split("(")[0],
+                              "total_us": round(us),
+                              "runs": cnt[name],
+                              "per_run_ms": round(us / cnt[name] / 1e3, 2)}))

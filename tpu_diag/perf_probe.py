@@ -1,0 +1,96 @@
+"""On-chip perf characterization of the tunneled TPU data plane.
+
+Measures the three costs that bound end-to-end pipeline throughput on the
+axon tunnel: (1) per-dispatch RPC latency, (2) H2D/D2H bandwidth,
+(3) raw on-chip compute throughput (MXU matmul + VPU elementwise on the
+byte-matrix shapes the framework actually ships).
+
+Run: python tpu_diag/perf_probe.py   (prints one JSON line per probe)
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def t(fn, n=5):
+    fn()  # warm
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    dev = jax.devices()[0]
+    print(json.dumps({"probe": "device", "platform": dev.platform,
+                      "kind": getattr(dev, "device_kind", "?")}), flush=True)
+
+    # 1. dispatch latency: trivial kernel roundtrip
+    one = jnp.ones((8, 8), jnp.float32)
+    f = jax.jit(lambda x: x + 1)
+    lat = t(lambda: f(one).block_until_ready(), n=20)
+    print(json.dumps({"probe": "dispatch_latency_ms",
+                      "value": round(lat * 1e3, 2)}), flush=True)
+
+    # 2. H2D bandwidth at framework-like sizes
+    for mb in (1, 8, 32, 128):
+        host = np.zeros((mb << 20,), np.uint8)
+        sec = t(lambda: jax.device_put(host).block_until_ready(), n=3)
+        print(json.dumps({"probe": f"h2d_{mb}MB",
+                          "sec": round(sec, 4),
+                          "MBps": round(mb / sec, 1)}), flush=True)
+
+    # 3. D2H bandwidth
+    for mb in (1, 32):
+        devarr = jax.device_put(np.zeros((mb << 20,), np.uint8))
+        devarr.block_until_ready()
+        sec = t(lambda: np.asarray(devarr), n=3)
+        print(json.dumps({"probe": f"d2h_{mb}MB",
+                          "sec": round(sec, 4),
+                          "MBps": round(mb / sec, 1)}), flush=True)
+
+    # 4. MXU: bf16 matmul FLOPs
+    for n in (1024, 4096):
+        a = jnp.ones((n, n), jnp.bfloat16)
+        mm = jax.jit(lambda x: x @ x)
+        sec = t(lambda: mm(a).block_until_ready(), n=5)
+        tflops = 2 * n ** 3 / sec / 1e12
+        print(json.dumps({"probe": f"matmul_bf16_{n}",
+                          "sec": round(sec, 5),
+                          "TFLOPs": round(tflops, 2)}), flush=True)
+
+    # 5. VPU elementwise on a framework-shaped byte matrix (100k x 200B):
+    #    the zillow batch is ~20 uint8 columns; model one fused pass over it.
+    rows = 106496
+    mat = jax.device_put(np.zeros((rows, 200), np.uint8))
+    mat.block_until_ready()
+
+    def stagelike(m):
+        x = m.astype(jnp.int32)
+        d = (x >= ord("0")) & (x <= ord("9"))
+        acc = jnp.where(d, x - 48, 0).cumsum(axis=1)
+        return (acc[:, -1] % 251).astype(jnp.uint8)
+
+    g = jax.jit(stagelike)
+    sec = t(lambda: g(mat).block_until_ready(), n=5)
+    print(json.dumps({"probe": "vpu_bytepass_106k_200B",
+                      "sec": round(sec, 5),
+                      "rows_per_sec": round(rows / sec, 0)}), flush=True)
+
+    # 6. many-small-dispatch cost (the window pipeline's per-partition cost)
+    small = jax.device_put(np.zeros((2048, 200), np.uint8))
+    small.block_until_ready()
+    sec = t(lambda: [g2.block_until_ready()
+                     for g2 in [g(small) for _ in range(20)]][-1], n=3)
+    print(json.dumps({"probe": "dispatch_20x_small",
+                      "sec": round(sec, 4),
+                      "per_call_ms": round(sec / 20 * 1e3, 2)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

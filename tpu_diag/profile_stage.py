@@ -1,0 +1,82 @@
+"""Capture a jax.profiler trace of the warm zillow stage exec on the live
+chip and print the top HLO ops by self time (tensorboard_plugin_profile
+parses the xplane offline — no tensorboard server needed)."""
+import glob
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+import tuplex_tpu
+from tuplex_tpu.exec.local import LocalBackend
+from tuplex_tpu.models import zillow
+
+TRACE = "/tmp/tpx_trace"
+
+_orig_jit = LocalBackend._jit_stage_fn
+STATE = {"n": 0}
+
+
+def jit_traced(self, raw_fn):
+    fn = _orig_jit(self, raw_fn)
+
+    def wrapped(*a, **k):
+        da = jax.device_put(a)
+        jax.block_until_ready(jax.tree.leaves(da))
+        big = sum(getattr(x, "nbytes", 0)
+                  for x in jax.tree.leaves(da)) > (1 << 20)
+        if big and STATE["n"] == 1:  # 2nd warm big call only
+            with jax.profiler.trace(TRACE):
+                out = fn(*da, **k)
+                jax.block_until_ready(out)
+        else:
+            out = fn(*da, **k)
+            jax.block_until_ready(out)
+        if big:
+            STATE["n"] += 1
+        return out
+
+    return wrapped
+
+
+LocalBackend._jit_stage_fn = jit_traced
+
+path = "/tmp/tuplex_tpu_bench/zillow_100000.csv"
+ctx = tuplex_tpu.Context()
+zillow.build_pipeline(ctx.csv(path)).collect()
+t0 = time.perf_counter()
+zillow.build_pipeline(ctx.csv(path)).collect()
+print(f"traced run: {time.perf_counter()-t0:.3f}s", flush=True)
+
+# ---- parse the xplane: top ops by self time
+from tensorboard_plugin_profile.convert import raw_to_tool_data as rttd
+
+xs = glob.glob(os.path.join(TRACE, "**", "*.xplane.pb"), recursive=True)
+xs.sort(key=os.path.getmtime)
+print(f"xplanes: {xs}", flush=True)
+data, _ = rttd.xspace_to_tool_data([xs[-1]], "hlo_stats^", {})
+import csv as _csv
+import io
+
+rows = list(_csv.reader(io.StringIO(data.decode()
+                                    if isinstance(data, bytes) else data)))
+hdr = rows[0]
+print("columns:", hdr, flush=True)
+try:
+    sel = [hdr.index(c) for c in
+           ("HLO Op Name", "Self Duration (us)", "Category")]
+except ValueError:
+    sel = None
+body = rows[1:]
+if sel:
+    body.sort(key=lambda r: -float(r[sel[1]] or 0))
+    total = sum(float(r[sel[1]] or 0) for r in body)
+    print(f"total self us: {total:.0f}")
+    for r in body[:35]:
+        print(f"  {float(r[sel[1]]):>10.0f}us  {r[sel[2]]:<18s} {r[sel[0]][:90]}")
+else:
+    for r in body[:10]:
+        print(r)

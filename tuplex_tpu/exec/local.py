@@ -30,6 +30,49 @@ from ..core.row import Row
 from ..plan import logical as L
 from ..plan.physical import TransformStage
 from ..runtime import columns as C
+from ..runtime.packing import PackedOuts
+
+
+def _get_outs(pending):
+    """Materialize a stage result to host numpy: packed single-buffer
+    fetch (runtime/packing.py) or plain per-leaf device_get."""
+    import jax
+
+    if isinstance(pending, PackedOuts):
+        return pending.to_host()
+    return jax.device_get(pending)
+
+
+def _cpu_device():
+    """The host CPU device alongside an accelerator backend, or None."""
+    import jax
+
+    try:
+        return jax.local_devices(backend="cpu")[0]
+    except Exception:
+        return None
+
+
+class _CpuJit:
+    """jit pinned to the host CPU backend: numpy args placed (and the
+    executable compiled) on the CPU device regardless of the default
+    accelerator — used for small resolve batches where the device
+    round-trip tax exceeds the compute."""
+
+    def __init__(self, fn):
+        import jax
+
+        self._fn = jax.jit(fn)
+
+    def __call__(self, *args, **kwargs):
+        import jax
+
+        from ..ops.strings import mxu_gather_override
+
+        # default_backend() still reports the accelerator inside this
+        # context, so force the CPU kernel formulations for the trace
+        with jax.default_device(_cpu_device()), mxu_gather_override(False):
+            return self._fn(*args, **kwargs)
 
 
 @dataclass
@@ -130,19 +173,29 @@ class LocalBackend:
     def touch_partition(self, part) -> None:
         self.mm.touch(part)
 
-    def _jit_stage_fn(self, raw_fn):
+    def _jit_stage_fn(self, raw_fn, packed: bool = True):
         """Compile a stage fn for dispatch (overridden by MultiHostBackend
         to row-shard over a mesh). Input buffers are donated off-CPU: the
         staged batch is dead once the kernel reads it (consumers re-stage
         from host leaves or a one-shot handoff view), so XLA may reuse its
         HBM for the outputs (reference analog: partitions freed/recycled
-        as tasks retire, Partition ref-counting)."""
+        as tasks retire, Partition ref-counting).
+
+        packed=False keeps per-leaf dict outputs — required where a
+        consumer needs device-resident arrays (the intermediate-stage
+        handoff, _attach_device_view)."""
         import jax
 
         from ..runtime.jaxcfg import donation_enabled
+        from ..runtime.packing import PackedStageFn, packing_enabled
 
-        if donation_enabled() and self.options.get_bool(
-                "tuplex.tpu.donateBuffers", True):
+        donate = donation_enabled() and self.options.get_bool(
+            "tuplex.tpu.donateBuffers", True)
+        if packed and type(self) is LocalBackend and packing_enabled():
+            # single-buffer transfers both ways (see runtime/packing.py);
+            # mesh backends keep per-leaf staging (sharded device_put)
+            return PackedStageFn(raw_fn, donate)
+        if donate:
             return jax.jit(raw_fn, donate_argnums=0)
         return jax.jit(raw_fn)
 
@@ -193,10 +246,18 @@ class LocalBackend:
                     and self.options.get_bool(
                         "tuplex.tpu.filterCompaction", True)
                     and stage.key() not in self._compaction_off)
+        # intermediate stages keep per-leaf dict outputs so the device-
+        # resident handoff can gather from them; every other stage packs
+        # its transfers into one buffer per direction
+        packed = True
+        if intermediate:
+            from ..runtime.jaxcfg import device_handoff_enabled as _dh
+
+            packed = not _dh()
         if not self.interpret_only and skey not in self._not_compilable \
                 and in_schema is not None:
             device_fn, use_comp = self._build_stage_fn(
-                stage, in_schema, skey, use_comp)
+                stage, in_schema, skey, use_comp, packed=packed)
 
         out_parts: list[C.Partition] = []
         exceptions: list[ExceptionRecord] = []
@@ -364,7 +425,7 @@ class LocalBackend:
                 # compaction: rebuild the plain fn instead of paying the
                 # dispatch-then-redo cost for every remaining partition
                 device_fn, use_comp = self._build_stage_fn(
-                    stage, in_schema, skey, False)
+                    stage, in_schema, skey, False, packed=packed)
             self.mm.touch(part)
             try:
                 window.append(self._dispatch_partition(part, device_fn,
@@ -399,6 +460,8 @@ class LocalBackend:
         try:
             from ..runtime.jaxcfg import jnp
 
+            if not isinstance(pending_outs, dict):
+                return   # packed results skip the device view (terminal path)
             expect = C.staged_keys(outp)
             if expect is None or not expect <= set(pending_outs):
                 return
@@ -437,7 +500,8 @@ class LocalBackend:
         return None
 
     # ------------------------------------------------------------------
-    def _build_stage_fn(self, stage, in_schema, skey: str, use_comp: bool):
+    def _build_stage_fn(self, stage, in_schema, skey: str, use_comp: bool,
+                        packed: bool = True):
         """Build + jit the fast-path fn. A build failure under compaction
         retries without it (an opt-in optimization must never demote the
         stage to the interpreter); only a plain build failure does that."""
@@ -447,8 +511,9 @@ class LocalBackend:
                     in_schema, compaction=use_comp,
                     fused_fold=self.supports_fused_fold)
                 return self.jit_cache.get_or_build(
-                    ("stagefn", skey, use_comp),
-                    lambda: self._jit_stage_fn(raw_fn)), use_comp
+                    ("stagefn", skey, use_comp, packed),
+                    lambda: self._jit_stage_fn(raw_fn, packed=packed)), \
+                    use_comp
             except NotCompilable:
                 self._not_compilable.add(skey)
                 return None, use_comp
@@ -545,7 +610,7 @@ class LocalBackend:
         device_outs = pending_outs     # arrays eligible for the device view
         if pending_outs is not None:
             t0 = time.perf_counter()
-            outs = jax.device_get(pending_outs)
+            outs = _get_outs(pending_outs)
             rowidx = outs.pop("#rowidx", None)
             ovf = outs.pop("#overflow", None)
             if rowidx is not None and bool(np.asarray(ovf)):
@@ -568,7 +633,7 @@ class LocalBackend:
                                               compaction=False)))
                 batch = C.stage_partition(part, self.bucket_mode)
                 pending2 = nfn(batch.arrays)
-                outs = jax.device_get(pending2)
+                outs = _get_outs(pending2)
                 self.jit_cache.note_traced(nkey, batch.spec())
                 outs.pop("#rowidx", None)
                 outs.pop("#overflow", None)
@@ -721,13 +786,26 @@ class LocalBackend:
             and exception_class_for_code(dc.get(i, (0, 0))[0]) is None)
         if not cand:
             return
+        # a small violation set on an accelerator backend resolves on the
+        # HOST CPU executable instead: the fixed dispatch+transfer tax of
+        # the device round-trip (~0.15 s on the tunneled TPU) dwarfs the
+        # compute for a few thousand rows (reference contrast: resolve
+        # tasks share the driver's threads, ResolveTask.h:31-98)
+        host_resolve = (
+            not local_jit and type(self) is LocalBackend
+            and len(cand) <= self.options.get_int(
+                "tuplex.tpu.hostResolveRows", 16384)
+            and jax.default_backend() != "cpu" and _cpu_device() is not None)
+        gckey = ("stagefn", gkey, "cpu") if host_resolve \
+            else ("stagefn", gkey)
         try:
             # local_jit: the caller's rows are HOST-LOCAL (host-block
             # resolve) — the mesh dispatch would violate SPMD lockstep,
             # so build a plain single-host jit instead
             gfn = self.jit_cache.get_or_build(
-                ("stagefn", gkey),
-                lambda: (jax.jit if local_jit else self._jit_stage_fn)(
+                gckey,
+                lambda: (_CpuJit if host_resolve else
+                         jax.jit if local_jit else self._jit_stage_fn)(
                     stage.build_device_fn(part.schema, general=True)))
         except NotCompilable:
             self._not_compilable.add(gkey)
@@ -738,7 +816,7 @@ class LocalBackend:
         sub.fallback = {}
         sub.normal_mask = None
         batch = C.stage_partition(sub, self.bucket_mode)
-        cache_key = ("stagefn", gkey)
+        cache_key = gckey
         spec = batch.spec()
         first_call = not self.jit_cache.was_traced(cache_key, spec)
         try:
@@ -754,7 +832,7 @@ class LocalBackend:
                 "interpreter", type(e).__name__, e)
             self._not_compilable.add(gkey)
             return
-        outs = jax.device_get(outs)
+        outs = _get_outs(outs)
         err = np.asarray(outs.pop("#err"))[:k]
         keep = np.asarray(outs.pop("#keep"))[:k]
         ok = err == 0

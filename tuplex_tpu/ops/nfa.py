@@ -30,6 +30,20 @@ import numpy as np
 from ..core.errors import NotCompilable
 from ..runtime.jaxcfg import jnp, lax
 from .regex import _category_spec, _in_spec, _byte_in_spec
+from .strings import _mxu_gather
+
+
+def _class_rows(tab, byte_col):
+    """tab[byte_col] for a [256, P] 0/1 class table and [N] byte indices.
+    The row gather runs on the TPU scalar core per element; the one-hot
+    MXU contraction is exact for 0/1 entries (see strings._mxu_gather)."""
+    if tab.dtype in (jnp.float32, jnp.bool_) and _mxu_gather():
+        oh = byte_col[:, None] == jnp.arange(tab.shape[0],
+                                             dtype=byte_col.dtype)[None, :]
+        out = jnp.matmul(oh.astype(jnp.bfloat16), tab.astype(jnp.bfloat16),
+                         preferred_element_type=jnp.float32)
+        return out.astype(tab.dtype)
+    return jnp.take(tab, byte_col, axis=0)
 
 try:
     from re import _parser as _sre
@@ -290,7 +304,7 @@ class NFARegex:
         def step(carry, x):
             S, matched = carry
             byte_col, j = x
-            cm = jnp.take(classtab, byte_col, axis=0)      # [N, P]
+            cm = _class_rows(classtab, byte_col)           # [N, P]
             nxt = jnp.dot(S, follow,
                           preferred_element_type=jnp.float32) > 0.5
             if self.anchored_start:
@@ -348,7 +362,7 @@ class NFARegex:
         def step(carry, x):
             S, best = carry
             byte_col, j = x
-            cm = jnp.take(cmtab, byte_col, axis=0)            # [N, P]
+            cm = _class_rows(cmtab, byte_col)                 # [N, P]
             nxt = jnp.min(S[:, :, None] + cost[None, :, :], axis=1)
             if self.anchored_start:
                 seed = jnp.where(first_b & (j == 0),

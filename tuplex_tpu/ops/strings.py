@@ -44,6 +44,88 @@ def _pos_mask(width: int, lens):
     return jnp.arange(width, dtype=jnp.int32)[None, :] < lens[:, None]
 
 
+import contextvars
+
+# per-trace override: _CpuJit (exec/local.py) traces host-CPU executables
+# while the process default backend is still the accelerator, so the
+# backend check below would wrongly pick the MXU formulations there
+_MXU_OVERRIDE: contextvars.ContextVar = contextvars.ContextVar(
+    "tuplex_mxu_gather", default=None)
+
+
+def mxu_gather_override(value):
+    """Context manager forcing the MXU-gather decision during a trace."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        tok = _MXU_OVERRIDE.set(value)
+        try:
+            yield
+        finally:
+            _MXU_OVERRIDE.reset(tok)
+
+    return _cm()
+
+
+def _mxu_gather() -> bool:
+    """Whether per-row byte gathers/scatters reformulate as one-hot bf16
+    matmuls. XLA-TPU lowers take_along_axis/scatter on [N, W] matrices to
+    the scalar core (~49 ms for u8[81920, 56] measured on a v5e via the
+    profiler, tpu_diag/gather_probe2.py); the identical one-hot contraction
+    runs on the MXU in 0.27 ms. Byte values (< 256) are exact in bf16 and
+    exactly one one-hot term fires per output element, so the rewrite is
+    bit-exact. CPU keeps the native gather (the matmul costs W x more
+    compute there). TUPLEX_MXU_GATHER=0/1 overrides."""
+    import os
+
+    ov = _MXU_OVERRIDE.get()
+    if ov is not None:
+        return ov
+    mode = os.environ.get("TUPLEX_MXU_GATHER", "auto")
+    if mode in ("0", "1"):
+        return mode == "1"
+    from ..runtime.jaxcfg import jax
+
+    return jax.default_backend() != "cpu"
+
+
+def take_cols(mat, idx):
+    """take_along_axis(mat, idx, axis=1) with a TPU-fast path.
+
+    For u8/bool matrices on accelerator backends the gather becomes a
+    one-hot MXU contraction (see _mxu_gather). idx must already be clipped
+    to [0, W) — same contract as every call site's jnp.clip."""
+    w = mat.shape[1]
+    if mat.dtype in (jnp.uint8, jnp.bool_) and w <= 512 and _mxu_gather():
+        oh = idx[:, :, None] == jnp.arange(w, dtype=jnp.int32)[None, None, :]
+        out = jnp.einsum("njk,nk->nj", oh.astype(jnp.bfloat16),
+                         mat.astype(jnp.bfloat16),
+                         preferred_element_type=jnp.float32)
+        return out.astype(mat.dtype)
+    return jnp.take_along_axis(mat, idx, axis=1)
+
+
+def table_lookup(table, idx):
+    """table[idx] for a small (<=256-entry) u8/bool/small-int table and u8
+    indices of any shape — the byte-classification primitive (class
+    membership, digit values). The element gather runs on the TPU scalar
+    core; the one-hot contraction against the table runs on the MXU and is
+    exact for values < 256."""
+    table = jnp.asarray(table)
+    t = table.shape[0]
+    if (table.dtype in (jnp.uint8, jnp.bool_, jnp.int8)
+            and t <= 256 and _mxu_gather()):
+        flat = idx.reshape(-1, idx.shape[-1]) if idx.ndim > 1 \
+            else idx.reshape(1, -1)
+        oh = flat[:, :, None] == jnp.arange(t, dtype=flat.dtype)[None, None, :]
+        out = jnp.einsum("nkt,t->nk", oh.astype(jnp.bfloat16),
+                         table.astype(jnp.bfloat16),
+                         preferred_element_type=jnp.float32)
+        return out.astype(table.dtype).reshape(idx.shape)
+    return jnp.take(table, idx)
+
+
 # ---------------------------------------------------------------------------
 # search
 # ---------------------------------------------------------------------------
@@ -112,7 +194,7 @@ def endswith_const(bytes_, lens, suffix: str):
     start = lens - m
     idx = start[:, None] + jnp.arange(m, dtype=jnp.int32)[None, :]
     idx = jnp.clip(idx, 0, w - 1)
-    got = jnp.take_along_axis(bytes_, idx, axis=1)
+    got = take_cols(bytes_, idx)
     ok = ok & jnp.all(got == jnp.asarray(nb)[None, :], axis=1)
     return ok
 
@@ -152,7 +234,7 @@ def slice_(bytes_, lens, start, stop, out_width: int | None = None):
     out_len = jnp.maximum(stop - start, 0)
     idx = start[:, None] + cols
     idx_c = jnp.clip(idx, 0, w - 1)
-    out = jnp.take_along_axis(bytes_, idx_c, axis=1)
+    out = take_cols(bytes_, idx_c)
     keep = cols < out_len[:, None]
     return jnp.where(keep, out, 0).astype(jnp.uint8), out_len.astype(jnp.int32)
 
@@ -163,7 +245,7 @@ def char_at(bytes_, lens, idx):
     nidx = normalize_index(idx, lens)
     oob = (nidx < 0) | (nidx >= lens)
     safe = jnp.clip(nidx, 0, w - 1)
-    ch = jnp.take_along_axis(bytes_, safe[:, None], axis=1)
+    ch = take_cols(bytes_, safe[:, None])
     return ch.astype(jnp.uint8), jnp.ones(n, dtype=jnp.int32), oob
 
 
@@ -281,7 +363,7 @@ def replace_const(bytes_, lens, old: str, new: str):
         # ~3.4x on CPU (XLA-CPU lowers scatter to a scalar row loop).
         key = jnp.where(copied, jnp.arange(w, dtype=jnp.int32)[None, :], w)
         sk = jnp.sort(key, axis=1)
-        out = jnp.take_along_axis(bytes_, jnp.clip(sk, 0, w - 1), axis=1)
+        out = take_cols(bytes_, jnp.clip(sk, 0, w - 1))
         out_len = jnp.sum(copied, axis=1).astype(jnp.int32)
         mask = jnp.arange(w, dtype=jnp.int32)[None, :] < out_len[:, None]
         return jnp.where(mask, out, 0).astype(jnp.uint8), out_len
@@ -305,7 +387,17 @@ def replace_const(bytes_, lens, old: str, new: str):
 
 
 def _scatter_cols(out, rows, tgt, src, wout):
-    """out[rows, tgt] = src where tgt < wout (off-end writes dropped)."""
+    """out[rows, tgt] = src where tgt < wout (off-end writes dropped).
+    Call sites guarantee distinct in-range targets per row, so on TPU the
+    scatter becomes the transposed one-hot MXU contraction (<=1 term per
+    output element -> exact; see _mxu_gather)."""
+    if out.dtype == jnp.uint8 and wout <= 512 and _mxu_gather():
+        oh = tgt[:, :, None] == jnp.arange(wout,
+                                           dtype=jnp.int32)[None, None, :]
+        vals = jnp.einsum("nkj,nk->nj", oh.astype(jnp.bfloat16),
+                          src.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32)
+        return jnp.where(oh.any(axis=1), vals.astype(out.dtype), out)
     pad_out = jnp.zeros((out.shape[0], wout + 1), dtype=out.dtype)
     pad_out = pad_out.at[:, :wout].set(out)
     tgt_c = jnp.clip(tgt, 0, wout)
@@ -323,9 +415,7 @@ def concat(a_bytes, a_lens, b_bytes, b_lens):
     pos = jnp.arange(wout, dtype=jnp.int32)[None, :]
     b_idx = pos - a_lens[:, None]
     valid_b = (b_idx >= 0) & (b_idx < b_lens[:, None])
-    b_gathered = jnp.take_along_axis(
-        b_bytes, jnp.clip(b_idx, 0, wb - 1), axis=1
-    )
+    b_gathered = take_cols(b_bytes, jnp.clip(b_idx, 0, wb - 1))
     out = jnp.where(valid_b, b_gathered, out)
     # zero anything past a_lens that isn't b payload (stale a padding)
     inside = (pos < a_lens[:, None]) | valid_b
@@ -372,8 +462,8 @@ def compare_lt(a_bytes, a_lens, b_bytes, b_lens, or_equal: bool = False):
     big = w + 1
     first = jnp.min(jnp.where(diff, pos, big), axis=1)
     no_diff = first >= big
-    fa = jnp.take_along_axis(ab, jnp.clip(first, 0, w - 1)[:, None], axis=1)[:, 0]
-    fb = jnp.take_along_axis(bb, jnp.clip(first, 0, w - 1)[:, None], axis=1)[:, 0]
+    fa = take_cols(ab, jnp.clip(first, 0, w - 1)[:, None])[:, 0]
+    fb = take_cols(bb, jnp.clip(first, 0, w - 1)[:, None])[:, 0]
     lt = jnp.where(no_diff, a_lens < b_lens, fa < fb)
     if or_equal:
         return lt | (no_diff & (a_lens == b_lens))
@@ -409,7 +499,7 @@ def _narrowed_parse(core, bytes_, lens):
     span = jnp.maximum(ls - fs + 1, 0)      # 0 = empty / all-space
     win = min(w, _PARSE_WIN)
     idx = fs[:, None] + jnp.arange(win, dtype=jnp.int32)[None, :]
-    sb = jnp.take_along_axis(bytes_, jnp.clip(idx, 0, w - 1), axis=1)
+    sb = take_cols(bytes_, jnp.clip(idx, 0, w - 1))
     sl = jnp.minimum(span, win)
     sb = jnp.where(jnp.arange(win, dtype=jnp.int32)[None, :] < sl[:, None],
                    sb, 0).astype(jnp.uint8)
@@ -451,8 +541,7 @@ def _parse_i64_core(sb, sl):
     # any whitespace strictly inside the span is invalid ("1 2")
     inner_sp = jnp.any(sp & (pos >= fs[:, None]) & (pos <= ls[:, None]),
                        axis=1)
-    first = jnp.take_along_axis(sb, jnp.clip(fs, 0, w - 1)[:, None],
-                                axis=1)[:, 0]
+    first = take_cols(sb, jnp.clip(fs, 0, w - 1)[:, None])[:, 0]
     has_sign = (first == 43) | (first == 45)  # + -
     neg = first == 45
     digit_start = fs + jnp.where(has_sign, 1, 0)
@@ -464,7 +553,7 @@ def _parse_i64_core(sb, sl):
     # Horner exactly — in ~6 ops instead of a 20-step dependent chain.
     win = min(w, 20)
     pos_w = digit_start[:, None] + jnp.arange(win, dtype=jnp.int32)[None, :]
-    wb = jnp.take_along_axis(sb, jnp.clip(pos_w, 0, w - 1), axis=1)
+    wb = take_cols(sb, jnp.clip(pos_w, 0, w - 1))
     in_zone_w = pos_w <= ls[:, None]
     is_digit_w = (wb >= 48) & (wb <= 57)
     # invalid if: any non-digit inside the digit zone, or no digits at all
@@ -490,7 +579,7 @@ def _parse_i64_core(sb, sl):
         nz = diff != 0
         first = jnp.argmax(nz, axis=1)
         over19 = nz.any(axis=1) & \
-            (jnp.take_along_axis(diff, first[:, None], axis=1)[:, 0] > 0)
+            (take_cols(diff, first[:, None])[:, 0] > 0)
         ovf = (ndigits == 19) & over19
     else:
         ovf = jnp.zeros(n, dtype=jnp.bool_)  # w < 19: no 19-digit values
@@ -570,8 +659,7 @@ def _parse_f64_core(sb, sl):
     scale = jnp.where(has_dot, (mant_end - frac_start).astype(jnp.float64), 0.0)
     # exponent digits: same rank trick (exponents are tiny integers, exact)
     exp_sign_pos = e_pos + 1
-    exp_first = jnp.take_along_axis(
-        sb, jnp.clip(exp_sign_pos, 0, w - 1)[:, None], axis=1)[:, 0]
+    exp_first = take_cols(sb, jnp.clip(exp_sign_pos, 0, w - 1)[:, None])[:, 0]
     exp_has_sign = has_e & ((exp_first == 43) | (exp_first == 45))
     exp_neg = has_e & (exp_first == 45)
     exp_start = jnp.where(exp_has_sign, e_pos + 2, e_pos + 1)
@@ -613,7 +701,7 @@ def _parse_f64_core(sb, sl):
             return jnp.zeros(n, dtype=jnp.bool_)
         L = len(word)
         idxs = int_start[:, None] + jnp.arange(L, dtype=jnp.int32)[None, :]
-        ch = jnp.take_along_axis(sb, jnp.clip(idxs, 0, w - 1), axis=1)
+        ch = take_cols(sb, jnp.clip(idxs, 0, w - 1))
         m = (sl - int_start) == L
         for j, c in enumerate(word):
             m = m & ((ch[:, j] | 32) == ord(c))
@@ -660,9 +748,8 @@ def format_i64(vals, width: int = 0, pad_zero: bool = False):
     # build output: optional '-', then the last `ndig` digits
     pos = jnp.arange(w + 1, dtype=jnp.int32)[None, :]
     digit_idx = pos - jnp.where(neg, 1, 0)[:, None] + (w - ndig)[:, None]
-    gathered = jnp.take_along_axis(
-        jnp.pad(digits, ((0, 0), (0, 1))), jnp.clip(digit_idx, 0, w), axis=1
-    )
+    gathered = take_cols(jnp.pad(digits, ((0, 0), (0, 1))),
+                         jnp.clip(digit_idx, 0, w))
     out = jnp.where(
         (pos == 0) & neg[:, None], 45, gathered
     )
@@ -808,9 +895,8 @@ def zfill(bytes_, lens, width: int):
     src_idx = jnp.where(sign_col, 0, jnp.where(
         pos < (body_start + nzeros)[:, None], -1, src_idx))
     is_zero = (src_idx < 0) & ~sign_col & (pos < out_len[:, None])
-    gathered = jnp.take_along_axis(
-        jnp.pad(bytes_, ((0, 0), (0, max(0, wout - w + 1)))),
-        jnp.clip(src_idx, 0, w), axis=1)[:, :wout]
+    gathered = take_cols(jnp.pad(bytes_, ((0, 0), (0, max(0, wout - w + 1)))),
+        jnp.clip(src_idx, 0, w))[:, :wout]
     out = jnp.where(sign_col, first[:, None], jnp.where(is_zero, 48, gathered))
     inside = pos < out_len[:, None]
     out = jnp.where(inside, out, 0)
@@ -828,7 +914,7 @@ def pad_left(bytes_, lens, width: int, fillchar: str = " "):
     src_idx = pos - shift[:, None]
     in_pad = (src_idx < 0) & (pos < out_len[:, None])
     padded_src = jnp.pad(bytes_, ((0, 0), (0, max(0, wout - w + 1))))
-    gathered = jnp.take_along_axis(padded_src, jnp.clip(src_idx, 0, w), axis=1)[:, :wout]
+    gathered = take_cols(padded_src, jnp.clip(src_idx, 0, w))[:, :wout]
     out = jnp.where(in_pad, fill, gathered)
     inside = pos < out_len[:, None]
     return jnp.where(inside, out, 0).astype(jnp.uint8), out_len.astype(jnp.int32)
@@ -901,7 +987,7 @@ def center(bytes_, lens, width: int, fillchar: str = " "):
     src_idx = pos - left[:, None]
     in_body = (src_idx >= 0) & (src_idx < lens[:, None])
     padded = jnp.pad(bytes_, ((0, 0), (0, max(0, wout - w + 1))))
-    gathered = jnp.take_along_axis(padded, jnp.clip(src_idx, 0, w), axis=1)[:, :wout]
+    gathered = take_cols(padded, jnp.clip(src_idx, 0, w))[:, :wout]
     inside = pos < out_len[:, None]
     out = jnp.where(in_body, gathered, jnp.where(inside, fill, 0))
     return out.astype(jnp.uint8), out_len.astype(jnp.int32)
@@ -997,23 +1083,26 @@ def splice_spans(bytes_, lens, starts, ends, valid, new: str):
         spans_before = spans_before + past.astype(jnp.int32)
     keep = (pos < lens[:, None]) & ~inside
     out_pos = pos - removed_before + r * spans_before
-    flat = jnp.where(keep, jnp.arange(n, dtype=jnp.int32)[:, None] * wout +
-                     out_pos, n * wout)
-    out = jnp.zeros(n * wout + 1, dtype=bytes_.dtype).at[
-        flat.reshape(-1)].set(bytes_.reshape(-1), mode="drop")
+    # per-row scatters (kept bytes land on distinct output slots; insertion
+    # slots are disjoint from them by construction) — _scatter_cols picks
+    # the MXU one-hot path on TPU, the .at[].set scatter on CPU
+    rows2 = jnp.arange(n, dtype=jnp.int32)[:, None]
+    tgt = jnp.where(keep, out_pos, wout)
+    out = _scatter_cols(jnp.zeros((n, wout), dtype=bytes_.dtype),
+                        rows2, tgt, bytes_, wout)
     # replacement copies: span j inserts at st_j - removed(st_j) + r*j
     cum_removed = jnp.cumsum(span_len, axis=1) - span_len   # removed before j
-    rows = jnp.arange(n, dtype=jnp.int32)
     for j in range(k):
         base = starts[:, j] - cum_removed[:, j] + r * j
         ok = valid[:, j]
         for rr in range(r):
-            idx = jnp.where(ok, rows * wout + base + rr, n * wout)
-            out = out.at[idx].set(nb[rr], mode="drop")
+            tgt_c = jnp.where(ok, base + rr, wout)[:, None]
+            src = jnp.full((n, 1), nb[rr], dtype=bytes_.dtype)
+            out = _scatter_cols(out, rows2, tgt_c, src, wout)
     total_removed = jnp.sum(jnp.where(valid, span_len, 0), axis=1)
     n_spans = jnp.sum(valid.astype(jnp.int32), axis=1)
     out_lens = lens - total_removed + r * n_spans
-    return out[:-1].reshape(n, wout), out_lens.astype(lens.dtype)
+    return out, out_lens.astype(lens.dtype)
 
 
 def replace_class_runs(bytes_, lens, table: np.ndarray, new: str):
@@ -1025,7 +1114,7 @@ def replace_class_runs(bytes_, lens, table: np.ndarray, new: str):
     k = len(nb)
     n, w = bytes_.shape
     inside = _pos_mask(w, lens)
-    member = jnp.take(jnp.asarray(table), bytes_.astype(jnp.int32)) & inside
+    member = table_lookup(jnp.asarray(table), bytes_.astype(jnp.int32)) & inside
     prev = jnp.pad(member[:, :-1], ((0, 0), (1, 0)))
     run_start = member & ~prev
     copied = inside & ~member
@@ -1090,8 +1179,8 @@ def parse_int_base(bytes_, lens, base: int):
     if prefix is not None:
         idx0 = jnp.clip(start, 0, w - 1)
         idx1 = jnp.clip(start + 1, 0, w - 1)
-        c0 = jnp.take_along_axis(sb, idx0[:, None], axis=1)[:, 0]
-        c1 = jnp.take_along_axis(sb, idx1[:, None], axis=1)[:, 0]
+        c0 = take_cols(sb, idx0[:, None])[:, 0]
+        c1 = take_cols(sb, idx1[:, None])[:, 0]
         has_pref = (c0 == 48) & ((c1 == prefix[0]) | (c1 == prefix[1])) & \
             (sl >= start + 2)
         start = start + jnp.where(has_pref, 2, 0)
@@ -1107,7 +1196,7 @@ def parse_int_base(bytes_, lens, base: int):
             v = c - 55
         if v is not None and v < base:
             tab[c] = v
-    dig = jnp.take(jnp.asarray(tab), sb.astype(jnp.int32))
+    dig = table_lookup(jnp.asarray(tab), sb.astype(jnp.int32))
     pos = jnp.arange(w, dtype=jnp.int32)[None, :]
     in_digits = (pos >= start[:, None]) & (pos < sl[:, None])
     # CPython accepts '_' separators between digits: exact handling needs
@@ -1125,9 +1214,8 @@ def parse_int_base(bytes_, lens, base: int):
     # positional power sum over a bounded window (same technique as
     # parse_i64: no W-step carry chain)
     widx = start[:, None] + jnp.arange(max_digits, dtype=jnp.int32)[None, :]
-    wd = jnp.take_along_axis(
-        jnp.where(dig == 255, 0, dig).astype(jnp.int64),
-        jnp.clip(widx, 0, w - 1), axis=1)
+    wd = take_cols(jnp.where(dig == 255, jnp.uint8(0), dig),
+                   jnp.clip(widx, 0, w - 1)).astype(jnp.int64)
     j = jnp.arange(max_digits, dtype=jnp.int32)[None, :]
     exp = jnp.clip(ndig[:, None] - 1 - j, 0, max_digits - 1)
     powers = jnp.asarray(
