@@ -627,8 +627,8 @@ def test_mesh_failure_degrades_to_single_device_compiled():
     orig = MultiHostBackend._jit_stage_fn
     calls = {"n": 0}
 
-    def poisoned(self, raw_fn):
-        inner = orig(self, raw_fn)
+    def poisoned(self, raw_fn, **kw):
+        inner = orig(self, raw_fn, **kw)
 
         def flaky(arrays):
             calls["n"] += 1
@@ -702,8 +702,8 @@ def test_elastic_partial_mesh_degrade(monkeypatch):
     orig_build = type(be)._build_stage_fn
     calls = {"n": 0}
 
-    def poisoned_build(self, stage, in_schema, skey, use_comp):
-        real_fn, uc = orig_build(self, stage, in_schema, skey, use_comp)
+    def poisoned_build(self, stage, in_schema, skey, use_comp, **kw):
+        real_fn, uc = orig_build(self, stage, in_schema, skey, use_comp, **kw)
 
         def flaky(arrays):
             calls["n"] += 1

@@ -1,0 +1,62 @@
+"""Single-buffer transfer packing (runtime/packing.py): round-trip
+exactness for every leaf dtype the stage runtime ships, including the
+64-bit split-into-u32-halves path (the XLA-TPU x64 legalizer cannot
+rewrite 64-bit bitcast-convert inside large graphs) and the f64
+per-leaf bypass (f64->int bitcasts fail outright on the TPU stack)."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def packed_identity():
+    from tuplex_tpu.runtime.packing import PackedOuts, PackedStageFn
+
+    fn = PackedStageFn(lambda arrays: dict(arrays), donate=False)
+
+    def roundtrip(arrays):
+        out = fn(arrays)
+        assert isinstance(out, PackedOuts)
+        return out.to_host()
+
+    return roundtrip
+
+
+def test_packing_roundtrip_all_dtypes(packed_identity):
+    rng = np.random.default_rng(7)
+    arrays = {
+        "u8": rng.integers(0, 256, (257, 13), np.uint8),
+        "bool": rng.integers(0, 2, (300,)).astype(np.bool_),
+        "i32": rng.integers(-2**31, 2**31 - 1, (99,), np.int64)
+        .astype(np.int32),
+        "u32": rng.integers(0, 2**32 - 1, (64, 3), np.uint64)
+        .astype(np.uint32),
+        "f32": rng.standard_normal((41,)).astype(np.float32),
+        "i64": np.array([0, 1, -1, 2**62, -2**62, 1234567890123], np.int64),
+        "u64": np.array([0, 1, 2**63, 2**64 - 1, 0xDEADBEEFCAFEF00D],
+                        np.uint64),
+        "f64": rng.standard_normal((55,)),          # per-leaf bypass
+        "scalar": np.bool_(True).reshape(()),
+        "empty": np.zeros((0, 4), np.uint8),
+    }
+    got = packed_identity(arrays)
+    assert set(got) == set(arrays)
+    for k, want in arrays.items():
+        g = np.asarray(got[k])
+        assert g.dtype == want.dtype, k
+        assert g.shape == want.shape, k
+        np.testing.assert_array_equal(g, want, err_msg=k)
+
+
+def test_packing_f64_rides_per_leaf(packed_identity):
+    from tuplex_tpu.runtime import packing as P
+
+    arrays = {"a": np.arange(8, dtype=np.float64),
+              "b": np.arange(8, dtype=np.int64)}
+    spec, _ = P._host_spec(arrays)
+    packed_keys = {s[0] for s in spec}
+    assert packed_keys == {"b"}          # f64 bypasses the buffer
+
+
+def test_packing_empty_dict(packed_identity):
+    assert packed_identity({}) == {}

@@ -43,7 +43,7 @@ def test_spec_roundtrip_rebuilds_udfs(tmp_path):
 
 
 def test_parallelize_fanout(tmp_path, monkeypatch):
-    c = _ctx(tmp_path)
+    c = _ctx(tmp_path, **{"tuplex.aws.reuseWorkers": "false"})
     launches = {"n": 0}
     orig = ServerlessBackend._launch
 
@@ -107,7 +107,8 @@ def test_task_failure_retries_then_degrades(tmp_path, monkeypatch):
     import sys
     import subprocess
 
-    c = _ctx(tmp_path, **{"tuplex.aws.retryCount": 1})
+    c = _ctx(tmp_path, **{"tuplex.aws.retryCount": 1,
+                          "tuplex.aws.reuseWorkers": "false"})
     backend = c.backend
     assert isinstance(backend, ServerlessBackend)
     orig = ServerlessBackend._launch
@@ -135,7 +136,8 @@ def test_degrade_runs_on_driver(tmp_path, monkeypatch):
     import sys
     import subprocess
 
-    c = _ctx(tmp_path, **{"tuplex.aws.retryCount": 0})
+    c = _ctx(tmp_path, **{"tuplex.aws.retryCount": 0,
+                          "tuplex.aws.reuseWorkers": "false"})
 
     def always_dead(self, run_dir, data_dir, task, tspec, req_base):
         os.makedirs(os.path.join(run_dir, f"task-{task:04d}"), exist_ok=True)
@@ -267,7 +269,8 @@ def test_sink_pushdown_degrade_writes_part_locally(tmp_path, monkeypatch):
     import subprocess
     import sys
 
-    c = _ctx(tmp_path, **{"tuplex.aws.retryCount": 0})
+    c = _ctx(tmp_path, **{"tuplex.aws.retryCount": 0,
+                          "tuplex.aws.reuseWorkers": "false"})
 
     def always_dead(self, run_dir, data_dir, task, tspec, req_base):
         os.makedirs(os.path.join(run_dir, f"task-{task:04d}"), exist_ok=True)
@@ -322,7 +325,8 @@ def test_task_timeout_kills_and_degrades(tmp_path, monkeypatch):
     import time as _time
 
     c = _ctx(tmp_path, **{"tuplex.aws.retryCount": 0,
-                          "tuplex.aws.requestTimeout": 1})
+                          "tuplex.aws.requestTimeout": 1,
+                          "tuplex.aws.reuseWorkers": "false"})
 
     def sleeper(self, run_dir, data_dir, task, tspec, req_base):
         os.makedirs(os.path.join(run_dir, f"task-{task:04d}"), exist_ok=True)
@@ -414,3 +418,52 @@ def test_worker_task_events_stream_to_dashboard(tmp_path):
     out = render_report(str(tmp_path), str(tmp_path / "report.html"))
     html_doc = open(out).read()
     assert "task 0" in html_doc
+
+
+# -- warm worker pool (reference: Lambda container reuse) -------------------
+
+def test_warm_pool_reuses_workers(tmp_path):
+    # consecutive jobs ride the SAME worker processes: the pool spawns at
+    # most maxConcurrency workers across both jobs and the second job's
+    # tasks skip the interpreter+jax cold start
+    c = _ctx(tmp_path)
+    backend = c.backend
+    got1 = c.parallelize(list(range(3000))).map(lambda x: x * 2).collect()
+    assert got1 == [x * 2 for x in range(3000)]
+    pids1 = {w.proc.pid for w in backend._pool}
+    assert 1 <= len(pids1) <= 3
+    got2 = c.parallelize(list(range(3000))).map(lambda x: x * 5).collect()
+    assert got2 == [x * 5 for x in range(3000)]
+    pids2 = {w.proc.pid for w in backend._pool}
+    assert pids2 <= pids1, "second job must reuse the warm workers"
+    assert all(w.busy is None for w in backend._pool)
+    c.close()
+    assert backend._pool == []
+
+
+def test_warm_worker_task_error_retries_without_killing(tmp_path,
+                                                        monkeypatch):
+    # a task exception inside a warm worker writes ok=False and the worker
+    # survives for the retry (here the 'error' is injected by pointing the
+    # task at a bogus request on first dispatch)
+    c = _ctx(tmp_path, **{"tuplex.aws.retryCount": 1})
+    backend = c.backend
+    orig = ServerlessBackend._write_request
+    flips = {"n": 0}
+
+    def corrupting(self, run_dir, data_dir, task, tspec, req_base):
+        path = orig(self, run_dir, data_dir, task, tspec, req_base)
+        if task == 0 and flips["n"] == 0:
+            flips["n"] += 1
+            with open(path, "wb") as fp:
+                fp.write(b"not a pickle")
+        return path
+
+    monkeypatch.setattr(ServerlessBackend, "_write_request", corrupting)
+    got = c.parallelize(list(range(2000))).map(lambda x: x - 1).collect()
+    assert got == [x - 1 for x in range(2000)]
+    assert flips["n"] == 1
+    assert any(e.get("stage") == "serverless"
+               for e in backend.failure_log)
+    # the worker that hit the bad pickle is still alive in the pool
+    assert any(w.proc.poll() is None for w in backend._pool)

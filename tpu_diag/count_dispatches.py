@@ -54,8 +54,8 @@ from tuplex_tpu.exec.local import LocalBackend
 _orig_jit = LocalBackend._jit_stage_fn
 
 
-def jit_counted(self, raw_fn):
-    fn = _orig_jit(self, raw_fn)
+def jit_counted(self, raw_fn, **kw):
+    fn = _orig_jit(self, raw_fn, **kw)
 
     def wrapped(*a, **k):
         t0 = time.perf_counter()

@@ -20,8 +20,8 @@ _orig_jit = LocalBackend._jit_stage_fn
 STATE = {"n": 0}
 
 
-def jit_traced(self, raw_fn):
-    fn = _orig_jit(self, raw_fn)
+def jit_traced(self, raw_fn, **kw):
+    fn = _orig_jit(self, raw_fn, **kw)
 
     def wrapped(*a, **k):
         da = jax.device_put(a)

@@ -197,7 +197,13 @@ class Context:
 
     def close(self) -> None:
         """Release context resources (the autostarted webui server's socket
-        and thread). Safe to call repeatedly."""
+        and thread; warm serverless workers). Safe to call repeatedly."""
+        be = getattr(self, "backend", None)
+        if be is not None and hasattr(be, "close"):
+            try:
+                be.close()
+            except Exception:
+                pass
         if self._webui_server is not None:
             try:
                 self._webui_server.shutdown()

@@ -77,6 +77,7 @@ DEFAULTS: dict[str, str] = {
     "tuplex.aws.workerPlatform": "cpu",     # jax platform inside workers
                                             # ("" = inherit; one local chip
                                             # cannot be shared by N procs)
+    "tuplex.aws.reuseWorkers": "true",      # warm container reuse analog
     # --- TPU-native keys ---------------------------------------------------
     "tuplex.tpu.deviceBatchSize": "1048576",    # rows per device dispatch
     "tuplex.tpu.padBucketing": "q8",            # q8 | pow2 | exact

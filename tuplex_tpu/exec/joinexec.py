@@ -564,7 +564,32 @@ def _build_probe_fn(u: int, nw: int, mesh=None):
 
     steps = max(1, u).bit_length() + 1
 
-    def lower_bound(words, build_words):
+    # direct rank probe: lower_bound[n] = |{j : build[j] <lex probe[n]}| as
+    # one fused comparison/reduction pass — no per-step row gathers. The
+    # binary search's build_words[mid] gathers run on the TPU scalar core
+    # (the profiled zillow-stage gathers cost ~49ms each at this batch
+    # size); the [B, u, nw] comparison streams through the VPU instead.
+    # Falls back to the log-step search when the broadcast build side is
+    # large enough that the B x u compare matrix would out-cost it.
+    direct = u * max(1, nw) <= (1 << 15)
+
+    def lower_bound_direct(words, build_words):
+        bw = build_words[None, :, :]          # [1, u, nw]
+        pw = words[:, None, :]                # [B, 1, nw]
+        lt = bw < pw
+        eq = bw == pw
+        b = words.shape[0]
+        less = jnp.zeros((b, u), dtype=bool)
+        prefix_eq = jnp.ones((b, u), dtype=bool)
+        for k in range(nw):                   # nw is tiny (key bytes / 8)
+            less = less | (prefix_eq & lt[..., k])
+            prefix_eq = prefix_eq & eq[..., k]
+        pos = less.sum(axis=1, dtype=jnp.int32)
+        matched = prefix_eq.any(axis=1)       # some build row fully equal
+        return (jnp.clip(pos, 0, max(u - 1, 0)).astype(jnp.int64),
+                matched)
+
+    def lower_bound_search(words, build_words):
         b = words.shape[0]
         lo = jnp.zeros(b, jnp.int32)
         hi = jnp.full(b, u, jnp.int32)
@@ -584,6 +609,8 @@ def _build_probe_fn(u: int, nw: int, mesh=None):
         cand = build_words[pos]
         matched = (lo < u) & jnp.all(cand == words, axis=1)
         return pos.astype(jnp.int64), matched
+
+    lower_bound = lower_bound_direct if direct else lower_bound_search
 
     if mesh is None:
         return jax.jit(lower_bound)

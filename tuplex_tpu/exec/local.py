@@ -625,12 +625,14 @@ class LocalBackend:
                     "compaction bucket overflow (stage %s); re-running "
                     "partition without compaction", stage.key()[:8])
                 self._compaction_off.add(stage.key())
+                packed = not intermediate   # keep the handoff's dict outs
                 nkey = ("stagefn", stage.key() + "/" + part.schema.name,
-                        False)
+                        False, packed)
                 nfn = self.jit_cache.get_or_build(
                     nkey, lambda: self._jit_stage_fn(
                         stage.build_device_fn(part.schema,
-                                              compaction=False)))
+                                              compaction=False),
+                        packed=packed))
                 batch = C.stage_partition(part, self.bucket_mode)
                 pending2 = nfn(batch.arrays)
                 outs = _get_outs(pending2)

@@ -312,6 +312,18 @@ def rebuild_stage(spec: dict, options, files: Optional[list] = None):
 # driver-side backend
 # ---------------------------------------------------------------------------
 
+class _WarmWorker:
+    """A long-lived `--serve` worker process. busy: None = idle, task id
+    while processing, -1 = condemned (killed / wedged)."""
+
+    __slots__ = ("proc", "busy", "resp_path")
+
+    def __init__(self, proc):
+        self.proc = proc
+        self.busy = None
+        self.resp_path = ""
+
+
 class ServerlessBackend(LocalBackend):
     """Fan a TransformStage out over detached worker processes with
     object-store-style part staging. Aggregates, joins, fused folds, and
@@ -346,6 +358,37 @@ class ServerlessBackend(LocalBackend):
         self.control_root = os.path.join(
             options.get_str("tuplex.scratchDir", "/tmp/tuplex_tpu"),
             "serverless-ctl") if self.scratch_remote else scratch
+        # warm worker pool (reference: Lambda container reuse — the
+        # measured cold path costs ~15 s/task in interpreter+jax import and
+        # stage re-trace; a warm worker amortizes both across tasks and
+        # across jobs). Workers persist on the backend until close().
+        self.reuse = options.get_bool("tuplex.aws.reuseWorkers", True)
+        self._pool: list = []
+
+    def close(self) -> None:
+        """Shut down warm workers (EXIT handshake, then terminate)."""
+        for w in self._pool:
+            try:
+                if w.proc.poll() is None:
+                    w.proc.stdin.write("EXIT\n")
+                    w.proc.stdin.flush()
+            except OSError:
+                pass
+        for w in self._pool:
+            try:
+                w.proc.wait(timeout=2)
+            except Exception:
+                try:
+                    w.proc.kill()
+                except OSError:
+                    pass
+        self._pool = []
+
+    def __del__(self):  # pragma: no cover - interpreter teardown
+        try:
+            self.close()
+        except Exception:
+            pass
 
     # -- dispatch ----------------------------------------------------------
     def execute_any(self, stage, partitions, context,
@@ -434,10 +477,20 @@ class ServerlessBackend(LocalBackend):
             while pending or procs:
                 check_interrupted()
                 while pending and len(procs) < self.max_conc:
-                    t = pending.pop(0)
-                    procs[t] = (self._launch(run_dir, data_dir, t,
-                                             tasks[t], req_base),
-                                time.perf_counter(), attempts[t])
+                    t = pending[0]
+                    if self.reuse:
+                        w = self._acquire_worker()
+                        if w is None:
+                            break       # every warm worker busy
+                        pending.pop(0)
+                        self._send_task(w, run_dir, data_dir, t,
+                                        tasks[t], req_base)
+                        procs[t] = (w, time.perf_counter(), attempts[t])
+                    else:
+                        pending.pop(0)
+                        procs[t] = (self._launch(run_dir, data_dir, t,
+                                                 tasks[t], req_base),
+                                    time.perf_counter(), attempts[t])
                 self._reap(procs, done, pending, attempts, tasks, run_dir,
                            data_dir, recorder=recorder,
                            ev_offsets=ev_offsets)
@@ -450,7 +503,7 @@ class ServerlessBackend(LocalBackend):
         finally:
             for p, _, _ in procs.values():
                 try:
-                    p.kill()
+                    (p.proc if isinstance(p, _WarmWorker) else p).kill()
                 except OSError:
                     pass
         result = self._collect(stage, tasks, done, context, run_dir, t0,
@@ -477,10 +530,15 @@ class ServerlessBackend(LocalBackend):
                     pass    # best-effort (reference leaves S3 scratch too)
         return result
 
-    def _launch(self, run_dir: str, data_dir: str, task: int, tspec: dict,
-                req_base: dict) -> subprocess.Popen:
+    def _write_request(self, run_dir: str, data_dir: str, task: int,
+                       tspec: dict, req_base: dict) -> str:
         task_dir = os.path.join(run_dir, f"task-{task:04d}")
         os.makedirs(task_dir, exist_ok=True)
+        # a retry must not see the failed attempt's response as completion
+        try:
+            os.remove(os.path.join(task_dir, "response.pkl"))
+        except OSError:
+            pass
         req = dict(req_base)
         req["task"] = task
         req["files"] = tspec.get("files")
@@ -489,6 +547,9 @@ class ServerlessBackend(LocalBackend):
         req_path = os.path.join(task_dir, "request.pkl")
         with open(req_path, "wb") as fp:
             pickle.dump(req, fp)
+        return req_path
+
+    def _worker_env(self) -> dict:
         env = dict(os.environ)
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
@@ -496,31 +557,91 @@ class ServerlessBackend(LocalBackend):
             env.get("PYTHONPATH", "")
         env["TUPLEX_WORKER_PLATFORM"] = self.options.get_str(
             "tuplex.aws.workerPlatform", "cpu")
+        return env
+
+    def _launch(self, run_dir: str, data_dir: str, task: int, tspec: dict,
+                req_base: dict) -> subprocess.Popen:
+        req_path = self._write_request(run_dir, data_dir, task, tspec,
+                                       req_base)
+        task_dir = os.path.dirname(req_path)
         with open(os.path.join(task_dir, "worker.log"), "wb") as logf:
             return subprocess.Popen(
                 [sys.executable, "-m", "tuplex_tpu.exec.worker", req_path],
-                stdout=logf, stderr=subprocess.STDOUT, env=env)
+                stdout=logf, stderr=subprocess.STDOUT,
+                env=self._worker_env())
+
+    # -- warm pool (reference: Lambda container reuse) ---------------------
+    def _spawn_warm(self) -> "_WarmWorker":
+        wid = len(self._pool)
+        logdir = os.path.join(self.control_root, "workers")
+        os.makedirs(logdir, exist_ok=True)
+        logf = open(os.path.join(logdir, f"worker-{wid}.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tuplex_tpu.exec.worker", "--serve"],
+            stdin=subprocess.PIPE, stdout=logf, stderr=subprocess.STDOUT,
+            env=self._worker_env(), text=True)
+        return _WarmWorker(proc)
+
+    def _acquire_worker(self):
+        """An idle live warm worker, spawning up to max_conc; None if all
+        are busy."""
+        self._pool = [w for w in self._pool if w.proc.poll() is None]
+        for w in self._pool:
+            if w.busy is None:
+                return w
+        if len(self._pool) < self.max_conc:
+            w = self._spawn_warm()
+            self._pool.append(w)
+            return w
+        return None
+
+    def _send_task(self, w: "_WarmWorker", run_dir: str, data_dir: str,
+                   task: int, tspec: dict, req_base: dict) -> None:
+        req_path = self._write_request(run_dir, data_dir, task, tspec,
+                                       req_base)
+        w.busy = task
+        w.resp_path = os.path.join(os.path.dirname(req_path),
+                                   "response.pkl")
+        try:
+            w.proc.stdin.write(req_path + "\n")
+            w.proc.stdin.flush()
+        except OSError:
+            pass    # dead worker: _reap sees proc.poll() and retries
 
     def _reap(self, procs, done, pending, attempts, tasks, run_dir,
               data_dir, recorder=None, ev_offsets=None):
         now = time.perf_counter()
         for t in list(procs):
             p, started, att = procs[t]
-            rc = p.poll()
-            if rc is None:
+            warm = isinstance(p, _WarmWorker)
+            proc = p.proc if warm else p
+            resp = os.path.join(run_dir, f"task-{t:04d}", "response.pkl")
+            rc = proc.poll()
+            # warm workers signal completion by the atomic response write
+            # (the process stays alive); cold workers by exiting
+            completed = os.path.exists(resp) if warm else rc is not None
+            if not completed and rc is None:
                 if now - started > self.timeout_s:
-                    p.kill()
+                    proc.kill()   # a warm worker dies with its stuck task
                     rc = -9
                 else:
                     continue
             del procs[t]
+            if warm:
+                p.busy = None if (completed and rc is None) else -1
             # drain the worker's remaining events exactly once, at the
-            # transition — its file cannot grow after the process exits
+            # transition — its file cannot grow after the task completes
             if ev_offsets is not None:
                 self._pump_task_events(run_dir, ev_offsets, recorder, [t])
             outdir = _djoin(_djoin(data_dir, f"task-{t:04d}"), "out")
-            resp = os.path.join(run_dir, f"task-{t:04d}", "response.pkl")
-            if rc == 0 and os.path.exists(resp):
+            resp_ok = False
+            if os.path.exists(resp):
+                try:
+                    with open(resp, "rb") as fp:
+                        resp_ok = bool(pickle.load(fp).get("ok", True))
+                except Exception:
+                    resp_ok = False
+            if resp_ok and (rc == 0 or (warm and rc is None)):
                 done[t] = outdir
                 continue
             tail = self._log_tail(run_dir, t)

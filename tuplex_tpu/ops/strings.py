@@ -90,19 +90,34 @@ def _mxu_gather() -> bool:
     return jax.default_backend() != "cpu"
 
 
+# contraction chunk for the one-hot rewrites: bounds the materialized
+# one-hot slab at N x Wout x 128 whatever the matrix width (an unchunked
+# [61440, 512, 512] one-hot wedged the flights stage on the v5e — XLA
+# declined to fuse it into the dot and tried to materialize ~16 GB)
+_OH_CHUNK = 128
+_OH_MAX_W = 1024      # beyond this the scalar gather wins back
+
+
 def take_cols(mat, idx):
     """take_along_axis(mat, idx, axis=1) with a TPU-fast path.
 
     For u8/bool matrices on accelerator backends the gather becomes a
-    one-hot MXU contraction (see _mxu_gather). idx must already be clipped
-    to [0, W) — same contract as every call site's jnp.clip."""
+    one-hot MXU contraction (see _mxu_gather), chunked along the
+    contraction dim to bound memory. idx must already be clipped to
+    [0, W) — same contract as every call site's jnp.clip."""
     w = mat.shape[1]
-    if mat.dtype in (jnp.uint8, jnp.bool_) and w <= 512 and _mxu_gather():
-        oh = idx[:, :, None] == jnp.arange(w, dtype=jnp.int32)[None, None, :]
-        out = jnp.einsum("njk,nk->nj", oh.astype(jnp.bfloat16),
-                         mat.astype(jnp.bfloat16),
-                         preferred_element_type=jnp.float32)
-        return out.astype(mat.dtype)
+    if mat.dtype in (jnp.uint8, jnp.bool_) and w <= _OH_MAX_W \
+            and _mxu_gather():
+        acc = None
+        for k0 in range(0, w, _OH_CHUNK):
+            k1 = min(k0 + _OH_CHUNK, w)
+            oh = idx[:, :, None] == jnp.arange(k0, k1,
+                                               dtype=jnp.int32)[None, None, :]
+            part = jnp.einsum("njk,nk->nj", oh.astype(jnp.bfloat16),
+                              mat[:, k0:k1].astype(jnp.bfloat16),
+                              preferred_element_type=jnp.float32)
+            acc = part if acc is None else acc + part
+        return acc.astype(mat.dtype)
     return jnp.take_along_axis(mat, idx, axis=1)
 
 
@@ -391,13 +406,21 @@ def _scatter_cols(out, rows, tgt, src, wout):
     Call sites guarantee distinct in-range targets per row, so on TPU the
     scatter becomes the transposed one-hot MXU contraction (<=1 term per
     output element -> exact; see _mxu_gather)."""
-    if out.dtype == jnp.uint8 and wout <= 512 and _mxu_gather():
-        oh = tgt[:, :, None] == jnp.arange(wout,
-                                           dtype=jnp.int32)[None, None, :]
-        vals = jnp.einsum("nkj,nk->nj", oh.astype(jnp.bfloat16),
-                          src.astype(jnp.bfloat16),
-                          preferred_element_type=jnp.float32)
-        return jnp.where(oh.any(axis=1), vals.astype(out.dtype), out)
+    if out.dtype == jnp.uint8 and wout <= _OH_MAX_W and _mxu_gather():
+        k = tgt.shape[1]
+        vals = None
+        hit = None
+        for k0 in range(0, k, _OH_CHUNK):   # chunk the contraction dim
+            k1 = min(k0 + _OH_CHUNK, k)
+            oh = tgt[:, k0:k1, None] == jnp.arange(
+                wout, dtype=jnp.int32)[None, None, :]
+            part = jnp.einsum("nkj,nk->nj", oh.astype(jnp.bfloat16),
+                              src[:, k0:k1].astype(jnp.bfloat16),
+                              preferred_element_type=jnp.float32)
+            h = oh.any(axis=1)
+            vals = part if vals is None else vals + part
+            hit = h if hit is None else (hit | h)
+        return jnp.where(hit, vals.astype(out.dtype), out)
     pad_out = jnp.zeros((out.shape[0], wout + 1), dtype=out.dtype)
     pad_out = pad_out.at[:, :wout].set(out)
     tgt_c = jnp.clip(tgt, 0, wout)

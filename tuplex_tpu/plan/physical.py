@@ -687,7 +687,8 @@ def plan_stages(sink: L.LogicalOperator, options=None):
     out: list = []
     for st in stages:
         if isinstance(st, TransformStage):
-            out.extend(segment_stage(st))
+            for seg in segment_stage(st):
+                out.extend(_split_oversize(seg, options))
         else:
             out.append(st)
     # fuse pattern-fold aggregates into the preceding transform stage's
@@ -906,6 +907,60 @@ def _op_compiles_uncached(op: L.LogicalOperator,
         # any other trace failure: treat as non-compilable (interpreter is
         # always correct)
         return False
+
+
+def _split_oversize(stage: TransformStage, options) -> list:
+    """Split a very large fused stage into balanced sub-stages on
+    accelerator backends. Remote TPU compiles scale superlinearly with
+    graph size (the 43-operator flights stage took >20 min in one
+    tpu_compile_helper call vs ~2-3 min for zillow's 13); two half-size
+    executables compile far faster and the intermediate rides the
+    device-resident handoff. CPU keeps maximal fusion (local XLA compiles
+    are cheap and stage boundaries cost real memcpys there).
+    tuplex.tpu.maxStageOps=0 disables."""
+    max_ops = 0
+    if options is not None:
+        max_ops = options.get_int("tuplex.tpu.maxStageOps", -1)
+    if max_ops < 0:       # auto: only when an accelerator is the target
+        from ..runtime.jaxcfg import jax
+
+        max_ops = 20 if jax.default_backend() != "cpu" else 0
+    n = len(stage.ops)
+    if not max_ops or n <= max_ops or stage.force_interpret:
+        return [stage]
+    import math
+
+    k = math.ceil(n / max_ops)
+    per = math.ceil(n / k)
+    # chunk boundaries must not separate an op from its trailing
+    # Resolve/Ignore guards
+    chunks: list[list] = [[]]
+    for op in stage.ops:
+        if (len(chunks[-1]) >= per
+                and not isinstance(op, (L.ResolveOperator,
+                                        L.IgnoreOperator))):
+            chunks.append([])
+        chunks[-1].append(op)
+    schema = stage.input_schema
+    segments: list[TransformStage] = []
+    for j, ops_run in enumerate(chunks):
+        if j == 0:
+            seg = TransformStage(
+                stage.source, ops_run,
+                input_schema=schema,
+                input_op=None if stage.source is not None else ops_run[0])
+            if hasattr(stage, "source_projection"):
+                seg.source_projection = stage.source_projection
+        else:
+            seg = TransformStage(None, ops_run, input_schema=schema,
+                                 input_op=ops_run[0])
+        seg.speculate_branches = stage.speculate_branches
+        for op in ops_run:
+            if not isinstance(op, (L.ResolveOperator, L.IgnoreOperator)):
+                schema = op.schema()
+        segments.append(seg)
+    segments[-1].limit = stage.limit
+    return segments
 
 
 def segment_stage(stage: TransformStage) -> list:

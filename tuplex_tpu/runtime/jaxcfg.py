@@ -36,6 +36,19 @@ def _host_tag() -> str:
                     break
     except OSError:
         pass
+    # axon sessions remote-compile EVERYTHING (PALLAS_AXON_REMOTE_COMPILE),
+    # including XLA:CPU executables built on the service machine's ISA
+    # (+prefer-no-scatter/+avx512* artifacts observed) — those must never
+    # land in the cache partition that plain local-CPU sessions load from
+    # (SIGILL risk, seen round 4). Keyed on the EFFECTIVE platform list:
+    # CPU-forced processes (tests, bench cpu child) set jax_platforms="cpu"
+    # before importing the framework and compile locally.
+    try:
+        platforms = jax.config.jax_platforms or ""
+    except AttributeError:
+        platforms = os.environ.get("JAX_PLATFORMS", "")
+    if "axon" in platforms:
+        tag += "_axon"
     return tag
 
 

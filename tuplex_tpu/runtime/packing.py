@@ -43,12 +43,27 @@ def _pad(nb: int) -> int:
     return -(-nb // _ALIGN) * _ALIGN
 
 
+def _packable(dtype) -> bool:
+    """Dtypes the in-executable bitcasts handle on every backend. 64-bit
+    ints split into u32 halves arithmetically (the XLA-TPU x64 legalizer
+    has no rule for 64-bit bitcast-convert inside large stage graphs, and
+    f64<->int bitcasts fail outright on the current TPU stack — probed on
+    the live chip); f64 and anything exotic transfer per-leaf instead."""
+    return np.dtype(dtype) in (np.dtype(np.uint8), np.dtype(np.bool_),
+                               np.dtype(np.int8), np.dtype(np.int16),
+                               np.dtype(np.uint16), np.dtype(np.int32),
+                               np.dtype(np.uint32), np.dtype(np.float32),
+                               np.dtype(np.int64), np.dtype(np.uint64))
+
+
 def _host_spec(arrays: dict):
     """Deterministic layout: (key, shape, dtype_str, offset, nbytes)."""
     spec = []
     off = 0
     for k in sorted(arrays):
         a = arrays[k]
+        if not _packable(a.dtype):
+            continue
         nb = a.nbytes
         spec.append((k, tuple(a.shape), a.dtype.str, off, nb))
         off += _pad(nb)
@@ -77,7 +92,9 @@ def _unpack_host(buf: np.ndarray, spec) -> dict:
 
 def _device_unpack(buf, spec):
     """Traced: one u8 buffer -> dict of typed arrays (static slices +
-    bitcasts; XLA fuses these into the stage executable)."""
+    bitcasts; XLA fuses these into the stage executable). 64-bit ints
+    combine from u32 halves arithmetically — no 64-bit bitcast reaches
+    the TPU x64 legalizer."""
     out = {}
     for k, shape, dt, off, nb in spec:
         dtype = np.dtype(dt)
@@ -86,6 +103,12 @@ def _device_unpack(buf, spec):
             arr = seg.reshape(shape)
         elif dtype == np.bool_:
             arr = seg.reshape(shape).astype(jnp.bool_)
+        elif dtype.itemsize == 8:
+            halves = jax.lax.bitcast_convert_type(
+                seg.reshape(tuple(shape) + (2, 4)), jnp.uint32)
+            lo = halves[..., 0].astype(jnp.uint64)
+            hi = halves[..., 1].astype(jnp.uint64)
+            arr = (lo | (hi << jnp.uint64(32))).astype(jnp.dtype(dt))
         else:
             it = dtype.itemsize
             arr = jax.lax.bitcast_convert_type(
@@ -95,17 +118,22 @@ def _device_unpack(buf, spec):
 
 
 def _device_pack(outs: dict):
-    """Traced: dict of arrays -> (u8 buffer, spec)."""
+    """Traced: dict of packable arrays -> (u8 buffer, spec)."""
     segs = []
     spec = []
     off = 0
     for k in sorted(outs):
-        v = outs[k]
-        v = jnp.asarray(v)
+        v = jnp.asarray(outs[k])
         if v.dtype == jnp.uint8:
             u = v.reshape(-1)
         elif v.dtype == jnp.bool_:
             u = v.astype(jnp.uint8).reshape(-1)
+        elif v.dtype.itemsize == 8:
+            w = v.astype(jnp.uint64) if v.dtype == jnp.int64 else v
+            lo = (w & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+            hi = (w >> jnp.uint64(32)).astype(jnp.uint32)
+            halves = jnp.stack([lo, hi], axis=-1)
+            u = jax.lax.bitcast_convert_type(halves, jnp.uint8).reshape(-1)
         else:
             u = jax.lax.bitcast_convert_type(v, jnp.uint8).reshape(-1)
         nb = int(u.shape[0])
@@ -120,13 +148,15 @@ def _device_pack(outs: dict):
 
 
 class PackedOuts:
-    """Async handle for a packed stage result (device buffer + layout)."""
+    """Async handle for a packed stage result: one device buffer + layout,
+    plus any per-leaf arrays whose dtype can't ride the buffer (f64)."""
 
-    __slots__ = ("buf", "spec")
+    __slots__ = ("buf", "spec", "extras")
 
-    def __init__(self, buf, spec):
+    def __init__(self, buf, spec, extras=None):
         self.buf = buf
         self.spec = spec
+        self.extras = extras or {}
 
     def to_host(self) -> dict:
         import os
@@ -134,13 +164,16 @@ class PackedOuts:
 
         t0 = time.perf_counter()
         host = np.asarray(jax.device_get(self.buf))
+        out = _unpack_host(host, self.spec)
+        if self.extras:
+            out.update(jax.device_get(self.extras))
         if os.environ.get("TUPLEX_PACK_DEBUG"):
             import sys
 
-            print(f"[pack] d2h {host.nbytes >> 20}MB "
+            print(f"[pack] d2h {host.nbytes >> 20}MB+{len(self.extras)}x "
                   f"{time.perf_counter() - t0:.3f}s", file=sys.stderr,
                   flush=True)
-        return _unpack_host(host, self.spec)
+        return out
 
 
 class PackedStageFn:
@@ -156,21 +189,30 @@ class PackedStageFn:
 
     def __call__(self, arrays: dict):
         spec, total = _host_spec(arrays)
-        entry = self._fns.get(spec)
+        extras_in = {k: v for k, v in arrays.items()
+                     if not _packable(v.dtype)}
+        ekey = tuple(sorted((k, v.shape, v.dtype.str)
+                            for k, v in extras_in.items()))
+        entry = self._fns.get((spec, ekey))
         if entry is None:
             cell = {}
 
-            def traced(buf):
+            def traced(buf, extras):
                 args = _device_unpack(buf, spec)
+                args.update(extras)
                 outs = self._raw(args)
-                obuf, ospec = _device_pack(outs)
+                pack_outs = {k: v for k, v in outs.items()
+                             if _packable(jnp.asarray(v).dtype)}
+                extra_outs = {k: v for k, v in outs.items()
+                              if k not in pack_outs}
+                obuf, ospec = _device_pack(pack_outs)
                 cell["ospec"] = ospec
-                return obuf
+                return obuf, extra_outs
 
             fn = jax.jit(traced, donate_argnums=0) if self._donate \
                 else jax.jit(traced)
             entry = (fn, cell)
-            self._fns[spec] = entry
+            self._fns[(spec, ekey)] = entry
         fn, cell = entry
         import os
 
@@ -181,12 +223,12 @@ class PackedStageFn:
             t0 = time.perf_counter()
             buf = _pack_host(arrays, spec, total)
             t1 = time.perf_counter()
-            dbuf = fn(buf)
+            dbuf, extra_outs = fn(buf, extras_in)
             jax.block_until_ready(dbuf)
             print(f"[pack] host-pack {total >> 20}MB {t1 - t0:.3f}s; "
                   f"h2d+exec {time.perf_counter() - t1:.3f}s",
                   file=sys.stderr, flush=True)
-            return PackedOuts(dbuf, cell["ospec"])
+            return PackedOuts(dbuf, cell["ospec"], extra_outs)
         buf = _pack_host(arrays, spec, total)
-        dbuf = fn(buf)
-        return PackedOuts(dbuf, cell["ospec"])
+        dbuf, extra_outs = fn(buf, extras_in)
+        return PackedOuts(dbuf, cell["ospec"], extra_outs)
