@@ -48,6 +48,31 @@ def test_packing_roundtrip_all_dtypes(packed_identity):
         np.testing.assert_array_equal(g, want, err_msg=k)
 
 
+def test_packing_narrowed_len_wire(packed_identity):
+    # '#len' i32 columns ride the wire as u16 when their '#bytes' sibling
+    # width fits; '#err' must NOT narrow (op ids exceed u16)
+    from tuplex_tpu.runtime import packing as P
+
+    rng = np.random.default_rng(3)
+    arrays = {
+        "0#bytes": rng.integers(0, 256, (100, 40), np.uint8),
+        "0#len": rng.integers(0, 41, (100,)).astype(np.int32),
+        "wide#bytes": np.zeros((10, 1 << 16), np.uint8),
+        "wide#len": np.full((10,), 70000, np.int32),   # > u16: stays i32
+        "#err": (np.arange(100, dtype=np.int32) + (300 << 8)),  # op id 300
+    }
+    spec, _ = P._host_spec(arrays)
+    wire = {s[0]: s[5] for s in spec}
+    assert np.dtype(wire["0#len"]) == np.uint16
+    assert np.dtype(wire["wide#len"]) == np.int32
+    assert np.dtype(wire["#err"]) == np.int32
+    got = packed_identity(arrays)
+    for k, want in arrays.items():
+        g = np.asarray(got[k])
+        assert g.dtype == want.dtype, k
+        np.testing.assert_array_equal(g, want, err_msg=k)
+
+
 def test_packing_f64_rides_per_leaf(packed_identity):
     from tuplex_tpu.runtime import packing as P
 

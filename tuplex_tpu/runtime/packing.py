@@ -56,37 +56,60 @@ def _packable(dtype) -> bool:
                                np.dtype(np.int64), np.dtype(np.uint64))
 
 
+def _wire_dtype(k: str, dtype, arrays) -> np.dtype:
+    """Transfer dtype for a leaf. A '#len' column is bounded by its
+    sibling byte matrix's padded width, so when that width fits u16 the
+    lens ride the ~50 MB/s download narrowed and re-widen on arrival.
+    ('#err' is NOT narrowed: it packs class|op_id<<8 and operator ids
+    come from a session-global counter, so values exceed u16.)"""
+    if np.dtype(dtype) == np.dtype(np.int32) and k.endswith("#len"):
+        sib = arrays.get(k[:-4] + "#bytes")
+        if sib is not None and getattr(sib, "ndim", 0) == 2 \
+                and sib.shape[1] < (1 << 16):
+            return np.dtype(np.uint16)
+    return np.dtype(dtype)
+
+
 def _host_spec(arrays: dict):
-    """Deterministic layout: (key, shape, dtype_str, offset, nbytes)."""
+    """Deterministic layout: (key, shape, dtype_str, offset, wire_nbytes,
+    wire_dtype_str)."""
     spec = []
     off = 0
     for k in sorted(arrays):
         a = arrays[k]
         if not _packable(a.dtype):
             continue
-        nb = a.nbytes
-        spec.append((k, tuple(a.shape), a.dtype.str, off, nb))
+        wd = _wire_dtype(k, a.dtype, arrays)
+        nb = a.size * wd.itemsize
+        spec.append((k, tuple(a.shape), a.dtype.str, off, nb, wd.str))
         off += _pad(nb)
     return tuple(spec), off
 
 
 def _pack_host(arrays: dict, spec, total: int) -> np.ndarray:
     buf = np.zeros(total, dtype=np.uint8)
-    for k, shape, dt, off, nb in spec:
+    for k, shape, dt, off, nb, wdt in spec:
         if nb:
             a = np.ascontiguousarray(arrays[k])
+            if wdt != dt:
+                a = np.ascontiguousarray(a.astype(np.dtype(wdt)))
             buf[off:off + nb] = a.view(np.uint8).reshape(-1)
     return buf
 
 
 def _unpack_host(buf: np.ndarray, spec) -> dict:
     out = {}
-    for k, shape, dt, off, nb in spec:
+    for k, shape, dt, off, nb, wdt in spec:
         dtype = np.dtype(dt)
+        wdtype = np.dtype(wdt)
+        if not nb:
+            out[k] = np.zeros(shape, dtype=dtype)
+            continue
         # zero-copy views: offsets are _ALIGN-ed so every element aligns
-        out[k] = np.frombuffer(buf, dtype=dtype, count=nb // dtype.itemsize,
-                               offset=off).reshape(shape) \
-            if nb else np.zeros(shape, dtype=dtype)
+        arr = np.frombuffer(buf, dtype=wdtype,
+                            count=nb // wdtype.itemsize,
+                            offset=off).reshape(shape)
+        out[k] = arr.astype(dtype) if wdtype != dtype else arr
     return out
 
 
@@ -96,8 +119,8 @@ def _device_unpack(buf, spec):
     combine from u32 halves arithmetically — no 64-bit bitcast reaches
     the TPU x64 legalizer."""
     out = {}
-    for k, shape, dt, off, nb in spec:
-        dtype = np.dtype(dt)
+    for k, shape, dt, off, nb, wdt in spec:
+        dtype = np.dtype(wdt)
         seg = buf[off:off + nb]
         if dtype == np.uint8:
             arr = seg.reshape(shape)
@@ -112,7 +135,9 @@ def _device_unpack(buf, spec):
         else:
             it = dtype.itemsize
             arr = jax.lax.bitcast_convert_type(
-                seg.reshape(tuple(shape) + (it,)), jnp.dtype(dt))
+                seg.reshape(tuple(shape) + (it,)), jnp.dtype(dtype))
+        if dtype != np.dtype(dt) and arr.dtype != np.dtype(dt):
+            arr = arr.astype(jnp.dtype(dt))     # re-widen narrowed wires
         out[k] = arr
     return out
 
@@ -124,6 +149,10 @@ def _device_pack(outs: dict):
     off = 0
     for k in sorted(outs):
         v = jnp.asarray(outs[k])
+        orig_dt = np.dtype(v.dtype).str
+        wd = _wire_dtype(k, np.dtype(v.dtype), outs)
+        if wd != np.dtype(v.dtype):
+            v = v.astype(jnp.dtype(wd))         # narrowed wire dtype
         if v.dtype == jnp.uint8:
             u = v.reshape(-1)
         elif v.dtype == jnp.bool_:
@@ -141,7 +170,7 @@ def _device_pack(outs: dict):
         if pad:
             u = jnp.pad(u, (0, pad))
         segs.append(u)
-        spec.append((k, tuple(v.shape), v.dtype.str, off, nb))
+        spec.append((k, tuple(v.shape), orig_dt, off, nb, wd.str))
         off += _pad(nb)
     buf = jnp.concatenate(segs) if segs else jnp.zeros(0, jnp.uint8)
     return buf, tuple(spec)
@@ -223,12 +252,14 @@ class PackedStageFn:
             t0 = time.perf_counter()
             buf = _pack_host(arrays, spec, total)
             t1 = time.perf_counter()
-            dbuf, extra_outs = fn(buf, extras_in)
+            dbuf, extra_outs = fn(jax.device_put(buf), extras_in)
             jax.block_until_ready(dbuf)
             print(f"[pack] host-pack {total >> 20}MB {t1 - t0:.3f}s; "
                   f"h2d+exec {time.perf_counter() - t1:.3f}s",
                   file=sys.stderr, flush=True)
             return PackedOuts(dbuf, cell["ospec"], extra_outs)
         buf = _pack_host(arrays, spec, total)
-        dbuf, extra_outs = fn(buf, extras_in)
+        # explicit placement: measured 871 MB/s vs 534 MB/s letting the jit
+        # call transfer its numpy argument over the tunnel
+        dbuf, extra_outs = fn(jax.device_put(buf), extras_in)
         return PackedOuts(dbuf, cell["ospec"], extra_outs)
