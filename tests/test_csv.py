@@ -290,3 +290,20 @@ def test_projection_through_aggregate_boundary(tmp_path):
     got2 = sorted(map(repr, ds2.collect()))
     got1 = sorted(map(repr, ds.collect()))
     assert got1 == got2
+
+
+def test_chunk_sizes_balanced():
+    # balanced splitting: no tiny tail partition (its fixed dispatch cost
+    # dwarfs its rows on the tunneled TPU), empty input yields no chunks
+    from tuplex_tpu.io.csvsource import _chunk_sizes
+
+    assert _chunk_sizes(0, 1000) == []
+    assert _chunk_sizes(-5, 1000) == []
+    assert _chunk_sizes(500, 1000) == [500]
+    assert _chunk_sizes(1000, 1000) == [1000]
+    assert _chunk_sizes(1250, 1000) == [1250]        # absorbed tail (+25%)
+    got = _chunk_sizes(2600, 1000)                   # balanced, not 1000+1000+600
+    assert sum(got) == 2600 and len(got) == 3
+    assert max(got) - min(got) <= 1
+    got = _chunk_sizes(101350, 100000)
+    assert got == [101350]

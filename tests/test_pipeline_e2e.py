@@ -332,3 +332,18 @@ def test_float_inf_nan_literals_resolve_on_interpreter(ctx):
     assert math.isnan(got[3])
     assert ds.exception_counts() == {"ValueError": 1}
     assert ctx.metrics.fastPathWallTime() > 0
+
+
+def test_cpu_jit_wrapper_runs_on_cpu_device():
+    # host-resolve wrapper: compiles and places on the CPU device even when
+    # invoked from any default backend; numpy in, exact result out
+    import numpy as np
+
+    from tuplex_tpu.exec.local import _CpuJit, _cpu_device
+
+    assert _cpu_device() is not None
+    fn = _CpuJit(lambda d: {"y": d["x"] * 2 + 1})
+    out = fn({"x": np.arange(5, dtype=np.int64)})
+    got = np.asarray(out["y"])
+    np.testing.assert_array_equal(got, np.arange(5, dtype=np.int64) * 2 + 1)
+    assert list(out["y"].devices())[0].platform == "cpu"
