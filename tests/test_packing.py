@@ -48,22 +48,38 @@ def test_packing_roundtrip_all_dtypes(packed_identity):
         np.testing.assert_array_equal(g, want, err_msg=k)
 
 
+def _str_matrix(rng, n, w, lens=None):
+    """Canonical StrLeaf byte matrix: random content, zero past len (the
+    columnar contract — signatures/decode never read past the length, and
+    the varlen wire ships only the content bytes)."""
+    lens = rng.integers(0, w + 1, (n,)).astype(np.int32) \
+        if lens is None else lens
+    mat = rng.integers(1, 256, (n, w), np.uint8)
+    mat = np.where(np.arange(w)[None, :] < lens[:, None], mat, 0)
+    return mat.astype(np.uint8), lens
+
+
 def test_packing_narrowed_len_wire(packed_identity):
     # '#len' i32 columns ride the wire as u16 when their '#bytes' sibling
     # width fits; '#err' must NOT narrow (op ids exceed u16)
     from tuplex_tpu.runtime import packing as P
 
     rng = np.random.default_rng(3)
+    mat, lens = _str_matrix(rng, 100, 40)
+    mat16, lens16 = _str_matrix(rng, 100, 1000)
     arrays = {
-        "0#bytes": rng.integers(0, 256, (100, 40), np.uint8),
-        "0#len": rng.integers(0, 41, (100,)).astype(np.int32),
+        "0#bytes": mat,
+        "0#len": lens,                                 # W <= 255: u8
+        "m#bytes": mat16,
+        "m#len": lens16,                               # 255 < W < 2^16: u16
         "wide#bytes": np.zeros((10, 1 << 16), np.uint8),
         "wide#len": np.full((10,), 70000, np.int32),   # > u16: stays i32
         "#err": (np.arange(100, dtype=np.int32) + (300 << 8)),  # op id 300
     }
     spec, _ = P._host_spec(arrays)
     wire = {s[0]: s[5] for s in spec}
-    assert np.dtype(wire["0#len"]) == np.uint16
+    assert np.dtype(wire["0#len"]) == np.uint8
+    assert np.dtype(wire["m#len"]) == np.uint16
     assert np.dtype(wire["wide#len"]) == np.int32
     assert np.dtype(wire["#err"]) == np.int32
     got = packed_identity(arrays)
@@ -85,3 +101,147 @@ def test_packing_f64_rides_per_leaf(packed_identity):
 
 def test_packing_empty_dict(packed_identity):
     assert packed_identity({}) == {}
+
+
+# ---------------------------------------------------------------------------
+# varlen wire (offsets+payload instead of padded [B, W] matrices)
+# ---------------------------------------------------------------------------
+
+def _varlen_roundtrip(arrays):
+    from tuplex_tpu.runtime.packing import PackedOuts, PackedStageFn
+
+    fn = PackedStageFn(lambda a: dict(a), donate=False)
+    out = fn(arrays)
+    assert isinstance(out, PackedOuts)
+    return out, out.to_host()
+
+
+def test_varlen_roundtrip_device_to_host():
+    # device varlen pack -> host unpack: empty strings, max-width rows,
+    # and ordinary mixed lengths all round-trip exactly
+    rng = np.random.default_rng(11)
+    w = 48
+    mat, lens = _str_matrix(rng, 300, w)
+    lens[0] = 0                    # empty string
+    mat[0] = 0
+    lens[1] = w                    # max-width row
+    mat[1] = rng.integers(1, 256, w, np.uint8)
+    mat2, lens2 = _str_matrix(rng, 300, 16)
+    arrays = {"0#bytes": mat, "0#len": lens,
+              "1#bytes": mat2, "1#len": lens2,
+              "2": rng.integers(-5, 5, 300),
+              "#err": np.zeros(300, np.int32)}
+    out, got = _varlen_roundtrip(arrays)
+    vkinds = {k: kind for kind, k, _, _ in out.vspec}
+    assert vkinds["0#bytes"] == "str" and vkinds["1#bytes"] == "str"
+    assert vkinds["2"] == "hi32"           # 1-D i64: lo/hi split wire
+    assert vkinds["#err"] == "sparse32"    # zero-dominated lattice
+    for k, want in arrays.items():
+        g = np.asarray(got[k])
+        assert g.dtype == want.dtype, k
+        np.testing.assert_array_equal(g, want, err_msg=k)
+
+
+def test_varlen_all_empty_and_zero_rows():
+    arrays = {"0#bytes": np.zeros((64, 8), np.uint8),
+              "0#len": np.zeros(64, np.int32),
+              "1#bytes": np.zeros((0, 4), np.uint8),
+              "1#len": np.zeros(0, np.int32)}
+    out, got = _varlen_roundtrip(arrays)
+    for k, want in arrays.items():
+        np.testing.assert_array_equal(np.asarray(got[k]), want, err_msg=k)
+
+
+def test_varlen_u16_boundary_len():
+    # len == 2^16-1 is the last value that narrows to u16; the width must
+    # be >= the len for the wire to carry it (W bounds len by contract)
+    n = 4
+    w = (1 << 16) - 1
+    lens = np.full(n, w, np.int32)
+    mat = np.ones((n, w), np.uint8)
+    arrays = {"0#bytes": mat, "0#len": lens}
+    from tuplex_tpu.runtime import packing as P
+
+    spec, _ = P._host_spec(arrays)
+    wire = {s[0]: s[5] for s in spec}
+    assert np.dtype(wire["0#len"]) == np.uint16   # 65535 still fits
+    out, got = _varlen_roundtrip(arrays)
+    np.testing.assert_array_equal(np.asarray(got["0#len"]), lens)
+    np.testing.assert_array_equal(np.asarray(got["0#bytes"]), mat)
+
+
+def test_u16_narrowing_invariant_validated_on_host():
+    # a '#len' leaf violating the len<=width invariant (out of the
+    # narrowed range, or negative) must fall back to i32 on the host pack
+    # path instead of silently wrapping (ADVICE r5)
+    from tuplex_tpu.runtime import packing as P
+
+    base = {"0#bytes": np.zeros((8, 100), np.uint8)}
+    for bad in (np.full(8, 1 << 16, np.int32),
+                np.full(8, 300, np.int32),     # > u8 range for W=100
+                np.full(8, -3, np.int32)):
+        arrays = dict(base)
+        arrays["0#len"] = bad
+        spec, total = P._host_spec(arrays)
+        wire = {s[0]: s[5] for s in spec}
+        assert np.dtype(wire["0#len"]) == np.int32, bad[0]
+        buf = P._pack_host(arrays, spec, total)
+        got = P._unpack_host(buf, spec)
+        np.testing.assert_array_equal(got["0#len"], bad)
+    good = dict(base)
+    good["0#len"] = np.full(8, 99, np.int32)
+    spec, _ = P._host_spec(good)
+    assert np.dtype({s[0]: s[5] for s in spec}["0#len"]) == np.uint8
+    wide = {"0#bytes": np.zeros((8, 1000), np.uint8),
+            "0#len": np.full(8, 700, np.int32)}
+    spec, _ = P._host_spec(wide)
+    assert np.dtype({s[0]: s[5] for s in spec}["0#len"]) == np.uint16
+
+
+def test_varlen_wire_shrinks_padded_strings():
+    # zillow-shaped leaves (wide padded matrices, short content) must ship
+    # >= 3x fewer D2H bytes on the varlen wire than fixed-width packing
+    from tuplex_tpu.runtime import xferstats
+    from tuplex_tpu.runtime.packing import PackedStageFn
+
+    rng = np.random.default_rng(5)
+    n, w = 2048, 256
+    lens = rng.integers(5, 30, n).astype(np.int32)   # ~20B of content
+    mat = np.where(np.arange(w)[None, :] < lens[:, None],
+                   rng.integers(1, 256, (n, w), np.uint8), 0).astype(np.uint8)
+    arrays = {"0#bytes": mat, "0#len": lens,
+              "1": rng.integers(0, 9, n), "#err": np.zeros(n, np.int32)}
+
+    def measure(env_val, monkey):
+        monkey.setenv("TUPLEX_VARLEN_WIRE", env_val)
+        fn = PackedStageFn(lambda a: dict(a), donate=False)
+        snap = xferstats.snapshot()
+        got = fn(arrays).to_host()
+        for k in arrays:
+            np.testing.assert_array_equal(np.asarray(got[k]), arrays[k], k)
+        return xferstats.delta(snap)["d2h_bytes"]
+
+    import pytest
+
+    mp = pytest.MonkeyPatch()
+    try:
+        fixed = measure("0", mp)
+        varlen = measure("1", mp)
+    finally:
+        mp.undo()
+    assert varlen * 3 <= fixed, (varlen, fixed)
+
+
+def test_strleaf_wire_view_roundtrip():
+    from tuplex_tpu.runtime import columns as C
+
+    leaf = C.encode_str_leaf(["", "hello", "x" * 31, None, "df"],
+                             optional=True)
+    payload, lens = leaf.to_wire()
+    assert payload.nbytes == int(np.clip(leaf.lengths, 0,
+                                         leaf.width).sum())
+    back = C.StrLeaf.from_wire(payload, lens, leaf.width, leaf.valid)
+    np.testing.assert_array_equal(back.bytes, leaf.bytes)
+    np.testing.assert_array_equal(back.lengths, leaf.lengths)
+    for i in range(5):
+        assert C.decode_str(back, i) == C.decode_str(leaf, i)

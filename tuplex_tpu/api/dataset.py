@@ -16,7 +16,8 @@ from typing import Any, Callable, Optional, Sequence
 from ..core import typesys as T
 from ..core.errors import TuplexException
 from ..plan import logical as L
-from ..plan.physical import AggregateStage, TransformStage, plan_stages
+from ..plan.physical import (AggregateStage, JoinStage, TransformStage,
+                             plan_stages)
 
 
 def _vfs_is_dir(path: str) -> bool:
@@ -288,10 +289,20 @@ class DataSet:
                             isinstance(stage, TransformStage)
                         partitions = _source_partitions(
                             self._context, stage, lazy=lazy)
-                    # device handoff pays off only when the NEXT stage
-                    # re-stages this output onto the device (transform/
-                    # aggregate); join probes consume host-side
+                    # device handoff: tell the backend WHO consumes this
+                    # stage's output ("stage"/"agg"/"join" — all three
+                    # drain device views now; round 5 excluded joins and
+                    # aggregates, which made q19/flights round-trip every
+                    # boundary through the ~50 MB/s tunnel)
                     nxt = stages[si + 1] if si + 1 < len(stages) else None
+                    consumer = False
+                    if not getattr(nxt, "force_interpret", False):
+                        if isinstance(nxt, AggregateStage):
+                            consumer = "agg"
+                        elif isinstance(nxt, JoinStage):
+                            consumer = "join"
+                        elif isinstance(nxt, TransformStage):
+                            consumer = "stage"
                     kw = {}
                     if output_sink is not None and \
                             si == len(stages) - 1 and \
@@ -303,10 +314,7 @@ class DataSet:
                     try:
                         result = backend.execute_any(
                             stage, partitions, self._context,
-                            intermediate=isinstance(
-                                nxt, (TransformStage, AggregateStage))
-                            and not getattr(nxt, "force_interpret", False),
-                            **kw)
+                            intermediate=consumer, **kw)
                     finally:
                         backend.progress_cb = None
                     partitions = result.partitions

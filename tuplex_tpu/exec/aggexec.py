@@ -239,9 +239,9 @@ class AggregateExecutor:
         n = part.num_rows
         nseg = len(uniq_rows)
         nseg_b = C.bucket_size(nseg)
-        keys = []
-        for row in C.decode_rows(part, uniq_rows.tolist()):
-            keys.append(tuple(row.values[j] for j in kidx))
+        # key columns only: a device-resident (lazy) partition must not be
+        # forced to host just to name its groups
+        keys = C.decode_key_tuples(part, uniq_rows.tolist(), kidx)
         try:
             seg_init = A._scanfold_encode_segments(
                 scan, [groups.get(k, op.initial) for k in keys], nseg_b)
@@ -401,10 +401,11 @@ class AggregateExecutor:
                 r = jax.ops.segment_max(masked, codes_b,
                                         num_segments=nseg + 1)
             seg_partials.append(np.asarray(r)[:nseg])
-        # merge per-key partials into the global dict
+        # merge per-key partials into the global dict (key columns only —
+        # see decode_key_tuples: full decode would force lazy leaves)
+        key_vals = C.decode_key_tuples(part, uniq_rows, kidx)
         for si, row_i in enumerate(uniq_rows):
-            row = part.decode_row(int(row_i))
-            k = tuple(row.values[j] for j in kidx)
+            k = key_vals[si]
             acc = groups.get(k, op.initial)
             accs = list(acc) if isinstance(acc, tuple) else [acc]
             merged = []
@@ -454,12 +455,12 @@ class AggregateExecutor:
         ok_np = M.materialize_np(outs[-1])[:n] & real
         counts = M.materialize_np(outs[-2])[:nseg]
         seg_partials = [np.asarray(o)[:nseg] for o in outs[:-2]]
+        key_vals = C.decode_key_tuples(part, uniq_rows, kidx)
         for si, row_i in enumerate(uniq_rows):
             if counts[si] == 0:
                 continue  # every row of this key failed: no ghost group —
                           # the interpreter fold below decides its fate
-            row = part.decode_row(int(row_i))
-            k = tuple(row.values[j] for j in kidx)
+            k = key_vals[si]
             acc = groups.get(k, op.initial)
             accs = list(acc) if isinstance(acc, tuple) else [acc]
             merged = [_combine_scalar(reducer, accs[j],

@@ -38,7 +38,8 @@ class JoinExecutor:
     def __init__(self, backend):
         self.backend = backend
 
-    def execute(self, stage, left_partitions: list[C.Partition], context):
+    def execute(self, stage, left_partitions: list[C.Partition], context,
+                intermediate=False):
         from ..plan.physical import plan_stages
 
         op = stage.op
@@ -61,6 +62,18 @@ class JoinExecutor:
         vec = None
         if self._device_join_enabled():
             vec = _DeviceProbe.try_build(op, rparts or [], self.backend)
+            if vec is not None:
+                # device-resident OUTPUT: when a later stage consumes this
+                # join, the match-expansion gathers stay on device and the
+                # host leaves go lazy (jaxcfg gate per consumer kind)
+                from ..runtime.jaxcfg import (device_handoff_budget_bytes,
+                                              device_handoff_enabled)
+
+                vec.dev_out = bool(intermediate) and device_handoff_enabled(
+                    intermediate if isinstance(intermediate, str)
+                    else "stage")
+                if vec.dev_out:
+                    vec._handoff_left = device_handoff_budget_bytes()
         if vec is None:
             vec = _VectorBuild.try_build(op, rparts or [], self.backend)
         if vec is not None and not all(
@@ -397,8 +410,46 @@ class _VectorBuild:
         jitted device gathers."""
         return _gather_leaves(part, idx, valid_rows)
 
+    def _output_layout(self, ls: T.RowType):
+        """(out_cols, out_types, entries) where entries[i] = (side,
+        src_ci, make_opt) maps output column i to its source column —
+        the single definition of the join's output column order, shared
+        by the host and device assemblies."""
+        op = self.op
+        rs = self.big.schema
+        lk = ls.columns.index(op.left_column)
+        out_cols: list[str] = []
+        out_types: list = []
+        entries: list[tuple[str, int, bool]] = []
+        for i, (c, t) in enumerate(zip(ls.columns, ls.types)):
+            if i == lk:
+                continue
+            out_cols.append(op._decorate(c, 0))
+            out_types.append(t)
+            entries.append(("l", i, False))
+        out_cols.append(op.left_column)
+        out_types.append(ls.types[lk])
+        entries.append(("l", lk, False))
+        for i, (c, t) in enumerate(zip(rs.columns, rs.types)):
+            if i == self.rk:
+                continue
+            out_cols.append(op._decorate(c, 1))
+            mo = op.how == "left"
+            out_types.append(T.option(t) if mo else t)
+            entries.append(("r", i, mo))
+        return out_cols, out_types, entries
+
     def _probe_sig(self, lpart: C.Partition, sig: np.ndarray, excs: list
                    ) -> Optional[C.Partition]:
+        plan = self._probe_plan(lpart, sig, excs)
+        return self._assemble_host(lpart, plan)
+
+    def _probe_plan(self, lpart: C.Partition, sig: np.ndarray,
+                    excs: list) -> dict:
+        """Host-side match planning shared by the host and device
+        assemblies: per-row match counts, boxed-row splices, output slot
+        layout, and the flat (left_idx, build_rows, has_match) gather
+        program for the vectorized portion."""
         op = self.op
         ls = lpart.schema
         lk = ls.columns.index(op.left_column)
@@ -479,6 +530,23 @@ class _VectorBuild:
                                           max(len(self.order) - 1, 0))], 0)
         # output slot of each vectorized row: row start + intra-group rank
         vec_slots = np.repeat(starts, vec_take) + intra
+        return {"lk": lk, "is_fb": is_fb, "cnt": cnt,
+                "extra_rows": extra_rows, "starts": starts, "m": m,
+                "m_vec": m_vec, "left_idx": left_idx,
+                "build_rows": build_rows, "has_match": has_match,
+                "vec_slots": vec_slots}
+
+    def _assemble_host(self, lpart: C.Partition, plan: dict
+                       ) -> Optional[C.Partition]:
+        """Materialize the join output on host from the gather program."""
+        op = self.op
+        ls = lpart.schema
+        left_idx = plan["left_idx"]
+        build_rows = plan["build_rows"]
+        has_match = plan["has_match"]
+        m_vec = plan["m_vec"]
+        m = plan["m"]
+        extra_rows = plan["extra_rows"]
         # gather left (minus key), key, right (minus key)
         lgather = self._gather(lpart, left_idx)
         rgather = self._gather(self.big, build_rows,
@@ -486,17 +554,10 @@ class _VectorBuild:
                                if op.how == "left" else None)
         if lgather is None or rgather is None:
             return None
-        rs = self.big.schema
-        out_cols: list[str] = []
-        out_types: list = []
+        out_cols, out_types, entries = self._output_layout(ls)
         leaves: dict[str, C.Leaf] = {}
-
-        def put(col_t, src_leaves, src_ci, make_opt=False):
-            ci_out = len(out_types)
-            t = col_t
-            if make_opt:
-                t = T.option(t)
-            out_types.append(t)
+        for ci_out, (side, src_ci, _mo) in enumerate(entries):
+            src_leaves = lgather if side == "l" else rgather
             for path, leaf in src_leaves.items():
                 if path == str(src_ci) or path.startswith(f"{src_ci}.") or \
                         path.startswith(f"{src_ci}#"):
@@ -504,25 +565,14 @@ class _VectorBuild:
                     # was called with valid_rows=has_match for left joins
                     newp = str(ci_out) + path[len(str(src_ci)):]
                     leaves[newp] = leaf
-
-        for i, (c, t) in enumerate(zip(ls.columns, ls.types)):
-            if i == lk:
-                continue
-            out_cols.append(op._decorate(c, 0))
-            put(t, lgather, i)
-        out_cols.append(op.left_column)
-        put(ls.types[lk], lgather, lk)
-        for i, (c, t) in enumerate(zip(rs.columns, rs.types)):
-            if i == self.rk:
-                continue
-            out_cols.append(op._decorate(c, 1))
-            put(t, rgather, i, make_opt=(op.how == "left"))
         schema = T.row_of(out_cols, out_types)
         vec_part = C.Partition(schema=schema, num_rows=m_vec, leaves=leaves,
                                start_index=lpart.start_index)
         if not extra_rows:
             return vec_part
         # ---- splice boxed outputs into their slots ------------------------
+        starts, cnt, is_fb = plan["starts"], plan["cnt"], plan["is_fb"]
+        vec_slots = plan["vec_slots"]
         outp = C.gather_partition(vec_part, vec_slots,
                                   np.arange(m_vec, dtype=np.int64), m)
         outp.start_index = lpart.start_index
@@ -572,10 +622,15 @@ def _build_probe_fn(u: int, nw: int, mesh=None):
     # Falls back to the log-step search when the broadcast build side is
     # large enough that the B x u compare matrix would out-cost it.
     direct = u * max(1, nw) <= (1 << 15)
+    # the loop-carried [chunk, u] less/prefix_eq intermediates are bounded
+    # by chunking the probe batch: an unchunked 1M-row bucket against
+    # u=32768 would carry multi-GB booleans per dispatch if XLA doesn't
+    # fuse the chain into the reductions (ADVICE r5) — cap chunk*u*nw
+    _DIRECT_CHUNK_ELEMS = 1 << 22
 
-    def lower_bound_direct(words, build_words):
+    def _lower_bound_direct_one(words, build_words):
         bw = build_words[None, :, :]          # [1, u, nw]
-        pw = words[:, None, :]                # [B, 1, nw]
+        pw = words[:, None, :]                # [chunk, 1, nw]
         lt = bw < pw
         eq = bw == pw
         b = words.shape[0]
@@ -588,6 +643,19 @@ def _build_probe_fn(u: int, nw: int, mesh=None):
         matched = prefix_eq.any(axis=1)       # some build row fully equal
         return (jnp.clip(pos, 0, max(u - 1, 0)).astype(jnp.int64),
                 matched)
+
+    def lower_bound_direct(words, build_words):
+        b = words.shape[0]
+        chunk = max(1, _DIRECT_CHUNK_ELEMS // max(1, u * max(1, nw)))
+        if b <= chunk:
+            return _lower_bound_direct_one(words, build_words)
+        nchunks = -(-b // chunk)
+        pad = nchunks * chunk - b
+        wpad = jnp.pad(words, ((0, pad), (0, 0))) if pad else words
+        pos, matched = jax.lax.map(
+            lambda w: _lower_bound_direct_one(w, build_words),
+            wpad.reshape(nchunks, chunk, wpad.shape[1]))
+        return pos.reshape(-1)[:b], matched.reshape(-1)[:b]
 
     def lower_bound_search(words, build_words):
         b = words.shape[0]
@@ -646,6 +714,36 @@ def _leaf_flat_arrays(part: C.Partition, prefix: str) -> Optional[dict]:
     return out
 
 
+def _build_assemble_fn(pairs: tuple, left_join: bool):
+    """Jittable join-output assembly: gathers every source leaf array at
+    the match-expansion indices and emits OUTPUT-convention keys (path /
+    path#bytes / path#len / path#valid) so the result doubles as the
+    output partition's device view. pairs: (outkey, side, srckey|None,
+    suffix) with suffix 'synth_v' synthesizing Option validity for left
+    joins whose build side had none."""
+    from ..runtime.jaxcfg import jax, jnp
+
+    def fn(larr, rarr, lidx, ridx, hm):
+        out = {}
+        for outkey, side, srckey, suf in pairs:
+            if suf == "synth_v":
+                out[outkey] = hm
+                continue
+            src = larr if side == "l" else rarr
+            idx = lidx if side == "l" else ridx
+            g = src[srckey][idx]
+            if side == "r" and left_join:
+                if suf == "v":
+                    g = g & hm
+                elif suf == "d":
+                    shape = (hm.shape[0],) + (1,) * (g.ndim - 1)
+                    g = jnp.where(hm.reshape(shape), g, 0)
+            out[outkey] = g
+        return out
+
+    return jax.jit(fn)
+
+
 def _build_gather_fn(lkeys: tuple, rkeys: tuple, left_join: bool):
     """Jittable match-expansion gather: output row i takes left row
     left_idx[i] and build row build_rows[i]; for left joins the unmatched
@@ -676,7 +774,14 @@ class _DeviceProbe(_VectorBuild):
     side by the reference's own cost model) and ships to the device once;
     probe partitions search it with a vectorized binary search and expand
     matches with device gathers. Reference: PipelineBuilder.h
-    innerJoinDict/leftJoinDict fused probes; HashJoinStage.cc:473."""
+    innerJoinDict/leftJoinDict fused probes; HashJoinStage.cc:473.
+
+    With `dev_out` set (the join feeds a later stage and the handoff gate
+    allows it), the match-expansion output stays ON DEVICE: the result
+    partition carries a device view for the consumer and lazy host leaves
+    that fetch only if some slow path needs them."""
+
+    dev_out = False
 
     @classmethod
     def try_build(cls, op, rparts, backend):
@@ -691,7 +796,155 @@ class _DeviceProbe(_VectorBuild):
         self._nw = self._build_words.shape[1]
         self._mesh = getattr(backend, "mesh", None)
         self.backend = backend
+        self._rflat_dev = None
         return self
+
+    # ------------------------------------------------------------------
+    def _probe_sig(self, lpart: C.Partition, sig: np.ndarray, excs: list
+                   ) -> Optional[C.Partition]:
+        plan = self._probe_plan(lpart, sig, excs)
+        if self.dev_out and self._mesh is None and not plan["extra_rows"]:
+            outp = self._assemble_device(lpart, plan)
+            if outp is not None:
+                return outp
+        return self._assemble_host(lpart, plan)
+
+    def _assemble_device(self, lpart: C.Partition, plan: dict
+                         ) -> Optional[C.Partition]:
+        """Device-resident join output: one jitted gather writes the
+        output-convention arrays; host leaves go lazy and the next stage
+        consumes the attached view directly. Best-effort — None falls back
+        to the host assembly (identical semantics)."""
+        try:
+            import jax
+
+            from ..runtime import xferstats
+            from ..runtime.jaxcfg import jnp
+
+            op = self.op
+            m = int(plan["m"])
+            if m == 0 or plan["m_vec"] != m:
+                return None
+            out_cols, out_types, entries = self._output_layout(lpart.schema)
+            rs = self.big.schema
+            for side, src_ci, mo in entries:
+                if not mo:
+                    continue
+                base = rs.types[src_ci]
+                base = base.without_option() if base.is_optional() else base
+                if isinstance(base, T.TupleType) or \
+                        base in (T.NULL, T.EMPTYTUPLE):
+                    return None   # nested Option synthesis: host path
+            # PEEK the input view: every bail below must leave it intact
+            # for the host assembly (a burnt view would force a full
+            # lazy-leaf D2H — worse than no handoff at all)
+            lflat = self._flat_device_arrays(lpart, "l.", consume=False)
+            if lflat is None:
+                return None
+            if self._rflat_dev is None:
+                rf = _leaf_flat_arrays(self.big, "r.")
+                if rf is None:
+                    return None
+                # the device copy of the build side pins HBM for the
+                # executor's lifetime: charge it against the handoff
+                # budget once, up front
+                rf_nb = sum(v.nbytes for v in rf.values())
+                if rf_nb > getattr(self, "_handoff_left", 0):
+                    return None
+                self._handoff_left -= rf_nb
+                self._rflat_dev = {k: jnp.asarray(v) for k, v in rf.items()}
+            rflat = self._rflat_dev
+            left = op.how == "left"
+
+            def src_pairs(flat, side_tag, src_ci, ci_out):
+                ps = []
+                for k in flat:
+                    core = k[2:]
+                    srcpath, suf = core.rsplit("#", 1)
+                    if not (srcpath == str(src_ci)
+                            or srcpath.startswith(f"{src_ci}.")
+                            or srcpath.startswith(f"{src_ci}#")):
+                        continue
+                    outpath = str(ci_out) + srcpath[len(str(src_ci)):]
+                    outkey = {"d": outpath, "b": outpath + "#bytes",
+                              "l": outpath + "#len",
+                              "v": outpath + "#valid"}[suf]
+                    ps.append((outkey, side_tag, k, suf))
+                return ps
+
+            pairs: list = []
+            for ci_out, (side, src_ci, mo) in enumerate(entries):
+                flat = lflat if side == "l" else rflat
+                ps = src_pairs(flat, side, src_ci, ci_out)
+                if mo and not any(ok == f"{ci_out}#valid"
+                                  for ok, _, _, _ in ps):
+                    ps.append((f"{ci_out}#valid", "r", None, "synth_v"))
+                pairs.extend(ps)
+
+            # structural check: the assembled keys must be exactly what a
+            # host-materialized partition would stage (one executable for
+            # handoff-fed and host-fed batches alike)
+            leaf_types: dict = {}
+            for ci, ct in enumerate(out_types):
+                for pth, lt in C.flatten_type(ct, str(ci)):
+                    leaf_types[pth] = lt
+            expect: set = set()
+            for pth, lt in leaf_types.items():
+                expect.update(C.staged_keys_for_type(pth, lt))
+            if expect != {ok for ok, _, _, _ in pairs}:
+                return None
+
+            b2 = C.bucket_size(m, self.backend.bucket_mode)
+            est = b2
+            for _, side_tag, sk, suf in pairs:
+                if sk is None:
+                    est += b2
+                    continue
+                a = (lflat if side_tag == "l" else rflat)[sk]
+                est += (a.nbytes // max(1, int(a.shape[0]))) * b2
+            if est * 2 > getattr(self, "_handoff_left", 0):
+                return None
+            self._handoff_left -= est * 2
+            lpart.device_batch = None     # committed: release the one-shot
+
+            lidx = np.zeros(b2, np.int64)
+            lidx[:m] = plan["left_idx"]
+            ridx = np.zeros(b2, np.int64)
+            ridx[:m] = plan["build_rows"]
+            hm = np.zeros(b2, np.bool_)
+            hm[:m] = plan["has_match"]
+            fkey = ("joinassemble", tuple(pairs), left)
+            fn = self.backend.jit_cache.get_or_build(
+                fkey, lambda: _build_assemble_fn(tuple(pairs), left))
+            outs = fn(lflat, rflat, jnp.asarray(lidx), jnp.asarray(ridx),
+                      jnp.asarray(hm))
+
+            schema = T.row_of(out_cols, out_types)
+            outp = C.Partition(schema=schema, num_rows=m, leaves={},
+                               start_index=lpart.start_index)
+            view = dict(outs)
+            rv = np.zeros(b2, np.bool_)
+            rv[:m] = True
+            view["#rowvalid"] = jnp.asarray(rv)
+            view["#seed"] = C.partition_seed(outp)
+
+            def loader(pth):
+                arrs = {}
+                for k in C.result_keys_for_leaf(outs, pth):
+                    h = np.asarray(jax.device_get(outs[k][:m]))
+                    xferstats.note_d2h(h.nbytes)
+                    arrs[k] = h
+                return C.leaf_from_result_arrays(arrs, pth,
+                                                 leaf_types[pth], m)
+
+            ll = C.LazyLeaves(leaf_types.keys(), loader, tag="join")
+            ll.nbytes_hint = est
+            outp.leaves = ll
+            outp.device_batch = C.DeviceBatch(arrays=view, n=m, b=b2,
+                                              schema=schema)
+            return outp
+        except Exception:   # pragma: no cover - purely an optimization
+            return None
 
     def _match_positions(self, sig: np.ndarray):
         import numpy as _np
@@ -715,6 +968,35 @@ class _DeviceProbe(_VectorBuild):
         matched = _mesh.materialize_np(matched)[:n]
         return pos, matched
 
+    def _flat_device_arrays(self, part: C.Partition, side: str,
+                            consume: bool = True):
+        """Flat '#d/#b/#l/#v' gather inputs, preferring a device-resident
+        handoff view over host leaves (the view's arrays skip both the
+        D2H of the producing stage and the H2D here). Falls back to the
+        host leaf arrays — forcing lazy leaves if it must.
+
+        consume=False peeks without releasing the one-shot view — callers
+        that may still bail to the host path must not burn it (a consumed
+        view would force a full lazy-leaf D2H on the fallback)."""
+        dv = getattr(part, "device_batch", None)
+        if dv is not None and dv.n == part.num_rows:
+            if consume:
+                part.device_batch = None      # one-shot, like stage_partition
+            out = {}
+            for k, v in dv.arrays.items():
+                if k in ("#rowvalid", "#seed"):
+                    continue
+                if k.endswith("#bytes"):
+                    out[f"{side}{k[:-6]}#b"] = v
+                elif k.endswith("#len"):
+                    out[f"{side}{k[:-4]}#l"] = v
+                elif k.endswith("#valid"):
+                    out[f"{side}{k[:-6]}#v"] = v
+                else:
+                    out[f"{side}{k}#d"] = v
+            return out
+        return _leaf_flat_arrays(part, side)
+
     def _gather(self, part: C.Partition, idx: np.ndarray, valid_rows=None
                 ) -> Optional[dict]:
         import numpy as _np
@@ -725,7 +1007,7 @@ class _DeviceProbe(_VectorBuild):
         if m == 0:
             return _gather_leaves(part, idx, valid_rows)
         side = "r." if part is self.big else "l."
-        arrays = _leaf_flat_arrays(part, side)
+        arrays = self._flat_device_arrays(part, side)
         if arrays is None:
             return _gather_leaves(part, idx, valid_rows)
         mb = C.bucket_size(m)
@@ -738,31 +1020,35 @@ class _DeviceProbe(_VectorBuild):
         fn = self.backend.jit_cache.get_or_build(
             ("joingather", side, keys, left_join),
             lambda: _build_gather_fn(
-                keys if side == "l." else (), 
+                keys if side == "l." else (),
                 keys if side == "r." else (), left_join))
         if side == "l.":
             outs = fn(arrays, {}, idx_p, idx_p, hm)
         else:
             outs = fn({}, arrays, idx_p, idx_p, hm)
         outs = {k: _mesh.materialize_np(v) for k, v in outs.items()}
-        # rebuild leaves, sliced back to the true match count
+        # rebuild leaves, sliced back to the true match count. Leaf
+        # structure derives from the SCHEMA + array key set, never from
+        # leaf instances — the partition's host leaves may be lazy
+        # (device-backed) and must not be forced here
         gathered: dict[str, C.Leaf] = {}
-        for path, leaf in part.leaves.items():
-            if isinstance(leaf, C.NumericLeaf):
-                data = _np.asarray(outs[f"{side}{path}#d"])[:m]
-                valid = _np.asarray(outs[f"{side}{path}#v"])[:m] \
-                    if leaf.valid is not None else None
-                if left_join and valid is None:
-                    valid = hm[:m].copy()
-                gathered[path] = C.NumericLeaf(data, valid)
-            elif isinstance(leaf, C.StrLeaf):
-                b_ = _np.asarray(outs[f"{side}{path}#b"])[:m]
-                ln = _np.asarray(outs[f"{side}{path}#l"])[:m]
-                valid = _np.asarray(outs[f"{side}{path}#v"])[:m] \
-                    if leaf.valid is not None else None
-                if left_join and valid is None:
-                    valid = hm[:m].copy()
-                gathered[path] = C.StrLeaf(b_, ln, valid)
-            elif isinstance(leaf, C.NullLeaf):
-                gathered[path] = C.NullLeaf(m)
+        for ci, ct in enumerate(part.schema.types):
+            for path, _lt in C.flatten_type(ct, str(ci)):
+                if f"{side}{path}#b" in outs:
+                    b_ = _np.asarray(outs[f"{side}{path}#b"])[:m]
+                    ln = _np.asarray(outs[f"{side}{path}#l"])[:m]
+                    valid = _np.asarray(outs[f"{side}{path}#v"])[:m] \
+                        if f"{side}{path}#v" in outs else None
+                    if left_join and valid is None:
+                        valid = hm[:m].copy()
+                    gathered[path] = C.StrLeaf(b_, ln, valid)
+                elif f"{side}{path}#d" in outs:
+                    data = _np.asarray(outs[f"{side}{path}#d"])[:m]
+                    valid = _np.asarray(outs[f"{side}{path}#v"])[:m] \
+                        if f"{side}{path}#v" in outs else None
+                    if left_join and valid is None:
+                        valid = hm[:m].copy()
+                    gathered[path] = C.NumericLeaf(data, valid)
+                else:
+                    gathered[path] = C.NullLeaf(m)
         return gathered

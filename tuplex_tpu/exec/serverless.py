@@ -314,14 +314,25 @@ def rebuild_stage(spec: dict, options, files: Optional[list] = None):
 
 class _WarmWorker:
     """A long-lived `--serve` worker process. busy: None = idle, task id
-    while processing, -1 = condemned (killed / wedged)."""
+    while processing, -1 = condemned (killed / wedged). `logf` is the
+    driver-side handle of the worker's log file — kept so close() can
+    release the fd (the child holds its own descriptor)."""
 
-    __slots__ = ("proc", "busy", "resp_path")
+    __slots__ = ("proc", "busy", "resp_path", "logf")
 
-    def __init__(self, proc):
+    def __init__(self, proc, logf=None):
         self.proc = proc
         self.busy = None
         self.resp_path = ""
+        self.logf = logf
+
+    def close_log(self) -> None:
+        if self.logf is not None:
+            try:
+                self.logf.close()
+            except OSError:
+                pass
+            self.logf = None
 
 
 class ServerlessBackend(LocalBackend):
@@ -382,6 +393,9 @@ class ServerlessBackend(LocalBackend):
                     w.proc.kill()
                 except OSError:
                     pass
+            # one leaked driver-side fd per warm worker otherwise
+            # (ADVICE r5); the child's own descriptor died with it
+            w.close_log()
         self._pool = []
 
     def __del__(self):  # pragma: no cover - interpreter teardown
@@ -576,15 +590,22 @@ class ServerlessBackend(LocalBackend):
         logdir = os.path.join(self.control_root, "workers")
         os.makedirs(logdir, exist_ok=True)
         logf = open(os.path.join(logdir, f"worker-{wid}.log"), "ab")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "tuplex_tpu.exec.worker", "--serve"],
-            stdin=subprocess.PIPE, stdout=logf, stderr=subprocess.STDOUT,
-            env=self._worker_env(), text=True)
-        return _WarmWorker(proc)
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "tuplex_tpu.exec.worker", "--serve"],
+                stdin=subprocess.PIPE, stdout=logf,
+                stderr=subprocess.STDOUT, env=self._worker_env(), text=True)
+        except Exception:
+            logf.close()
+            raise
+        return _WarmWorker(proc, logf)
 
     def _acquire_worker(self):
         """An idle live warm worker, spawning up to max_conc; None if all
         are busy."""
+        for w in self._pool:
+            if w.proc.poll() is not None:
+                w.close_log()       # dead worker: release the driver-side fd
         self._pool = [w for w in self._pool if w.proc.poll() is None]
         for w in self._pool:
             if w.busy is None:

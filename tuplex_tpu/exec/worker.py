@@ -148,8 +148,11 @@ def _write_response(req_path: str, resp: dict) -> None:
 
 def serve() -> int:
     """Warm-worker loop: one request path per stdin line; 'EXIT' quits.
-    Acknowledges each task on stdout (the driver's liveness signal; the
-    authoritative completion signal stays the response.pkl write)."""
+
+    Completion AND liveness are signalled solely by the atomic
+    response.pkl write — the driver redirects this process's stdout into
+    its log file and never reads it, so the 'READY'/'OK' lines below are
+    log breadcrumbs, not a protocol (ADVICE r5)."""
     _set_platform()
     backends: dict = {}
     print("READY", flush=True)

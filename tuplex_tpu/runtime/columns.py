@@ -108,6 +108,23 @@ class StrLeaf:
     def width(self) -> int:
         return self.bytes.shape[1] if self.bytes.ndim == 2 else 0
 
+    def to_wire(self) -> tuple[np.ndarray, np.ndarray]:
+        """Varlen wire view: (contiguous payload of the ACTUAL row bytes,
+        int32 lengths). The inverse of from_wire; the transfer analog of
+        the reference serializer's offsets+payload layout
+        (Serializer.h:104-138) — offsets are implied by cumsum(lengths)."""
+        return matrix_to_varlen(self.bytes, self.lengths)
+
+    @classmethod
+    def from_wire(cls, payload: np.ndarray, lengths: np.ndarray, width: int,
+                  valid: Optional[np.ndarray] = None) -> "StrLeaf":
+        lengths = np.asarray(lengths, dtype=np.int32)
+        offs = np.concatenate(
+            [[0], np.cumsum(np.clip(lengths, 0, width),
+                            dtype=np.int64)])[:-1]
+        return cls(varlen_to_matrix(payload, offs, lengths, width),
+                   lengths, valid)
+
 
 @dataclass
 class NullLeaf:
@@ -198,6 +215,150 @@ def decode_leaf(leaf: Leaf, i: int) -> Any:
 
 
 # ---------------------------------------------------------------------------
+# varlen wire view (offsets + contiguous payload — the reference
+# serializer's disk layout applied to the transfer wire)
+# ---------------------------------------------------------------------------
+
+def matrix_to_varlen(mat: np.ndarray,
+                     lens: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """[N, W] zero-padded byte matrix -> (payload of the actual row bytes
+    concatenated, int32 lengths clamped to [0, W]). Row-major boolean
+    selection keeps each row's prefix contiguous and in row order, so
+    offsets are exactly the exclusive cumsum of the clamped lengths."""
+    n = mat.shape[0]
+    w = mat.shape[1] if mat.ndim == 2 else 0
+    ln = np.clip(np.asarray(lens[:n], dtype=np.int32), 0, w)
+    if n == 0 or w == 0:
+        return np.zeros(0, np.uint8), ln
+    keep = np.arange(w, dtype=np.int32)[None, :] < ln[:, None]
+    return np.ascontiguousarray(mat[:n])[keep], ln
+
+
+def varlen_to_matrix(payload: np.ndarray, offs: np.ndarray,
+                     lens: np.ndarray, w: int) -> np.ndarray:
+    """(payload, per-row offsets, lengths) -> [N, w] zero-padded byte
+    matrix (vectorized gather — same technique as arrow_string_to_leaf)."""
+    n = len(lens)
+    mat = np.zeros((n, max(w, 1)), np.uint8)
+    if n == 0 or w <= 0 or len(payload) == 0:
+        return mat
+    ln = np.clip(np.asarray(lens, dtype=np.int64), 0, w)
+    idx = np.asarray(offs, dtype=np.int64)[:, None] + \
+        np.arange(w, dtype=np.int64)[None, :]
+    np.clip(idx, 0, len(payload) - 1, out=idx)
+    g = np.asarray(payload, dtype=np.uint8)[idx]
+    keep = np.arange(w, dtype=np.int64)[None, :] < ln[:, None]
+    return np.where(keep, g, 0).astype(np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# lazy (device-backed) leaves — the host side of the stage handoff
+# ---------------------------------------------------------------------------
+
+# process-wide handoff observability (tests + bench): which lazy leaf dicts
+# were created and which leaf paths were ever forced to host. Reset freely.
+HANDOFF_STATS = {"lazy_parts": 0, "forced": []}
+
+
+class LazyLeaves(dict):
+    """Leaf dict whose values materialize from device arrays on first
+    access. Key-set operations (iteration, membership, len) never transfer;
+    value access fetches ONLY the touched leaf — a join probing one key
+    column pulls that column's bytes and nothing else. items()/values()
+    force everything (spill, row-wise fallbacks).
+
+    This is what lets an intermediate partition skip the D2H round-trip
+    entirely: the host dict stays empty unless some slow path actually
+    needs host bytes, while the device arrays feed the next stage."""
+
+    def __init__(self, keys, loader, tag: str = ""):
+        super().__init__()
+        self._keys = tuple(keys)
+        self._loader = loader            # loader(path) -> Leaf
+        self._tag = tag
+        HANDOFF_STATS["lazy_parts"] += 1
+
+    # -- key-set views (no transfer) ------------------------------------
+    def __iter__(self):
+        return iter(self._keys)
+
+    def keys(self):
+        return tuple(self._keys)
+
+    def __len__(self):
+        return len(self._keys)
+
+    def __contains__(self, k):
+        return k in self._keys
+
+    def __bool__(self):
+        return bool(self._keys)
+
+    # -- value access (forces the touched leaf) -------------------------
+    def _load(self, k):
+        if not super().__contains__(k):
+            HANDOFF_STATS["forced"].append((self._tag, k))
+            super().__setitem__(k, self._loader(k))
+            if all(dict.__contains__(self, k2) for k2 in self._keys):
+                self._loader = None   # release the device-array closure
+        return super().__getitem__(k)
+
+    def __getitem__(self, k):
+        if k not in self._keys:
+            raise KeyError(k)
+        return self._load(k)
+
+    def get(self, k, default=None):
+        if k not in self._keys:
+            return default
+        return self._load(k)
+
+    def items(self):
+        return [(k, self._load(k)) for k in self._keys]
+
+    def values(self):
+        return [self._load(k) for k in self._keys]
+
+    def materialized(self) -> bool:
+        return all(dict.__contains__(self, k) for k in self._keys)
+
+    # -- inherited-dict traps: keep copies/compares consistent ----------
+    # (CPython bypasses overridden accessors for some C-level dict uses;
+    # force first so a partially-materialized mapping never leaks out)
+    def copy(self):
+        return dict(self.items())
+
+    def __eq__(self, other):
+        if isinstance(other, dict):
+            return dict(self.items()) == other
+        return NotImplemented
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return NotImplemented if eq is NotImplemented else not eq
+
+    __hash__ = None
+
+    def __setitem__(self, k, v):
+        if k not in self._keys:
+            self._keys = self._keys + (k,)
+        super().__setitem__(k, v)
+
+
+def decode_key_tuples(part: "Partition", indices, kidx) -> list[tuple]:
+    """Key-column values for the given NORMAL rows, touching only the key
+    columns' leaves (a full decode_rows would force every lazy leaf of a
+    device-resident partition to host — exactly the round-trip the handoff
+    exists to avoid)."""
+    out = []
+    for i in indices:
+        i = int(i)
+        out.append(tuple(part._decode_col(str(j), part.schema.types[j], i)
+                         for j in kidx))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # partition
 # ---------------------------------------------------------------------------
 
@@ -284,8 +445,13 @@ class Partition:
             yield self.decode_row(i)
 
     def nbytes(self) -> int:
+        lv = self.leaves
+        if isinstance(lv, LazyLeaves) and not lv.materialized():
+            # unforced device-backed leaves hold no host bytes; the size
+            # estimate must not itself trigger the D2H it is sizing
+            return int(getattr(lv, "nbytes_hint", 0))
         total = 0
-        for leaf in self.leaves.values():
+        for leaf in lv.values():
             if isinstance(leaf, NumericLeaf):
                 total += leaf.data.nbytes + (leaf.valid.nbytes if leaf.valid is not None else 0)
             elif isinstance(leaf, StrLeaf):
@@ -460,6 +626,25 @@ def staged_keys(part: Partition):
     return keys
 
 
+def staged_keys_for_type(path: str, lt: T.Type) -> list[str]:
+    """Device-array keys stage_partition would produce for a leaf of
+    type `lt` at `path` — the TYPE-level twin of _leaf_keys, for layouts
+    that exist only as device arrays (no Leaf instance to inspect).
+    Kept next to _leaf_keys so the two definitions evolve together."""
+    base = lt.without_option() if lt.is_optional() else lt
+    opt = lt.is_optional()
+    if path.endswith("#opt"):
+        return [path]                       # BOOL validity leaf
+    if base is T.NULL:
+        return []
+    if base is T.EMPTYTUPLE:
+        return [path, path + "#valid"] if opt else []
+    ks = [path + "#bytes", path + "#len"] if base is T.STR else [path]
+    if opt:
+        ks.append(path + "#valid")
+    return ks
+
+
 def partition_seed(part: Partition):
     """Per-partition PRNG seed (Weyl-mixed start index) for compiled
     `random` UDFs — distinct per partition so batches don't replay one
@@ -590,7 +775,10 @@ def type_from_result_arrays(arrays: dict, path: str) -> Optional[T.Type]:
     if (path + "#unit") in arrays:
         return T.option(T.EMPTYTUPLE) if opt else T.EMPTYTUPLE
     if path in arrays:
-        dt = np.asarray(arrays[path]).dtype
+        # dtype attribute, not np.asarray: schema probing must work on
+        # DEVICE arrays without pulling their bytes to host (lazy handoff)
+        dt = np.dtype(getattr(arrays[path], "dtype", None) or
+                      np.asarray(arrays[path]).dtype)
         if dt == np.bool_:
             base = T.BOOL
         elif np.issubdtype(dt, np.integer):
@@ -640,38 +828,47 @@ def partition_from_result_arrays(
     leaves: dict[str, Leaf] = {}
     for ci, ct in enumerate(col_types):
         for path, lt in flatten_type(ct, str(ci)):
-            base = lt.without_option() if lt.is_optional() else lt
-            opt = lt.is_optional()
-            if path.endswith("#opt"):
-                leaves[path] = NumericLeaf(
-                    np.asarray(arrays[path][:n], dtype=np.bool_))
-                continue
-            valid = arrays.get(path + "#valid")
-            if valid is None and opt and (path + "#opt") in arrays:
-                valid = arrays[path + "#opt"]
-            valid = None if valid is None else \
-                np.asarray(valid[:n], dtype=np.bool_)
-            if base is T.STR:
-                leaves[path] = StrLeaf(
-                    np.asarray(arrays[path + "#bytes"][:n], dtype=np.uint8),
-                    np.asarray(arrays[path + "#len"][:n], dtype=np.int32),
-                    valid)
-            elif base is T.NULL:
-                leaves[path] = NullLeaf(n)
-            elif base is T.EMPTYTUPLE:
-                if opt:
-                    leaves[path] = NumericLeaf(
-                        np.zeros(n, dtype=np.bool_),
-                        valid if valid is not None
-                        else np.ones(n, dtype=np.bool_))
-                else:
-                    leaves[path] = NullLeaf(n)
-            else:
-                leaves[path] = NumericLeaf(
-                    np.asarray(arrays[path][:n], dtype=LEAF_NUMERIC[base]),
-                    valid)
+            leaves[path] = leaf_from_result_arrays(arrays, path, lt, n)
     return Partition(schema=schema, num_rows=n, leaves=leaves,
                      start_index=start_index)
+
+
+def result_keys_for_leaf(arrays: dict, path: str) -> list[str]:
+    """The result-array keys leaf_from_result_arrays reads for `path` —
+    the unit of a lazy per-leaf fetch."""
+    ks = [k for k in (path, path + "#bytes", path + "#len",
+                      path + "#valid", path + "#opt") if k in arrays]
+    return ks
+
+
+def leaf_from_result_arrays(arrays: dict, path: str, lt: T.Type,
+                            n: int) -> Leaf:
+    """One leaf of a result partition from stage-output arrays (the
+    per-path unit of partition_from_result_arrays; lazy handoff loaders
+    call it with just that leaf's fetched arrays)."""
+    base = lt.without_option() if lt.is_optional() else lt
+    opt = lt.is_optional()
+    if path.endswith("#opt"):
+        return NumericLeaf(np.asarray(arrays[path][:n], dtype=np.bool_))
+    valid = arrays.get(path + "#valid")
+    if valid is None and opt and (path + "#opt") in arrays:
+        valid = arrays[path + "#opt"]
+    valid = None if valid is None else np.asarray(valid[:n], dtype=np.bool_)
+    if base is T.STR:
+        return StrLeaf(
+            np.asarray(arrays[path + "#bytes"][:n], dtype=np.uint8),
+            np.asarray(arrays[path + "#len"][:n], dtype=np.int32),
+            valid)
+    if base is T.NULL:
+        return NullLeaf(n)
+    if base is T.EMPTYTUPLE:
+        if opt:
+            return NumericLeaf(
+                np.zeros(n, dtype=np.bool_),
+                valid if valid is not None else np.ones(n, dtype=np.bool_))
+        return NullLeaf(n)
+    return NumericLeaf(
+        np.asarray(arrays[path][:n], dtype=LEAF_NUMERIC[base]), valid)
 
 
 def gather_partition(part: Partition, out_positions: np.ndarray,

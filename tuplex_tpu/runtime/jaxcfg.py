@@ -88,20 +88,50 @@ def fusion_barriers_enabled() -> bool:
     return jax.default_backend() == "cpu"
 
 
-def device_handoff_enabled() -> bool:
+def device_handoff_enabled(consumer: str = "stage") -> bool:
     """Whether intermediate stage outputs keep a device-resident gathered
     view for downstream re-staging (skips host pad/copy + H2D — the analog
     of the reference passing hash intermediates by pointer as stage
     globals, LocalBackend.cc:903-908). Default: off on CPU (host staging IS
     device memory there; the extra device gather would be pure overhead),
-    on everywhere else. TUPLEX_DEVICE_HANDOFF=0/1 overrides (tests force it
-    on under the CPU platform)."""
+    on everywhere else.
+
+    `consumer` names WHO drains the view — "stage" (a downstream
+    TransformStage re-stages it), "join" (the probe side of a JoinStage
+    gathers from it), or "agg" (an AggregateStage evaluates fold exprs over
+    it). Round 5 gated joins and aggregates off entirely, which is exactly
+    the boundary that made q19/flights/nyc311 round-trip per stage; the
+    per-consumer knobs exist so a regressing consumer can be switched off
+    without losing the others. TUPLEX_DEVICE_HANDOFF=0/1 overrides all
+    consumers (tests force it on under the CPU platform);
+    TUPLEX_DEVICE_HANDOFF_STAGE / _JOIN / _AGG=0/1 override one."""
     import os
 
+    per = os.environ.get(f"TUPLEX_DEVICE_HANDOFF_{consumer.upper()}")
+    if per in ("0", "1"):
+        return per == "1"
     mode = os.environ.get("TUPLEX_DEVICE_HANDOFF", "auto")
     if mode in ("0", "1"):
         return mode == "1"
     return jax.default_backend() != "cpu"
+
+
+def varlen_wire_enabled() -> bool:
+    """Whether packed stage outputs ship str leaves as a varlen segment
+    (per-row lengths + contiguous payload of ACTUAL bytes) instead of the
+    zero-padded [B, W] matrices. The padded matrices are ~170 B/row on
+    zillow against ~30 B of real content, and the D2H tunnel runs at
+    ~50 MB/s — shipping content-sized payloads is the same offsets+payload
+    layout the reference serializer uses on disk (Serializer.h:104-138)
+    applied to the transfer wire. Only meaningful where packing is active
+    (the varlen segment rides PackedOuts). TUPLEX_VARLEN_WIRE=0/1
+    overrides; default on."""
+    import os
+
+    mode = os.environ.get("TUPLEX_VARLEN_WIRE", "auto")
+    if mode in ("0", "1"):
+        return mode == "1"
+    return True
 
 
 def device_handoff_budget_bytes() -> int:

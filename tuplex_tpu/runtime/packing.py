@@ -23,7 +23,12 @@ import numpy as np
 
 from .jaxcfg import jax, jnp
 
-_ALIGN = 512
+# segment alignment inside the packed buffer: large enough that every
+# element-typed view of a segment is aligned (max itemsize 8; 64 also
+# keeps cache-line alignment), small enough that a many-leaf stage does
+# not bleed KBs of padding per partition (512 cost ~18 KB/partition on
+# zillow's ~35 output leaves)
+_ALIGN = 64
 
 
 def packing_enabled() -> bool:
@@ -56,18 +61,77 @@ def _packable(dtype) -> bool:
                                np.dtype(np.int64), np.dtype(np.uint64))
 
 
-def _wire_dtype(k: str, dtype, arrays) -> np.dtype:
-    """Transfer dtype for a leaf. A '#len' column is bounded by its
-    sibling byte matrix's padded width, so when that width fits u16 the
-    lens ride the ~50 MB/s download narrowed and re-widen on arrival.
-    ('#err' is NOT narrowed: it packs class|op_id<<8 and operator ids
-    come from a session-global counter, so values exceed u16.)"""
-    if np.dtype(dtype) == np.dtype(np.int32) and k.endswith("#len"):
+# wire-dtype markers beyond plain numpy dtype strs:
+#   "b1"     1-D bool bitpacked little-endian, 8 rows/byte (both directions)
+#   "lo4i"   i64 shipped as its low u32 word; high words ride the varlen
+#   "lo4u"   u64 same — payload carries (rare) rows whose high word isn't
+#            the low word's sign/zero extension (output direction only)
+#   "pb<N>"  '#rowidx' as a survivor bitmap over the padded input size N:
+#            the compaction contract (physical.py) keeps the indices
+#            ascending+unique with sentinel N for dead tail slots, so a
+#            bit per INPUT row reconstructs them exactly (output only)
+_BITS = "b1"
+_LO32 = {"<i8": "lo4i", "<u8": "lo4u"}
+
+
+def _wire_nbytes(shape, wdt: str) -> int:
+    n = int(np.prod(shape)) if shape else 1
+    if wdt == _BITS:
+        return (n + 7) // 8
+    if wdt in ("lo4i", "lo4u"):
+        return n * 4
+    if wdt.startswith("pb"):
+        return (int(wdt[2:]) + 7) // 8
+    return n * np.dtype(wdt).itemsize
+
+
+def _wire_dtype(k: str, dtype, arrays, check_values: bool = False) -> str:
+    """Transfer dtype (str, possibly a marker) for a leaf.
+
+    * 1-D bool leaves bitpack 8 rows/byte ('#keep', '#rowvalid', Option
+      validity — an 8x cut on every boolean lattice column).
+    * A '#len' column is bounded by its sibling byte matrix's padded
+      width, so it narrows to u16 (or u8 when the width fits a byte) and
+      re-widens on arrival. ('#err' is NOT narrowed: it packs
+      class|op_id<<8 and operator ids come from a session-global counter,
+      so values exceed u16.)
+    * '#rowidx' values are bounded by the padded INPUT size (sentinel
+      included), visible statically as '#err'.shape — u16 when it fits.
+
+    check_values (host pack path only — device values are traced):
+    the len<=padded-width invariant is enforced nowhere upstream, so a
+    '*#len' leaf carrying values past the narrowed range (or a negative
+    sentinel) would silently wrap on the wire; such leaves fall back to
+    their declared dtype (ADVICE round 5)."""
+    dt = np.dtype(dtype)
+    a = arrays.get(k)
+    if dt == np.dtype(np.bool_) and getattr(a, "ndim", 0) == 1:
+        return _BITS
+    if dt == np.dtype(np.int32) and k.endswith("#len"):
         sib = arrays.get(k[:-4] + "#bytes")
         if sib is not None and getattr(sib, "ndim", 0) == 2 \
                 and sib.shape[1] < (1 << 16):
-            return np.dtype(np.uint16)
-    return np.dtype(dtype)
+            narrow = np.uint8 if sib.shape[1] <= 0xFF else np.uint16
+            if check_values:
+                av = np.asarray(a)
+                if av.size and (int(av.max()) > int(np.iinfo(narrow).max)
+                                or int(av.min()) < 0):
+                    return dt.str
+            return np.dtype(narrow).str
+    if dt == np.dtype(np.int32) and k == "#rowidx":
+        err = arrays.get("#err")
+        b_in = err.shape[0] if err is not None \
+            and getattr(err, "ndim", 0) == 1 else None
+        if b_in is not None and not check_values:
+            # device direction: the compaction contract (ascending,
+            # unique, sentinel=b_in) is structural — a bit per input row
+            return f"pb{b_in}"
+        if b_in is not None and b_in < (1 << 16):
+            av = np.asarray(a)
+            if not av.size or (int(av.max()) < (1 << 16)
+                               and int(av.min()) >= 0):
+                return np.dtype(np.uint16).str
+    return dt.str
 
 
 def _host_spec(arrays: dict):
@@ -79,9 +143,9 @@ def _host_spec(arrays: dict):
         a = arrays[k]
         if not _packable(a.dtype):
             continue
-        wd = _wire_dtype(k, a.dtype, arrays)
-        nb = a.size * wd.itemsize
-        spec.append((k, tuple(a.shape), a.dtype.str, off, nb, wd.str))
+        wd = _wire_dtype(k, a.dtype, arrays, check_values=True)
+        nb = _wire_nbytes(a.shape, wd)
+        spec.append((k, tuple(a.shape), a.dtype.str, off, nb, wd))
         off += _pad(nb)
     return tuple(spec), off
 
@@ -89,11 +153,17 @@ def _host_spec(arrays: dict):
 def _pack_host(arrays: dict, spec, total: int) -> np.ndarray:
     buf = np.zeros(total, dtype=np.uint8)
     for k, shape, dt, off, nb, wdt in spec:
-        if nb:
-            a = np.ascontiguousarray(arrays[k])
-            if wdt != dt:
-                a = np.ascontiguousarray(a.astype(np.dtype(wdt)))
-            buf[off:off + nb] = a.view(np.uint8).reshape(-1)
+        if not nb:
+            continue
+        a = np.ascontiguousarray(arrays[k])
+        if wdt == _BITS:
+            bits = np.packbits(a.astype(np.bool_).reshape(-1),
+                               bitorder="little")
+            buf[off:off + nb] = bits
+            continue
+        if wdt != dt:
+            a = np.ascontiguousarray(a.astype(np.dtype(wdt)))
+        buf[off:off + nb] = a.view(np.uint8).reshape(-1)
     return buf
 
 
@@ -101,10 +171,34 @@ def _unpack_host(buf: np.ndarray, spec) -> dict:
     out = {}
     for k, shape, dt, off, nb, wdt in spec:
         dtype = np.dtype(dt)
-        wdtype = np.dtype(wdt)
+        n = int(np.prod(shape)) if shape else 1
         if not nb:
             out[k] = np.zeros(shape, dtype=dtype)
             continue
+        if wdt == _BITS:
+            seg = np.frombuffer(buf, dtype=np.uint8, count=nb, offset=off)
+            out[k] = np.unpackbits(seg, bitorder="little")[:n] \
+                .astype(np.bool_).reshape(shape)
+            continue
+        if wdt in ("lo4i", "lo4u"):
+            lo = np.frombuffer(buf, dtype=np.uint32, count=n, offset=off)
+            # sign/zero-extend the low word; rows whose high word differs
+            # are patched from the varlen payload (_unpack_varlen)
+            out[k] = (lo.astype(np.int32).astype(np.int64)
+                      if wdt == "lo4i"
+                      else lo.astype(np.uint64)).astype(dtype) \
+                .reshape(shape)
+            continue
+        if wdt.startswith("pb"):
+            b_in = int(wdt[2:])
+            seg = np.frombuffer(buf, dtype=np.uint8, count=nb, offset=off)
+            pos = np.nonzero(
+                np.unpackbits(seg, bitorder="little")[:b_in])[0]
+            arr = np.full(n, b_in, dtype=dtype)   # sentinel tail slots
+            arr[:min(len(pos), n)] = pos[:n]
+            out[k] = arr.reshape(shape)
+            continue
+        wdtype = np.dtype(wdt)
         # zero-copy views: offsets are _ALIGN-ed so every element aligns
         arr = np.frombuffer(buf, dtype=wdtype,
                             count=nb // wdtype.itemsize,
@@ -120,8 +214,14 @@ def _device_unpack(buf, spec):
     the TPU x64 legalizer."""
     out = {}
     for k, shape, dt, off, nb, wdt in spec:
-        dtype = np.dtype(wdt)
         seg = buf[off:off + nb]
+        if wdt == _BITS:
+            n = int(np.prod(shape)) if shape else 1
+            bits = (seg[:, None] >> jnp.arange(8, dtype=jnp.uint8)) \
+                & jnp.uint8(1)
+            out[k] = bits.reshape(-1)[:n].astype(jnp.bool_).reshape(shape)
+            continue
+        dtype = np.dtype(wdt)
         if dtype == np.uint8:
             arr = seg.reshape(shape)
         elif dtype == np.bool_:
@@ -142,78 +242,339 @@ def _device_unpack(buf, spec):
     return out
 
 
-def _device_pack(outs: dict):
-    """Traced: dict of packable arrays -> (u8 buffer, spec)."""
+def _device_pack(outs: dict, skip=(), lo32: dict | None = None):
+    """Traced: dict of packable arrays -> (u8 buffer, spec). Keys in
+    `skip` ride the varlen payload but stay visible here so wire
+    narrowing still sees its siblings; keys in `lo32` ship only their low
+    u32 word here (high words ride the varlen payload)."""
+    lo32 = lo32 or {}
     segs = []
     spec = []
     off = 0
     for k in sorted(outs):
+        if k in skip:
+            continue
         v = jnp.asarray(outs[k])
         orig_dt = np.dtype(v.dtype).str
-        wd = _wire_dtype(k, np.dtype(v.dtype), outs)
-        if wd != np.dtype(v.dtype):
-            v = v.astype(jnp.dtype(wd))         # narrowed wire dtype
-        if v.dtype == jnp.uint8:
-            u = v.reshape(-1)
-        elif v.dtype == jnp.bool_:
-            u = v.astype(jnp.uint8).reshape(-1)
-        elif v.dtype.itemsize == 8:
-            w = v.astype(jnp.uint64) if v.dtype == jnp.int64 else v
-            lo = (w & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
-            hi = (w >> jnp.uint64(32)).astype(jnp.uint32)
-            halves = jnp.stack([lo, hi], axis=-1)
-            u = jax.lax.bitcast_convert_type(halves, jnp.uint8).reshape(-1)
+        if k in lo32:
+            wd = _LO32[orig_dt]
+            u = jax.lax.bitcast_convert_type(lo32[k], jnp.uint8).reshape(-1)
         else:
-            u = jax.lax.bitcast_convert_type(v, jnp.uint8).reshape(-1)
+            wd = _wire_dtype(k, np.dtype(v.dtype), outs)
+            if wd == _BITS:
+                u = _bitpack_dev(v)
+            elif wd.startswith("pb"):
+                b_in = int(wd[2:])
+                bm = jnp.zeros(b_in, jnp.bool_).at[v].set(True, mode="drop")
+                u = _bitpack_dev(bm)
+            else:
+                if np.dtype(wd) != np.dtype(v.dtype):
+                    v = v.astype(jnp.dtype(wd))     # narrowed wire dtype
+                if v.dtype == jnp.uint8:
+                    u = v.reshape(-1)
+                elif v.dtype == jnp.bool_:
+                    u = v.astype(jnp.uint8).reshape(-1)
+                elif v.dtype.itemsize == 8:
+                    w = v.astype(jnp.uint64) if v.dtype == jnp.int64 else v
+                    lo = (w & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+                    hi = (w >> jnp.uint64(32)).astype(jnp.uint32)
+                    halves = jnp.stack([lo, hi], axis=-1)
+                    u = jax.lax.bitcast_convert_type(
+                        halves, jnp.uint8).reshape(-1)
+                else:
+                    u = jax.lax.bitcast_convert_type(
+                        v, jnp.uint8).reshape(-1)
         nb = int(u.shape[0])
         pad = _pad(nb) - nb
         if pad:
             u = jnp.pad(u, (0, pad))
         segs.append(u)
-        spec.append((k, tuple(v.shape), orig_dt, off, nb, wd.str))
+        spec.append((k, tuple(v.shape), orig_dt, off, nb, wd))
         off += _pad(nb)
     buf = jnp.concatenate(segs) if segs else jnp.zeros(0, jnp.uint8)
     return buf, tuple(spec)
 
 
+def _varlen_str_keys(outs: dict) -> tuple:
+    """Output keys eligible for the varlen string wire: 2-D u8 '#bytes'
+    matrices with an int '#len' sibling (the StrLeaf layout). Sorted so
+    the device payload order and the host re-derivation agree byte for
+    byte."""
+    ks = []
+    for k in sorted(outs):
+        if not k.endswith("#bytes"):
+            continue
+        v = outs[k]
+        lk = k[:-6] + "#len"
+        if getattr(v, "ndim", 0) == 2 and np.dtype(v.dtype) == np.uint8 \
+                and lk in outs \
+                and np.dtype(outs[lk].dtype).kind in "iu":
+            ks.append(k)
+    return tuple(ks)
+
+
+def _varlen_i64_keys(outs: dict, str_keys: tuple) -> tuple:
+    """1-D 64-bit leaves whose high words ride the varlen payload (the
+    low word ships fixed as u32). On data like zillow the values fit 32
+    bits almost everywhere, so this halves every i64 column."""
+    skip = set(str_keys)
+    return tuple(k for k in sorted(outs)
+                 if k not in skip
+                 and getattr(outs[k], "ndim", 0) == 1
+                 and np.dtype(outs[k].dtype) in (np.dtype(np.int64),
+                                                 np.dtype(np.uint64)))
+
+
+def _live_masks(args, outs):
+    """(live_slot, live_input) bool masks — rows the host merge can ever
+    read from the fast-path outputs (rowvalid & keep & err==0, mapped
+    through '#rowidx' for compacted outputs). Dead rows' varlen bytes are
+    suppressed: padding/filtered/errored slots would otherwise ship
+    garbage content over the ~50 MB/s tunnel. None when the outputs don't
+    carry the stage lattice (non-stage uses of the packer)."""
+    keep = outs.get("#keep")
+    err = outs.get("#err")
+    if keep is None or err is None or getattr(keep, "ndim", 0) != 1 \
+            or getattr(err, "shape", None) != keep.shape:
+        return None, None
+    live = keep & (err == 0)
+    rv = args.get("#rowvalid") if isinstance(args, dict) else None
+    if rv is not None and getattr(rv, "shape", None) == live.shape:
+        live = live & rv
+    rowidx = outs.get("#rowidx")
+    if rowidx is None or getattr(rowidx, "ndim", 0) != 1:
+        return live, live
+    b_in = live.shape[0]
+    ri = jnp.clip(rowidx, 0, b_in - 1)
+    live_slot = live[ri] & (rowidx < b_in)
+    return live_slot, live
+
+
+def _bitpack_dev(v):
+    """Traced: 1-D bool -> little-endian bitpacked u8[ceil(n/8)]."""
+    n = int(v.shape[0])
+    nb8 = (n + 7) // 8
+    b = v.astype(jnp.int32)
+    if nb8 * 8 != n:
+        b = jnp.pad(b, (0, nb8 * 8 - n))
+    return (b.reshape(nb8, 8) << jnp.arange(8, dtype=jnp.int32)) \
+        .sum(axis=1).astype(jnp.uint8)
+
+
+def _u32_bytes(v):
+    return jax.lax.bitcast_convert_type(v.astype(jnp.uint32), jnp.uint8)
+
+
+def _device_pack_varlen(entries: list):
+    """Traced: scatter every varlen entry's actual row bytes into ONE
+    contiguous payload buffer. entries: (kind, key, mat u8 [B, w],
+    lens i32 [B], dt_str). Capacity is the static worst case so the
+    executable is shape-stable; the host fetches only payload[:total]
+    after re-deriving the per-row lengths from the fixed buffer."""
+    lens = [e[3].astype(jnp.int64) for e in entries]
+    all_lens = jnp.concatenate(lens)
+    offs = jnp.cumsum(all_lens) - all_lens          # exclusive cumsum
+    cap = _pad(sum(int(e[2].shape[0] * e[2].shape[1]) for e in entries))
+    payload = jnp.zeros(max(cap, 1), jnp.uint8)
+    vspec = []
+    row0 = 0
+    for (kind, k, mat, ln, dt), ln64 in zip(entries, lens):
+        b, w = mat.shape
+        o = offs[row0:row0 + b]
+        idx = o[:, None] + jnp.arange(w, dtype=o.dtype)[None, :]
+        m = jnp.arange(w, dtype=jnp.int32)[None, :] < \
+            ln.astype(jnp.int32)[:, None]
+        idx = jnp.where(m, idx, cap)                # OOB -> dropped
+        payload = payload.at[idx.reshape(-1)].set(
+            mat.reshape(-1), mode="drop")
+        vspec.append((kind, k, (b, w), dt))
+        row0 += b
+    return payload, tuple(vspec)
+
+
+def _build_varlen(args, outs, pack_outs):
+    """Assemble the varlen plan inside the trace. Mutates pack_outs
+    (masked lens, synthetic '#need' bitmaps) and returns
+    (entries, skip_keys, lo32)."""
+    entries = []
+    skip = set()
+    lo32 = {}
+    live_slot, live_in = _live_masks(args, pack_outs)
+    str_keys = _varlen_str_keys(pack_outs)
+    if live_slot is not None:
+        # ship the liveness mask (bitpacked) so the host derives the same
+        # layout lengths WITHOUT altering the '#len' leaves — dead slots
+        # (padding/filtered/errored; unread by every consumer, the merge
+        # gathers only rowvalid & keep & err==0 rows) contribute zero
+        # payload bytes instead of garbage content
+        pack_outs["#live"] = live_slot
+    # -- str leaves: actual bytes instead of padded [B, W] matrices ------
+    for bk in str_keys:
+        lk = bk[:-6] + "#len"
+        mat = jnp.asarray(pack_outs[bk])
+        b, w = mat.shape
+        ln = jnp.clip(jnp.asarray(pack_outs[lk]).astype(jnp.int32)
+                      .reshape(-1), 0, w)
+        if live_slot is not None and live_slot.shape == ln.shape:
+            ln = ln * live_slot
+        entries.append(("str", bk, mat, ln, "|u1"))
+        skip.add(bk)
+    # -- 64-bit leaves: low word u32, high words varlen ------------------
+    for k in _varlen_i64_keys(pack_outs, tuple(skip)):
+        v = jnp.asarray(pack_outs[k])
+        dt = np.dtype(v.dtype)
+        w64 = v.astype(jnp.uint64)
+        lo = (w64 & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+        hi = (w64 >> jnp.uint64(32)).astype(jnp.uint32)
+        sext = ((lo.astype(jnp.int32) >> 31).astype(jnp.uint32)
+                if dt == np.dtype(np.int64) else jnp.uint32(0))
+        need = hi != sext
+        if live_slot is not None and live_slot.shape == need.shape:
+            # liveness known: the low words ride the payload too, so dead
+            # slots ship zero bytes instead of 4 garbage ones
+            need = need & live_slot
+            entries.append(("lo32v", k, _u32_bytes(lo),
+                            live_slot.astype(jnp.int32) * 4, dt.str))
+            skip.add(k)
+        else:
+            lo32[k] = lo                    # low word fixed-buffer u32
+        pack_outs[k + "#need"] = need       # 1-D bool -> bitpacked wire
+        entries.append(("hi32", k, _u32_bytes(hi),
+                        need.astype(jnp.int32) * 4, dt.str))
+    # -- '#err': zero-dominated lattice -> sparse nonzero codes ----------
+    err = pack_outs.get("#err")
+    if err is not None and getattr(err, "ndim", 0) == 1 \
+            and np.dtype(err.dtype) == np.dtype(np.int32):
+        ev = jnp.asarray(err)
+        need = ev != 0
+        rv = args.get("#rowvalid") if isinstance(args, dict) else None
+        if rv is not None and getattr(rv, "shape", None) == need.shape:
+            need = need & rv                # padding rows' codes are noise
+        pack_outs["#err#need"] = need
+        entries.append(("sparse32", "#err", _u32_bytes(ev),
+                        need.astype(jnp.int32) * 4, "<i4"))
+        skip.add("#err")
+    return entries, tuple(sorted(skip)), lo32
+
+
 class PackedOuts:
-    """Async handle for a packed stage result: one device buffer + layout,
-    plus any per-leaf arrays whose dtype can't ride the buffer (f64)."""
+    """Async handle for a packed stage result: one fixed-layout device
+    buffer + layout, an optional varlen payload buffer (str leaves as
+    actual bytes), plus any per-leaf arrays whose dtype can't ride the
+    buffer (f64)."""
 
-    __slots__ = ("buf", "spec", "extras")
+    __slots__ = ("buf", "spec", "extras", "vbuf", "vspec")
 
-    def __init__(self, buf, spec, extras=None):
+    def __init__(self, buf, spec, extras=None, vbuf=None, vspec=()):
         self.buf = buf
         self.spec = spec
         self.extras = extras or {}
+        self.vbuf = vbuf
+        self.vspec = tuple(vspec or ())
 
     def to_host(self) -> dict:
         import os
         import time
 
+        from . import xferstats
+
         t0 = time.perf_counter()
         host = np.asarray(jax.device_get(self.buf))
         out = _unpack_host(host, self.spec)
+        fetched = host.nbytes
+        if self.vspec:
+            fetched += self._unpack_varlen(out)
         if self.extras:
-            out.update(jax.device_get(self.extras))
+            ex = jax.device_get(self.extras)
+            fetched += sum(np.asarray(v).nbytes for v in ex.values())
+            out.update(ex)
+        xferstats.note_d2h(fetched)
         if os.environ.get("TUPLEX_PACK_DEBUG"):
             import sys
 
-            print(f"[pack] d2h {host.nbytes >> 20}MB+{len(self.extras)}x "
+            print(f"[pack] d2h {fetched >> 20}MB ({len(self.vspec)} varlen"
+                  f"+{len(self.extras)}x) "
                   f"{time.perf_counter() - t0:.3f}s", file=sys.stderr,
                   flush=True)
         return out
+
+    def _unpack_varlen(self, out: dict) -> int:
+        """Fetch payload[:total] and rebuild every varlen entry in place
+        — str byte matrices, i64 high words, sparse '#err' codes. The
+        per-row lengths re-derive deterministically from the fixed buffer
+        (shipped lens / '#need' bitmaps), so no offsets travel. Returns
+        bytes fetched."""
+        from .columns import varlen_to_matrix
+
+        live = out.pop("#live", None)
+        lens = {}
+        total = 0
+        for kind, k, (b, w), dt in self.vspec:
+            if kind == "str":
+                ln = np.clip(np.asarray(out[k[:-6] + "#len"],
+                                        dtype=np.int64).reshape(-1), 0, w)
+                if live is not None and live.shape == ln.shape:
+                    ln = ln * live
+            elif kind == "lo32v":
+                ln = np.asarray(live, dtype=np.int64) * 4
+            else:
+                ln = np.asarray(out[k + "#need"],
+                                dtype=np.int64).reshape(-1) * 4
+            lens[(kind, k)] = ln
+            total += int(ln.sum())
+        cap = int(self.vbuf.shape[0])
+        want = min(_pad(total), cap) if total else 0
+        payload = np.asarray(jax.device_get(self.vbuf[:want])) if want \
+            else np.zeros(0, np.uint8)
+        off = 0
+        for kind, k, (b, w), dt in self.vspec:
+            ln = lens[(kind, k)]
+            offs = off + np.concatenate(
+                [[0], np.cumsum(ln, dtype=np.int64)])[:-1]
+            mat = varlen_to_matrix(payload, offs, ln, w)
+            off += int(ln.sum())
+            if kind == "str":
+                out[k] = mat
+                continue
+            words = np.ascontiguousarray(
+                np.ascontiguousarray(mat).view("<u4")[:, 0])
+            if kind == "lo32v":
+                # dead rows carried no bytes -> lo 0 -> value 0 (unread)
+                out[k] = (words.astype(np.int32).astype(np.int64)
+                          if np.dtype(dt) == np.dtype(np.int64)
+                          else words.astype(np.uint64)).astype(np.dtype(dt))
+                continue
+            need = np.asarray(out.pop(k + "#need"), dtype=np.bool_)
+            if kind == "sparse32":
+                out[k] = np.where(need, words.view("<i4"),
+                                  0).astype(np.dtype(dt))
+            else:   # hi32: patch the rows whose high word isn't the
+                    # low word's sign/zero extension
+                base = np.asarray(out[k]).view(np.uint64)
+                lo = base & np.uint64(0xFFFFFFFF)
+                full = lo | (words.astype(np.uint64) << np.uint64(32))
+                out[k] = np.where(need, full,
+                                  base).view(np.dtype(dt))
+        return payload.nbytes
 
 
 class PackedStageFn:
     """Drop-in for jit(raw_fn): __call__(arrays_dict) -> PackedOuts.
 
     One compiled executable per input layout (same granularity as jit's
-    shape retrace). The output layout is recorded as a trace side effect."""
+    shape retrace). The output layout is recorded as a trace side effect.
+
+    With the varlen wire (runtime/jaxcfg.varlen_wire_enabled) str '#bytes'
+    outputs leave the fixed buffer and ship as one contiguous payload of
+    actual row bytes — on zillow that's the difference between ~170 B/row
+    of padding and ~30 B of content over a ~50 MB/s tunnel."""
 
     def __init__(self, raw_fn, donate: bool):
+        from .jaxcfg import varlen_wire_enabled
+
         self._raw = raw_fn
         self._donate = donate
+        self._varlen = varlen_wire_enabled()
         self._fns: dict = {}
 
     def __call__(self, arrays: dict):
@@ -234,9 +595,16 @@ class PackedStageFn:
                              if _packable(jnp.asarray(v).dtype)}
                 extra_outs = {k: v for k, v in outs.items()
                               if k not in pack_outs}
-                obuf, ospec = _device_pack(pack_outs)
+                entries, vskip, lo32 = (
+                    _build_varlen(args, outs, pack_outs)
+                    if self._varlen else ([], (), {}))
+                obuf, ospec = _device_pack(pack_outs, skip=vskip,
+                                           lo32=lo32)
+                vbuf, vspec = (_device_pack_varlen(entries) if entries
+                               else (jnp.zeros(0, jnp.uint8), ()))
                 cell["ospec"] = ospec
-                return obuf, extra_outs
+                cell["vspec"] = vspec
+                return obuf, vbuf, extra_outs
 
             fn = jax.jit(traced, donate_argnums=0) if self._donate \
                 else jax.jit(traced)
@@ -252,14 +620,16 @@ class PackedStageFn:
             t0 = time.perf_counter()
             buf = _pack_host(arrays, spec, total)
             t1 = time.perf_counter()
-            dbuf, extra_outs = fn(jax.device_put(buf), extras_in)
+            dbuf, vbuf, extra_outs = fn(jax.device_put(buf), extras_in)
             jax.block_until_ready(dbuf)
             print(f"[pack] host-pack {total >> 20}MB {t1 - t0:.3f}s; "
                   f"h2d+exec {time.perf_counter() - t1:.3f}s",
                   file=sys.stderr, flush=True)
-            return PackedOuts(dbuf, cell["ospec"], extra_outs)
+            return PackedOuts(dbuf, cell["ospec"], extra_outs,
+                              vbuf, cell["vspec"])
         buf = _pack_host(arrays, spec, total)
         # explicit placement: measured 871 MB/s vs 534 MB/s letting the jit
         # call transfer its numpy argument over the tunnel
-        dbuf, extra_outs = fn(jax.device_put(buf), extras_in)
-        return PackedOuts(dbuf, cell["ospec"], extra_outs)
+        dbuf, vbuf, extra_outs = fn(jax.device_put(buf), extras_in)
+        return PackedOuts(dbuf, cell["ospec"], extra_outs,
+                          vbuf, cell["vspec"])

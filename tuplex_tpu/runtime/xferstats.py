@@ -1,0 +1,47 @@
+"""Process-wide transfer accounting for the stage-boundary data plane.
+
+Every point that actually pulls device bytes to the host (packed-buffer
+fetch, per-leaf device_get, lazy handoff leaf materialization) notes its
+byte count here, so the D2H tunnel tax is MEASURED rather than asserted:
+bench.py reports the per-run delta as `d2h_bytes` and the varlen wire /
+device-resident handoff work is judged against it (VERDICT r5: ~0.30 s of
+a 0.73 s zillow job was boundary transfer).
+
+Counters are cumulative since process start; callers take snapshots and
+diff (same pattern as MemoryManager.metrics_snapshot). Thread safety:
+bumps happen under a lock — fetches are milliseconds, the lock is noise.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_d2h_bytes = 0
+_d2h_calls = 0
+
+
+def note_d2h(nbytes: int) -> None:
+    """Record one host-bound transfer of `nbytes` bytes."""
+    global _d2h_bytes, _d2h_calls
+    if nbytes <= 0:
+        return
+    with _lock:
+        _d2h_bytes += int(nbytes)
+        _d2h_calls += 1
+
+
+def snapshot() -> tuple[int, int]:
+    with _lock:
+        return (_d2h_bytes, _d2h_calls)
+
+
+def delta(snap: tuple[int, int]) -> dict:
+    with _lock:
+        return {"d2h_bytes": _d2h_bytes - snap[0],
+                "d2h_calls": _d2h_calls - snap[1]}
+
+
+def d2h_bytes() -> int:
+    with _lock:
+        return _d2h_bytes
