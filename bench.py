@@ -235,6 +235,10 @@ def child() -> None:
         "d2h_bytes": int(d2h_bytes),
         "n_trials": len(times),
         "spread": round(spread, 3),
+        # plan-time static-analysis cost + how many operators the analyzer
+        # routed to the interpreter without ever invoking the emitter
+        "analyzer_ms": round(ctx.metrics.analyzerTimeMs(), 3),
+        "plan_fallback_ops": ctx.metrics.planFallbackOps(),
     }
     # extra context on stderr (driver only parses stdout JSON line)
     print(json.dumps({

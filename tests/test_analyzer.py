@@ -1,0 +1,444 @@
+"""Plan-time UDF static analyzer (compiler/analyzer.py): traceability
+verdicts, exception-site inventory, purity gates, plan routing, and the
+lint surfaces (`python -m tuplex_tpu lint`, DataSet.explain(lint=True))."""
+
+import random
+
+import pytest
+
+from tuplex_tpu.compiler import analyzer as az
+from tuplex_tpu.core.errors import ExceptionCode as EC
+from tuplex_tpu.utils.reflection import get_udf_source
+
+# --------------------------------------------------------------------------
+# module-level UDFs (real source locations; some mutate real globals)
+# --------------------------------------------------------------------------
+
+_COUNT = 0
+_LOOKUP = {"a": 1}
+
+
+def gen_udf(x):
+    yield x
+
+
+def try_udf(x):
+    try:
+        return int(x)
+    except ValueError:
+        return -1
+
+
+def io_udf(x):
+    fh = open("/dev/null")
+    fh.close()
+    return x["a"] * 3
+
+
+def glob_mut_udf(x):
+    global _COUNT
+    _COUNT = _COUNT + 1
+    return x["a"] + 0 * _COUNT
+
+
+def dyn_udf(x):
+    return eval("x + 1")
+
+
+def rec_udf(x):
+    return rec_udf(x)
+
+
+def spin_udf(x):
+    while True:
+        x += 1
+    return x
+
+
+def bounded_while_udf(x):
+    while x > 0:
+        x -= 2
+        if x == 1:
+            break
+    return x
+
+
+def cold_arm_udf(x):
+    if x < -10**9:
+        open("/nope")
+    return x + 1
+
+
+def clean_udf(x):
+    return int(x["a"]) / x["b"]
+
+
+def rnd_udf(x):
+    return x + random.random()
+
+
+def mutable_read_udf(x):
+    return x + _LOOKUP["a"]
+
+
+def _rep(f):
+    return az.analyze_udf(get_udf_source(f))
+
+
+# --------------------------------------------------------------------------
+# traceability verdicts
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("udf,needle", [
+    (gen_udf, "generator"),
+    (try_udf, "try/except"),
+    (io_udf, "I/O call"),
+    (glob_mut_udf, "global mutation"),
+    (dyn_udf, "dynamic code"),
+    (rec_udf, "recursive call"),
+    (spin_udf, "unbounded while"),
+])
+def test_must_fallback_constructs(udf, needle):
+    rep = _rep(udf)
+    assert rep.must_fallback
+    assert any(needle in f.reason for f in rep.fallback_findings)
+    # none of these are inside an if-arm: routed even under speculation
+    assert rep.must_fallback_now(speculate=True)
+
+
+def test_clean_udf_is_traceable():
+    rep = _rep(clean_udf)
+    assert not rep.must_fallback
+    assert not rep.must_fallback_now(speculate=False)
+
+
+def test_bounded_while_is_exception_site_not_fallback():
+    rep = _rep(bounded_while_udf)
+    assert not rep.must_fallback
+    assert EC.LOOPCAPEXCEEDED in rep.exception_codes()
+
+
+def test_cold_arm_finding_left_to_trace_probe_under_speculation():
+    rep = _rep(cold_arm_udf)
+    assert rep.must_fallback                      # the site exists
+    assert not rep.must_fallback_now(speculate=True)   # probe decides
+    assert rep.must_fallback_now(speculate=False)      # no pruning: route
+
+
+def test_while_true_with_only_nested_loop_break_is_unbounded():
+    def f(x):
+        while True:
+            for i in range(3):
+                break
+        return x
+
+    rep = _rep(f)
+    assert any("unbounded while" in g.reason for g in rep.fallback_findings)
+
+    def g(x):
+        while True:
+            if x > 3:
+                break
+            x += 1
+        return x
+
+    assert not _rep(g).must_fallback     # its OWN break bounds it
+
+
+def test_while_true_broken_by_nested_for_else_is_bounded():
+    def f(x):
+        while True:
+            for i in range(3):
+                x += i
+            else:
+                break   # binds to the WHILE (python for-else scoping)
+        return x
+
+    rep = _rep(f)
+    assert not any("unbounded while" in g.reason
+                   for g in rep.fallback_findings)
+
+
+def test_tuple_target_global_mutation_detected():
+    def f(row):
+        tmp = {}
+        (tmp["x"], _LOOKUP["x"]) = (1, row["a"])
+        return row["a"]
+
+    rep = _rep(f)
+    assert rep.mutates_globals
+    assert any("mutates captured global '_LOOKUP'" in g.reason
+               for g in rep.fallback_findings)
+
+
+def test_closure_module_identity_not_shared_across_memo():
+    import math
+
+    def make(mod):
+        return lambda x: mod.floor(x) if mod is math else mod.random()
+
+    det = _rep(make(math))
+    nondet = _rep(make(random))
+    assert det.deterministic
+    assert not nondet.deterministic
+
+
+def test_aliased_random_import_detected(tmp_path, capsys):
+    p = tmp_path / "alias.py"
+    p.write_text(
+        "import tuplex_tpu\n"
+        "import random as rnd\n"
+        "c = tuplex_tpu.Context()\n"
+        "c.parallelize([1]).map(lambda x: x + rnd.random()).collect()\n")
+    az.lint_file(str(p))
+    out = capsys.readouterr().out
+    assert "nondeterministic call rnd.random()" in out
+
+
+def test_routing_finding_skips_speculation_owned_sites():
+    def f(x):
+        if x < -10**9:
+            try:
+                x = 1
+            except ValueError:
+                pass
+        fh = open("/dev/null")
+        fh.close()
+        return x
+
+    rep = _rep(f)
+    routed = rep.routing_finding(speculate=True)
+    assert routed is not None and "I/O call" in routed.reason, \
+        "diagnostic must cite the unconditional site, not the cold arm"
+
+
+def test_no_source_udf_falls_back():
+    rep = az.analyze_udf(get_udf_source(abs))     # builtin: no source
+    assert rep.must_fallback_now(speculate=True)
+
+
+# --------------------------------------------------------------------------
+# exception-site inventory
+# --------------------------------------------------------------------------
+
+def test_exception_site_inventory_codes():
+    rep = _rep(clean_udf)
+    codes = rep.exception_codes()
+    assert {EC.KEYERROR, EC.VALUEERROR, EC.ZERODIVISIONERROR} <= codes
+
+    rep = _rep(lambda x: x[0].strip())
+    assert {EC.INDEXERROR, EC.NULLERROR} <= rep.exception_codes()
+
+    def asserting(x):
+        assert x > 0
+        if x > 100:
+            raise ValueError("big")
+        return x
+
+    rep = _rep(asserting)
+    assert {EC.ASSERTIONERROR, EC.VALUEERROR} <= rep.exception_codes()
+
+
+def test_constant_divisor_and_str_format_not_flagged():
+    rep = _rep(lambda x: (x / 2, "%05d" % x))
+    assert EC.ZERODIVISIONERROR not in rep.exception_codes()
+
+
+def test_findings_carry_source_locations():
+    rep = _rep(io_udf)
+    f = rep.fallback_findings[0]
+    assert rep.loc(f).startswith(rep.filename)
+    assert rep.filename.endswith("test_analyzer.py")
+    assert int(rep.loc(f).rsplit(":", 1)[1]) > 1
+
+
+# --------------------------------------------------------------------------
+# purity / determinism
+# --------------------------------------------------------------------------
+
+def test_random_is_nondeterministic_not_fallback():
+    rep = _rep(rnd_udf)
+    assert not rep.must_fallback     # random COMPILES (staged #seed)
+    assert not rep.deterministic
+    assert not rep.pure
+
+
+def test_mutable_global_read_is_impure_but_deterministic():
+    rep = _rep(mutable_read_udf)
+    assert rep.deterministic
+    assert not rep.pure
+    assert any("mutable global" in f.reason for f in rep.impure_findings)
+
+
+def test_global_mutation_marks_report():
+    assert _rep(glob_mut_udf).mutates_globals
+
+
+def test_chain_key_gated_on_nondeterminism(ctx, tmp_path):
+    # needs a fingerprintable source: parallelize over live lists never
+    # memoizes (source_key None), csv does
+    p = tmp_path / "d.csv"
+    p.write_text("a\n1\n2\n3\n")
+    det = ctx.csv(str(p)).mapColumn("a", lambda x: x + 1)
+    assert det._op.chain_key() is not None
+    nondet = ctx.csv(str(p)).mapColumn("a", rnd_udf)
+    assert nondet._op.chain_key() is None
+
+
+def test_branch_profile_gated_on_nondeterminism(ctx):
+    ds = ctx.parallelize(list(range(64))).map(rnd_udf)
+    assert ds._op.branch_profile() == {}
+
+
+def test_cacheop_deterministic_verdict(ctx):
+    det = ctx.parallelize([1, 2, 3]).map(lambda x: x * 2).cache()
+    assert det._op.deterministic
+    nondet = ctx.parallelize([1, 2, 3]).map(rnd_udf).cache()
+    assert not nondet._op.deterministic
+
+
+def test_pypipeline_never_specializes_global_mutators():
+    from tuplex_tpu.compiler.pypipeline import _specialize_udf
+
+    assert _specialize_udf(get_udf_source(glob_mut_udf), ("a",)) is None
+    # a clean UDF still specializes
+    assert _specialize_udf(get_udf_source(clean_udf), ("a", "b")) is not None
+
+
+# --------------------------------------------------------------------------
+# plan-time routing (acceptance): the emitter is NEVER invoked for a
+# statically untraceable UDF; a traceable sibling still compiles
+# --------------------------------------------------------------------------
+
+def _collect_with_emitter_spy(ctx, ds, monkeypatch):
+    import tuplex_tpu.compiler.emitter as EM
+
+    seen = []
+    orig = EM.Emitter.eval_udf
+
+    def spy(self, udf, args):
+        seen.append(udf.name)
+        return orig(self, udf, args)
+
+    monkeypatch.setattr(EM.Emitter, "eval_udf", spy)
+    out = ds.collect()
+    return out, seen
+
+
+@pytest.mark.parametrize("bad", [io_udf, glob_mut_udf])
+def test_untraceable_udf_routed_at_plan_time(ctx, monkeypatch, bad):
+    ds = ctx.parallelize([(i,) for i in range(100)], columns=["a"]) \
+        .withColumn("b", lambda x: x["a"] * 2) \
+        .withColumn("c", bad)
+    out, seen = _collect_with_emitter_spy(ctx, ds, monkeypatch)
+    assert len(out) == 100
+    assert out[0][1] == 0 and out[5][1] == 10      # sibling ran
+    assert bad.__name__ not in seen, \
+        "emitter was invoked for a statically untraceable UDF"
+    assert "<lambda>" in seen, "traceable sibling did not compile"
+    assert ctx.metrics.planFallbackOps() >= 1
+    assert ctx.metrics.as_dict()["analyzer_ms"] >= 0.0
+
+
+def test_plan_segments_carry_route_reason(ctx):
+    from tuplex_tpu.plan.physical import TransformStage, plan_stages
+
+    ds = ctx.parallelize([(i,) for i in range(64)], columns=["a"]) \
+        .withColumn("b", lambda x: x["a"] + 1) \
+        .withColumn("c", io_udf)
+    stages = [s for s in plan_stages(ds._op, ctx.options_store)
+              if isinstance(s, TransformStage)]
+    routed = [s for s in stages if s.force_interpret]
+    assert routed and "plan-time fallback" in routed[0].route_reason
+    assert any(not s.force_interpret for s in stages)
+
+
+def test_stage_possible_exception_codes(ctx):
+    from tuplex_tpu.plan.physical import TransformStage, plan_stages
+
+    ds = ctx.parallelize([("1", 2)], columns=["a", "b"]).map(clean_udf)
+    stages = [s for s in plan_stages(ds._op, ctx.options_store)
+              if isinstance(s, TransformStage) and s.ops]
+    codes = set()
+    for s in stages:
+        codes.update(s.possible_exception_codes())
+    assert {EC.KEYERROR, EC.VALUEERROR, EC.ZERODIVISIONERROR} <= codes
+
+
+def test_explain_lint_lists_findings(ctx, capsys):
+    ds = ctx.parallelize([(1,)], columns=["a"]) \
+        .withColumn("b", clean_udf).withColumn("c", io_udf)
+    text = ds.explain(lint=True)
+    assert "lint:" in text
+    assert "exc-site" in text and "fallback" in text
+    assert "possible row error codes" in text
+    assert "test_analyzer.py:" in text     # source locations
+
+
+# --------------------------------------------------------------------------
+# lint CLI + argparse subcommands
+# --------------------------------------------------------------------------
+
+_SCRIPT = '''
+import tuplex_tpu
+
+def extract(x):
+    return int(x["price"][1:]) / x["sqft"]
+
+def bad(x):
+    with open("/tmp/log") as fh:
+        fh.write(str(x))
+    return x
+
+c = tuplex_tpu.Context()
+ds = c.parallelize([{"price": "$100", "sqft": 2}])
+ds.withColumn("ppsf", extract).map(bad).filter(lambda x: x["ppsf"] > 1)
+'''
+
+
+def test_lint_file_reports_findings_with_locations(tmp_path, capsys):
+    p = tmp_path / "pipe.py"
+    p.write_text(_SCRIPT)
+    rc = az.lint_file(str(p))
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "3 UDF(s)" in out
+    assert f"{p}:8: I/O call (open)" in out              # fallback site
+    assert "ZERODIVISIONERROR" in out and "KEYERROR" in out
+    assert "INTERPRETER (plan-time fallback)" in out
+    assert az.lint_file(str(p), strict=True) == 1
+
+
+def test_lint_file_finds_udfs_nested_in_functions(tmp_path, capsys):
+    p = tmp_path / "nested.py"
+    p.write_text(
+        "import tuplex_tpu\n"
+        "def main():\n"
+        "    def ext(x):\n"
+        "        return open(x['path']).read()\n"
+        "    c = tuplex_tpu.Context()\n"
+        "    c.parallelize([{'path': '/x'}]).map(ext).collect()\n")
+    assert az.lint_file(str(p), strict=True) == 1
+    out = capsys.readouterr().out
+    assert "ext(x)" in out and "I/O call (open)" in out
+
+
+def test_lint_file_no_udfs(tmp_path, capsys):
+    p = tmp_path / "empty.py"
+    p.write_text("x = 1\n")
+    assert az.lint_file(str(p)) == 0
+    assert "no UDFs found" in capsys.readouterr().out
+
+
+def test_main_subcommands(tmp_path, capsys):
+    from tuplex_tpu.__main__ import main
+
+    assert main(["version"]) == 0
+    import tuplex_tpu
+
+    assert tuplex_tpu.__version__ in capsys.readouterr().out
+    p = tmp_path / "pipe.py"
+    p.write_text(_SCRIPT)
+    assert main(["lint", str(p)]) == 0
+    assert main(["lint", str(p), "--strict"]) == 1
+    assert "fallback" in capsys.readouterr().out

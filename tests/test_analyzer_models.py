@@ -1,0 +1,78 @@
+"""The analyzer over every bundled model pipeline: zero FALSE
+"must-fallback" verdicts. All model UDFs are known to trace (they are the
+benchmark workloads), so any fallback finding here is analyzer
+over-restriction — the exact failure mode that would silently demote a
+benchmark from the compiled path to the interpreter."""
+
+import pytest
+
+from tuplex_tpu.compiler import analyzer as az
+from tuplex_tpu.plan.physical import plan_stages
+
+
+def _assert_no_false_fallback(ds, allow_conditional: bool = False):
+    reports = az.chain_reports(ds._op)
+    assert reports, "pipeline carries no UDFs?"
+    offenders = []
+    for op, attr, rep in reports:
+        if rep.must_fallback if not allow_conditional \
+                else rep.must_fallback_now(True):
+            offenders.append(
+                (type(op).__name__, attr, rep.name,
+                 [f.reason for f in rep.fallback_findings]))
+    assert not offenders, f"false must-fallback verdicts: {offenders}"
+    # and the planner routes no operator to the interpreter at plan time
+    snap = az.snapshot()
+    plan_stages(ds._op, ds._context.options_store)
+    assert az.delta(snap)["plan_fallback_ops"] == 0
+
+
+def test_zillow_model_udfs_traceable(ctx, tmp_path):
+    from tuplex_tpu.models import zillow
+
+    path = str(tmp_path / "zillow.csv")
+    zillow.generate_csv(path, 300, seed=42)
+    _assert_no_false_fallback(zillow.build_pipeline(ctx.csv(path)))
+
+
+def test_flights_model_udfs_traceable(ctx, tmp_path):
+    from tuplex_tpu.models import flights
+
+    perf = str(tmp_path / "flights.csv")
+    carrier = str(tmp_path / "carrier.csv")
+    airport = str(tmp_path / "airports.txt")
+    flights.generate_perf_csv(perf, 300, seed=2)
+    flights.generate_carrier_csv(carrier)
+    flights.generate_airport_db(airport)
+    _assert_no_false_fallback(
+        flights.build_pipeline(ctx, perf, carrier, airport))
+
+
+def test_nyc311_model_udfs_traceable(ctx, tmp_path):
+    from tuplex_tpu.models import nyc311
+
+    path = str(tmp_path / "n311.csv")
+    nyc311.generate_csv(path, 300)
+    _assert_no_false_fallback(nyc311.build_pipeline(ctx, path))
+
+
+@pytest.mark.parametrize("mode", ["strip", "regex"])
+def test_logs_model_udfs_traceable(ctx, tmp_path, mode):
+    from tuplex_tpu.models import logs
+
+    path = str(tmp_path / "logs.txt")
+    logs.generate_log(path, 300)
+    _assert_no_false_fallback(logs.build_pipeline(ctx.text(path), mode))
+
+
+def test_tpch_model_udfs_traceable(ctx, tmp_path):
+    from tuplex_tpu.models import tpch
+
+    li = str(tmp_path / "lineitem.csv")
+    tpch.generate_csv(li, 300, seed=4)
+    _assert_no_false_fallback(tpch.q6(ctx.csv(li)))
+    _assert_no_false_fallback(tpch.q1(ctx.csv(li)))
+    pq = str(tmp_path / "part.csv")
+    lq = str(tmp_path / "li19.csv")
+    tpch.generate_q19_csvs(pq, lq, 50, 300)
+    _assert_no_false_fallback(tpch.q19(ctx, pq, lq))

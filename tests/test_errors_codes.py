@@ -1,0 +1,80 @@
+"""ExceptionCode round-trips (core/errors.py): every code maps
+int -> Python class -> name and back, including the >=100 synthetic codes
+and the packed device-lattice layout."""
+
+import numpy as np
+import pytest
+
+from tuplex_tpu.core import errors as E
+
+EC = E.ExceptionCode
+
+
+def test_every_code_roundtrips():
+    for c in EC:
+        name = E.exception_name(int(c))
+        cls = E.exception_class_for_code(int(c))
+        if cls is not None:
+            # python-class codes: int -> class -> code -> name closes
+            assert E.code_for_exception(cls("x")) == c
+            assert name == cls.__name__
+        else:
+            # internal/synthetic codes keep the enum name
+            assert name == c.name
+
+
+def test_synthetic_codes_have_no_python_class():
+    synthetic = [c for c in EC if int(c) >= 100]
+    assert synthetic, "expected internal codes >= 100"
+    for c in synthetic:
+        assert E.exception_class_for_code(int(c)) is None
+        assert E.exception_name(int(c)) == c.name
+
+
+def test_exception_subclass_maps_to_base_code():
+    class MyErr(ValueError):
+        pass
+
+    assert E.code_for_exception(MyErr()) == EC.VALUEERROR
+
+
+def test_unmapped_exception_is_unknown():
+    assert E.code_for_exception(OSError()) == EC.UNKNOWN
+
+
+def test_code_for_name_roundtrips():
+    for c in EC:
+        cls = E.exception_class_for_code(int(c))
+        if cls is not None:
+            assert E.code_for_name(cls.__name__) == c
+    assert E.code_for_name("ValueError") == EC.VALUEERROR
+    assert E.code_for_name("OSError") is None
+    assert E.code_for_name("") is None
+
+
+def test_unknown_int_has_fallback_name():
+    assert E.exception_name(9999) == "code9999"
+
+
+@pytest.mark.parametrize("code", [int(c) for c in EC])
+def test_pack_unpack_device_code(code):
+    packed = E.pack_device_code(code, 17)
+    got_code, got_op = E.unpack_device_code(packed)
+    assert (got_code, got_op) == (code, 17)
+
+
+def test_pack_overflowing_op_id_degrades_to_zero():
+    packed = E.pack_device_code(int(EC.KEYERROR), 1 << 23)
+    code, op = E.unpack_device_code(packed)
+    assert code == int(EC.KEYERROR) and op == 0
+    # negative / zero op ids likewise pack as "unknown operator"
+    assert E.unpack_device_code(E.pack_device_code(3, 0)) == (3, 0)
+
+
+def test_vectorized_unpack_matches_scalar():
+    codes = [E.pack_device_code(int(c), i + 1)
+             for i, c in enumerate(EC)]
+    arr = np.asarray(codes, dtype=np.int64)
+    got = list(E.unpack_device_codes(arr))
+    want = [E.unpack_device_code(p) for p in codes]
+    assert got == want

@@ -1,0 +1,69 @@
+"""Column-read analysis edges (plan/optimizer.py udf_read_columns): the
+projection-pushdown prerequisite is that a wrong-but-nonempty read set is
+never returned — ambiguous shapes must degrade to ALL (None = whole row)."""
+
+from tuplex_tpu.plan.optimizer import ALL, udf_read_columns
+from tuplex_tpu.utils.reflection import get_udf_source
+
+
+def _reads(f):
+    return udf_read_columns(get_udf_source(f))
+
+
+def test_simple_const_reads():
+    assert _reads(lambda x: x["a"] + x["b"]) == {"a", "b"}
+
+
+def test_dynamic_subscript_is_all():
+    col = "a"
+    assert _reads(lambda x: x[col]) is ALL
+
+
+def test_int_subscript_is_all():
+    assert _reads(lambda x: x[0] + x[1]) is ALL
+
+
+def test_tuple_unpack_alias_is_all():
+    def f(x):
+        a, b = x
+        return a["p"] + b
+    assert _reads(f) is ALL
+
+
+def test_plain_alias_is_all():
+    def f(x):
+        y = x
+        return y["a"]
+    assert _reads(f) is ALL
+
+
+def test_row_escape_is_all():
+    assert _reads(lambda x: len(x)) is ALL
+
+
+def test_nested_lambda_shadowing_param_is_all():
+    # the inner lambda REBINDS x: its x['z'] subscripts are not row reads,
+    # and the walk can't tell them apart -> must degrade to ALL, never to
+    # the wrong set {'vals', 'z'}
+    f = lambda x: sorted(x["vals"], key=lambda x: x["z"])  # noqa: E731
+    assert get_udf_source(f).source          # extraction must not bail
+    assert _reads(f) is ALL
+
+
+def test_nested_def_shadowing_param_is_all():
+    def f(x):
+        def g(x):
+            return x["z"]
+        return g(x["vals"])
+    assert _reads(f) is ALL
+
+
+def test_nested_lambda_without_shadowing_keeps_precision():
+    # a DIFFERENT inner param leaves the outer reads unambiguous
+    f = lambda x: sorted(x["vals"], key=lambda y: y["z"])  # noqa: E731
+    assert get_udf_source(f).source
+    assert _reads(f) == {"vals"}
+
+
+def test_multi_param_is_all():
+    assert _reads(lambda a, b: a + b) is ALL

@@ -1,8 +1,60 @@
-"""``python -m tuplex_tpu`` — interactive shell with a ready Context and
-jedi tab-completion (reference: python/tuplex/utils/interactive_shell.py
-TuplexShell, launched by the `tuplex` console entry point)."""
+"""``python -m tuplex_tpu`` — CLI entry point.
 
-from .utils.repl import interactive_shell
+Bare invocation keeps the interactive shell with a ready Context and jedi
+tab-completion (reference: python/tuplex/utils/interactive_shell.py
+TuplexShell, launched by the `tuplex` console entry point). Subcommands:
+
+    python -m tuplex_tpu                  # interactive shell (default)
+    python -m tuplex_tpu shell            # same, explicit
+    python -m tuplex_tpu lint script.py   # plan-time UDF static analysis
+    python -m tuplex_tpu version          # print the package version
+
+`lint` runs the compiler's static analyzer (compiler/analyzer.py) over every
+UDF the script hands to DataSet methods — purely syntactic, the script is
+never imported or executed — and prints per-UDF fallback, exception-site,
+and purity findings with file:line locations. `--strict` exits non-zero
+when any fallback finding exists.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tuplex_tpu",
+        description="tuplex_tpu — TPU-native data-processing framework")
+    sub = parser.add_subparsers(dest="cmd")
+    sub.add_parser("shell", help="interactive shell (the default)")
+    lint = sub.add_parser(
+        "lint", help="static-analyze the UDFs of a pipeline script")
+    lint.add_argument("script", help="path to a python pipeline script")
+    lint.add_argument("--strict", action="store_true",
+                      help="exit non-zero on any fallback finding")
+    sub.add_parser("version", help="print the package version")
+    args = parser.parse_args(argv)
+
+    if args.cmd == "version":
+        from . import __version__
+
+        print(__version__)
+        return 0
+    if args.cmd == "lint":
+        from .compiler.analyzer import lint_file
+
+        try:
+            return lint_file(args.script, strict=args.strict)
+        except OSError as e:
+            print(f"lint: {e}", file=sys.stderr)
+            return 2
+    # bare invocation or explicit `shell`
+    from .utils.repl import interactive_shell
+
+    interactive_shell()
+    return 0
+
 
 if __name__ == "__main__":
-    interactive_shell()
+    sys.exit(main())

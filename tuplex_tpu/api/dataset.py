@@ -140,14 +140,17 @@ class DataSet:
             else:
                 print(repr(r))
 
-    def explain(self) -> str:
+    def explain(self, lint: bool = False) -> str:
         """Human-readable physical plan: stages + fused operators, with
         per-stage jaxpr codegen stats when tuplex.optimizer.codeStats is on
         (reference: LocalBackend.cc:932-949 stage logs +
-        InstructionCountPass.h)."""
+        InstructionCountPass.h). `lint=True` appends the plan-time UDF
+        static-analysis reports (compiler/analyzer.py): per-UDF fallback /
+        exception-site / purity findings with source locations, and each
+        stage's possible row error codes."""
         from ..utils.planviz import explain as _explain
 
-        text = _explain(self._op, self._context.options_store)
+        text = _explain(self._op, self._context.options_store, lint=lint)
         print(text)
         return text
 
@@ -269,7 +272,14 @@ class DataSet:
             except Exception:
                 prof_cm = None
         sink = L.TakeOperator(self._op, limit) if limit >= 0 else self._op
+        from ..compiler import analyzer as _az
+
+        azsnap = _az.snapshot()
         stages = plan_stages(sink, self._context.options_store)
+        azd = _az.delta(azsnap)
+        self._context.metrics.record_plan({
+            "analyzer_ms": azd["analyze_ms"],
+            "plan_fallback_ops": azd["plan_fallback_ops"]})
         backend = self._context.backend
         recorder = self._context.recorder
         recorder.job_started("collect" if limit < 0 else f"take({limit})",

@@ -8,9 +8,25 @@ from __future__ import annotations
 class Metrics:
     def __init__(self):
         self.stages: list[dict] = []
+        self.plans: list[dict] = []
 
     def record_stage(self, m: dict) -> None:
         self.stages.append(dict(m))
+
+    def record_plan(self, m: dict) -> None:
+        """Planning-time record: static-analyzer wall time and the number
+        of operators the analyzer routed to the interpreter at PLAN time
+        (compiler/analyzer.py STATS delta for one plan_stages call)."""
+        self.plans.append(dict(m))
+
+    def analyzerTimeMs(self) -> float:
+        """Total UDF static-analysis wall time (ms) across plans."""
+        return sum(float(m.get("analyzer_ms", 0.0)) for m in self.plans)
+
+    def planFallbackOps(self) -> int:
+        """Operators routed to the interpreter by the PLAN-time analyzer
+        verdict (the emitter was never invoked for them)."""
+        return sum(int(m.get("plan_fallback_ops", 0)) for m in self.plans)
 
     # -- totals (JobMetrics getters) ----------------------------------------
     @property
@@ -82,6 +98,8 @@ class Metrics:
             "wall_s": self.totalWallTime(),
             "rows_out": self.totalRowsOut(),
             "exception_rows": self.totalExceptionCount,
+            "analyzer_ms": self.analyzerTimeMs(),
+            "plan_fallback_ops": self.planFallbackOps(),
         }
 
     def as_json(self) -> str:

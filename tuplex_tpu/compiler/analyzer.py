@@ -1,0 +1,708 @@
+"""Plan-time UDF static analysis: traceability, exception sites, purity.
+
+Tuplex's headline trick is deciding *before* execution what the compiled
+normal-case path can and cannot handle (reference: UDF.h hintInputSchema /
+the compile-or-fallback split in StageBuilder.cc). Our port previously
+learned a UDF was untraceable only when the emitter threw mid-trace and the
+row got stamped PYTHON_FALLBACK. This module runs ONE AST+closure pass per
+UDF at plan time and produces a structured ``UDFReport``:
+
+* **traceability verdict** — construct sites the emitter can never compile
+  (generators, try/except, global/closure mutation, I/O calls, recursion,
+  unbounded ``while``, dynamic ``exec``/``eval``). The planner routes such
+  operators to the interpreter pipeline at *plan* time; the emitter is never
+  invoked for them. Findings inside an ``if`` arm are marked *conditional*:
+  sample-driven branch speculation may prune the arm, so those stay with the
+  trace probe (reference: RemoveDeadBranchesVisitor semantics).
+* **exception-site inventory** — AST nodes mapped to the ``ExceptionCode``
+  the compiled path can emit there (division -> ZERODIVISIONERROR,
+  ``row[k]`` -> KEYERROR, ``int(s)`` -> VALUEERROR, attribute on an Option
+  value -> NULLERROR...), so physical planning knows each stage's possible
+  error codes without sampling.
+* **purity/determinism verdict** — ``random``/``time`` calls and
+  mutable-global reads. Nondeterministic chains disable the cross-job
+  sample/schema memo (plan/logical.py), branch speculation, and are flagged
+  on cache() materialization (plan/cacheop.py).
+
+Everything is exposed as human-readable diagnostics with source locations
+via ``python -m tuplex_tpu lint <script.py>`` and ``DataSet.explain(lint=
+True)``. Analysis cost is recorded in STATS (api/metrics.py: analyzer_ms).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import time
+import types
+from typing import Any, Optional
+
+from ..core.errors import ExceptionCode
+
+# -- module call classification ---------------------------------------------
+
+# calls that compile to nothing sensible on device and always will
+_DYNAMIC_CALLS = {"eval", "exec", "compile", "__import__", "globals",
+                  "locals", "vars", "delattr", "setattr"}
+_IO_CALLS = {"open", "input", "print", "breakpoint"}
+# module-level calls that are I/O or process state: never device material
+_IO_MODULES = {"os", "sys", "io", "shutil", "subprocess", "socket",
+               "urllib", "requests", "pathlib"}
+# nondeterminism markers. NOTE: `random` COMPILES (the emitter stages a
+# per-partition #seed) — it is an impurity verdict, not a fallback one.
+_NONDET_MODULES = {"random", "time", "datetime", "uuid", "secrets"}
+
+_FINDINGS_CAP = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    kind: str                 # "fallback" | "exception" | "impure"
+    reason: str               # human-readable, one line
+    lineno: int               # relative to the UDF source (or absolute in
+    col: int                  # lint-file mode; see UDFReport.abs_lines)
+    code: Optional[ExceptionCode] = None   # exception-site code
+    conditional: bool = False  # inside an if-arm branch speculation may prune
+
+
+@dataclasses.dataclass
+class UDFReport:
+    name: str
+    params: tuple
+    filename: str = "<udf>"
+    line_base: int = 1        # absolute line of the UDF's first source line
+    abs_lines: bool = False   # linenos in findings are already file-absolute
+    findings: list = dataclasses.field(default_factory=list)
+    deterministic: bool = True
+    mutates_globals: bool = False
+
+    # -- verdicts ----------------------------------------------------------
+    @property
+    def fallback_findings(self) -> list:
+        return [f for f in self.findings if f.kind == "fallback"]
+
+    @property
+    def exception_findings(self) -> list:
+        return [f for f in self.findings if f.kind == "exception"]
+
+    @property
+    def impure_findings(self) -> list:
+        return [f for f in self.findings if f.kind == "impure"]
+
+    @property
+    def must_fallback(self) -> bool:
+        """Any construct the emitter can never compile (incl. conditional
+        sites that speculation might prune)."""
+        return bool(self.fallback_findings)
+
+    def must_fallback_now(self, speculate: bool = True) -> bool:
+        """The PLAN-time routing verdict: route to the interpreter without
+        attempting a trace. With speculation on, findings inside if-arms are
+        left to the trace probe (the sample profile may prune the arm)."""
+        return self.routing_finding(speculate) is not None
+
+    def routing_finding(self, speculate: bool = True) -> Optional[Finding]:
+        """The first fallback finding that actually triggers plan-time
+        routing under the given speculation mode — diagnostics must cite
+        THIS site, not a cold-arm finding the trace probe still owns."""
+        for f in self.fallback_findings:
+            if not (f.conditional and speculate):
+                return f
+        return None
+
+    @property
+    def pure(self) -> bool:
+        return not self.impure_findings and not self.mutates_globals
+
+    def exception_codes(self) -> set:
+        return {f.code for f in self.exception_findings if f.code is not None}
+
+    # -- rendering ---------------------------------------------------------
+    def loc(self, f: Finding) -> str:
+        line = f.lineno if self.abs_lines else self.line_base + f.lineno - 1
+        return f"{self.filename}:{line}"
+
+    def verdict_line(self) -> str:
+        if self.must_fallback:
+            path = "INTERPRETER (plan-time fallback)"
+        else:
+            path = "compiled fast path candidate"
+        purity = "pure" if self.pure else (
+            "nondeterministic" if not self.deterministic else "impure")
+        return f"{self.name}({', '.join(self.params)}) " \
+               f"[{self.filename}:{self.line_base}] — {path}; {purity}"
+
+    def format(self, indent: str = "") -> list:
+        out = [indent + self.verdict_line()]
+        for f in self.fallback_findings:
+            cond = " [cold-arm: trace probe decides]" if f.conditional else ""
+            out.append(f"{indent}  fallback  {self.loc(f)}: {f.reason}{cond}")
+        for f in self.exception_findings:
+            code = f.code.name if f.code is not None else "?"
+            out.append(f"{indent}  exc-site  {self.loc(f)}: {f.reason} "
+                       f"-> {code}")
+        for f in self.impure_findings:
+            out.append(f"{indent}  impure    {self.loc(f)}: {f.reason}")
+        return out
+
+
+# ===========================================================================
+# the single AST pass
+# ===========================================================================
+
+class _UdfVisitor(ast.NodeVisitor):
+    """One walk over a UDF body collecting all three finding categories.
+
+    Scope discipline: `locals_` over-approximates bound-in-body names (any
+    Store), so global reads are under-reported, never over-reported. A
+    nested lambda/def whose parameter shadows analysis-relevant names is a
+    fallback site anyway (the emitter has no nested-scope support)."""
+
+    def __init__(self, report: UDFReport, self_name: str,
+                 globals_map: dict, module_names: dict, locals_: set):
+        self.r = report
+        self.self_name = self_name
+        self.globals_map = globals_map
+        self.module_names = module_names
+        self.locals = locals_
+        self.if_depth = 0
+        self._impure_names: set = set()
+
+    # -- helpers -----------------------------------------------------------
+    def _add(self, kind: str, node: ast.AST, reason: str,
+             code: Optional[ExceptionCode] = None,
+             conditional: Optional[bool] = None) -> None:
+        if len(self.r.findings) >= _FINDINGS_CAP:
+            return
+        cond = self.if_depth > 0 if conditional is None else conditional
+        self.r.findings.append(Finding(
+            kind=kind, reason=reason, lineno=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0), code=code, conditional=cond))
+
+    def _fallback(self, node, reason, conditional=None):
+        self._add("fallback", node, reason, conditional=conditional)
+
+    def _exc(self, node, reason, code):
+        self._add("exception", node, reason, code=code)
+
+    # -- conditionality tracking -------------------------------------------
+    def visit_If(self, node: ast.If) -> None:
+        self.visit(node.test)
+        self.if_depth += 1
+        for s in node.body:
+            self.visit(s)
+        for s in node.orelse:
+            self.visit(s)
+        self.if_depth -= 1
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self.visit(node.test)
+        self.if_depth += 1
+        self.visit(node.body)
+        self.visit(node.orelse)
+        self.if_depth -= 1
+
+    # -- definite fallback constructs --------------------------------------
+    def visit_Yield(self, node) -> None:
+        # a yield anywhere makes the whole function a generator: scope-wide
+        self._fallback(node, "generator (yield)", conditional=False)
+        self.generic_visit(node)
+
+    def visit_YieldFrom(self, node) -> None:
+        self._fallback(node, "generator (yield from)", conditional=False)
+        self.generic_visit(node)
+
+    def visit_Await(self, node) -> None:
+        self._fallback(node, "async construct (await)", conditional=False)
+        self.generic_visit(node)
+
+    def visit_Try(self, node) -> None:
+        self._fallback(node, "try/except block")
+        self.generic_visit(node)
+
+    def visit_TryStar(self, node) -> None:          # pragma: no cover
+        self._fallback(node, "try/except* block")
+        self.generic_visit(node)
+
+    def visit_With(self, node) -> None:
+        self._fallback(node, "with block")
+        self.generic_visit(node)
+
+    def visit_AsyncWith(self, node) -> None:
+        self._fallback(node, "async with block", conditional=False)
+
+    def visit_AsyncFor(self, node) -> None:
+        self._fallback(node, "async for loop", conditional=False)
+
+    def visit_Import(self, node) -> None:
+        self._fallback(node, "import inside UDF body")
+
+    def visit_ImportFrom(self, node) -> None:
+        self._fallback(node, "import inside UDF body")
+
+    def visit_Delete(self, node) -> None:
+        self._fallback(node, "del statement")
+
+    def visit_Match(self, node) -> None:
+        self._fallback(node, "match statement")
+        self.generic_visit(node)
+
+    def visit_Global(self, node: ast.Global) -> None:
+        # scope-wide declaration in CPython regardless of where it appears
+        self._fallback(node, f"global mutation ({', '.join(node.names)})",
+                       conditional=False)
+        self.r.mutates_globals = True
+
+    def visit_Nonlocal(self, node: ast.Nonlocal) -> None:
+        self._fallback(node,
+                       f"closure-cell mutation ({', '.join(node.names)})",
+                       conditional=False)
+        self.r.mutates_globals = True
+
+    def visit_FunctionDef(self, node) -> None:
+        self._fallback(node, f"nested function def {node.name!r}")
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._fallback(node, "async function def", conditional=False)
+
+    def visit_ClassDef(self, node) -> None:
+        self._fallback(node, f"class def {node.name!r} inside UDF")
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # a nested lambda value has no device representation; its body is a
+        # separate scope — don't descend (misattributed locals/globals)
+        self._fallback(node, "nested lambda")
+
+    def visit_SetComp(self, node) -> None:
+        self._fallback(node, "set comprehension")
+
+    def visit_While(self, node: ast.While) -> None:
+        test = node.test
+        const_true = isinstance(test, ast.Constant) and bool(test.value)
+        if const_true and not _has_own_break(node):
+            self._fallback(node, "unbounded while (constant-true, no break)")
+        else:
+            self._exc(node, "while loop past the unroll cap interprets "
+                      "the row", ExceptionCode.LOOPCAPEXCEEDED)
+        self.generic_visit(node)
+
+    # -- assignments: global-structure mutation -----------------------------
+    def _check_target(self, tgt: ast.AST) -> None:
+        root = tgt
+        while isinstance(root, (ast.Subscript, ast.Attribute)):
+            root = root.value
+        if isinstance(root, ast.Name) and root is not tgt \
+                and root.id not in self.locals:
+            self._fallback(tgt, f"mutates captured global {root.id!r}")
+            self.r.mutates_globals = True
+
+    def _check_target_tree(self, t: ast.AST) -> None:
+        """Every assignment slot in a (possibly nested tuple/list) target."""
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                self._check_target_tree(el)
+        elif isinstance(t, ast.Starred):
+            self._check_target_tree(t.value)
+        elif isinstance(t, (ast.Subscript, ast.Attribute)):
+            self._check_target(t)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._check_target_tree(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_target_tree(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node) -> None:
+        self._check_target_tree(node.target)
+        self.generic_visit(node)
+
+    # -- calls ---------------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            n = fn.id
+            if n in _DYNAMIC_CALLS:
+                self._fallback(node, f"dynamic code/introspection ({n})")
+            elif n in _IO_CALLS:
+                self._fallback(node, f"I/O call ({n})")
+            elif n == self.self_name and n:
+                self._fallback(node, f"recursive call to {n!r}")
+            elif n in ("int", "float") and node.args:
+                a = node.args[0]
+                if not (isinstance(a, ast.Constant)
+                        and isinstance(a.value, (int, float))):
+                    self._exc(node, f"{n}() parse of a non-constant",
+                              ExceptionCode.VALUEERROR)
+        elif isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            # classify by the module's REAL name, not the local binding —
+            # `import random as rnd` / modules passed through closures must
+            # not dodge the verdict
+            real = self.module_names.get(fn.value.id)
+            if real is not None:
+                if real in _IO_MODULES:
+                    self._fallback(node, f"I/O module call "
+                                   f"({fn.value.id}.{fn.attr})")
+                elif real in _NONDET_MODULES:
+                    self._add("impure", node,
+                              f"nondeterministic call "
+                              f"{fn.value.id}.{fn.attr}()")
+                    self.r.deterministic = False
+        self.generic_visit(node)
+
+    # -- exception-site inventory -------------------------------------------
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if isinstance(node.ctx, ast.Load) and \
+                not isinstance(node.slice, ast.Slice):
+            key = node.slice
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                self._exc(node, f"subscript [{key.value!r}]",
+                          ExceptionCode.KEYERROR)
+            else:
+                self._exc(node, "indexed subscript",
+                          ExceptionCode.INDEXERROR)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.ctx, ast.Load):
+            base = node.value
+            if not (isinstance(base, ast.Name)
+                    and base.id in self.module_names):
+                self._exc(node, f"attribute/method .{node.attr} on a "
+                          "possibly-None (Option) value",
+                          ExceptionCode.NULLERROR)
+        self.generic_visit(node)
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        if isinstance(node.op, (ast.Div, ast.FloorDiv, ast.Mod)):
+            right_nonzero_const = (isinstance(node.right, ast.Constant)
+                                   and isinstance(node.right.value,
+                                                  (int, float))
+                                   and node.right.value != 0)
+            left_is_fmt = isinstance(node.op, ast.Mod) and (
+                isinstance(node.left, ast.JoinedStr)
+                or (isinstance(node.left, ast.Constant)
+                    and isinstance(node.left.value, str)))
+            if not right_nonzero_const and not left_is_fmt:
+                opn = {ast.Div: "/", ast.FloorDiv: "//",
+                       ast.Mod: "%"}[type(node.op)]
+                self._exc(node, f"division ({opn})",
+                          ExceptionCode.ZERODIVISIONERROR)
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._exc(node, "assert", ExceptionCode.ASSERTIONERROR)
+        self.generic_visit(node)
+
+    def visit_Raise(self, node: ast.Raise) -> None:
+        exc = node.exc
+        name = None
+        if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
+            name = exc.func.id
+        elif isinstance(exc, ast.Name):
+            name = exc.id
+        from ..core.errors import code_for_name
+
+        code = code_for_name(name or "")
+        self._exc(node, f"raise {name or '?'}",
+                  code if code is not None else ExceptionCode.UNKNOWN)
+        self.generic_visit(node)
+
+    # -- purity: mutable-global reads ---------------------------------------
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load) and node.id not in self.locals \
+                and node.id not in self._impure_names:
+            v = self.globals_map.get(node.id)
+            if isinstance(v, (list, dict, set, bytearray)):
+                self._impure_names.add(node.id)
+                self._add("impure", node,
+                          f"reads mutable global {node.id!r} "
+                          f"({type(v).__name__})")
+
+
+def _has_own_break(loop: ast.AST) -> bool:
+    """Whether a loop body contains a break bound to THIS loop. Breaks in a
+    nested loop's body belong to that loop — but a break in a nested loop's
+    `else:` block binds to the ENCLOSING loop, so those still count. The
+    loop's own `orelse` is excluded (a break there binds further out)."""
+    stack = list(loop.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Break):
+            return True
+        if isinstance(n, (ast.While, ast.For, ast.AsyncFor)):
+            stack.extend(n.orelse)   # nested loop's else binds to THIS loop
+            continue
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                          ast.ClassDef)):
+            continue                 # new scope: break is a SyntaxError there
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def _bound_names(node: ast.AST) -> set:
+    """Over-approximate the names bound inside a UDF body (params added by
+    the caller): any Store/walrus/for/comprehension target."""
+    out: set = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            out.add(n.id)
+        elif isinstance(n, ast.NamedExpr) and isinstance(n.target, ast.Name):
+            out.add(n.target.id)
+        elif isinstance(n, ast.arg):
+            out.add(n.arg)
+    return out
+
+
+def _all_params(node) -> tuple:
+    a = node.args
+    names = [x.arg for x in
+             list(getattr(a, "posonlyargs", [])) + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return tuple(names)
+
+
+def analyze_tree(node: ast.AST, name: str = "<udf>",
+                 globals_map: Optional[dict] = None,
+                 module_names=None,
+                 filename: str = "<udf>", line_base: int = 1,
+                 abs_lines: bool = False) -> UDFReport:
+    """Analyze one Lambda/FunctionDef AST node. `globals_map` carries the
+    captured closure/global VALUES when available (runtime mode);
+    `module_names` maps names known to be modules to the module's REAL name
+    (lint mode derives them from the script's imports; a plain set/iterable
+    is accepted as the identity mapping)."""
+    globals_map = globals_map or {}
+    if module_names is None:
+        module_names = {k: v.__name__.split(".")[0]
+                        for k, v in globals_map.items()
+                        if isinstance(v, types.ModuleType)}
+    elif not isinstance(module_names, dict):
+        module_names = {n: n for n in module_names}
+    params = _all_params(node) if hasattr(node, "args") else ()
+    rpt = UDFReport(name=name, params=params, filename=filename,
+                    line_base=line_base, abs_lines=abs_lines)
+    body = node.body if isinstance(node, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) else [node.body]
+    locals_ = set(params)
+    for s in body:
+        locals_ |= _bound_names(s)
+    v = _UdfVisitor(rpt, name, globals_map, module_names, locals_)
+    if isinstance(node, ast.AsyncFunctionDef):
+        v._fallback(node, "async function def", conditional=False)
+    for s in body:
+        v.visit(s)
+    return rpt
+
+
+# ===========================================================================
+# runtime entry points (UDFSource / operators / plans)
+# ===========================================================================
+
+STATS = {"analyze_calls": 0, "analyze_ms": 0.0, "plan_fallback_ops": 0}
+
+
+def snapshot() -> dict:
+    return dict(STATS)
+
+
+def delta(snap: dict) -> dict:
+    return {k: STATS[k] - snap.get(k, 0) for k in STATS}
+
+
+_udf_memo: dict = {}   # (code object, globals signature) -> UDFReport
+
+
+def _globals_sig(globs: dict) -> tuple:
+    """The slice of the captured globals the analysis actually reads:
+    module identities (purity/I-O classification) and which names hold
+    mutable containers. Two closures sharing a code object but capturing
+    different modules must NOT share a verdict."""
+    mods = tuple(sorted(
+        (k, v.__name__.split(".")[0]) for k, v in globs.items()
+        if isinstance(v, types.ModuleType)))
+    muts = tuple(sorted(
+        k for k, v in globs.items()
+        if isinstance(v, (list, dict, set, bytearray))))
+    return (mods, muts)
+
+
+def analyze_udf(udf) -> UDFReport:
+    """Report for a reflected UDFSource; memoized per (code object,
+    globals signature) — analysis is source-determined except for the
+    module/mutability classification of captured globals."""
+    code = getattr(udf.func, "__code__", None)
+    key = (code, _globals_sig(udf.globals)) if code is not None else None
+    if key is not None and key in _udf_memo:
+        return _udf_memo[key]
+    t0 = time.perf_counter()
+    filename = code.co_filename if code is not None else "<udf>"
+    line_base = code.co_firstlineno if code is not None else 1
+    if not udf.source:
+        rpt = UDFReport(name=udf.name, params=tuple(udf.params),
+                        filename=filename, line_base=line_base)
+        rpt.findings.append(Finding(
+            kind="fallback", reason="no retrievable UDF source",
+            lineno=1, col=0, conditional=False))
+    else:
+        rpt = analyze_tree(udf.tree, name=udf.name, globals_map=udf.globals,
+                           filename=filename, line_base=line_base)
+    STATS["analyze_calls"] += 1
+    STATS["analyze_ms"] += (time.perf_counter() - t0) * 1e3
+    if key is not None:
+        if len(_udf_memo) > 4096:
+            _udf_memo.clear()
+        _udf_memo[key] = rpt
+    return rpt
+
+
+_UDF_ATTRS = ("udf", "combine_udf", "aggregate_udf")
+
+
+def op_reports(op) -> list:
+    """[(udf attribute name, UDFReport)] for every UDF an operator carries;
+    memoized on the operator (operators are immutable once planned)."""
+    memo = getattr(op, "_az_reports", None)
+    if memo is None:
+        memo = []
+        for attr in _UDF_ATTRS:
+            u = getattr(op, attr, None)
+            if u is not None:
+                memo.append((attr, analyze_udf(u)))
+        try:
+            op._az_reports = memo
+        except (AttributeError, TypeError):   # pragma: no cover
+            pass
+    return memo
+
+
+def op_analysis(op) -> Optional[UDFReport]:
+    """The report of an operator's primary (fused) UDF, or None."""
+    for attr, rep in op_reports(op):
+        if attr == "udf":
+            return rep
+    return None
+
+
+def op_nondeterministic(op) -> bool:
+    return any(not rep.deterministic for _, rep in op_reports(op))
+
+
+def chain_reports(sink) -> list:
+    """[(op, udf attr, report)] over the whole upstream DAG of `sink`."""
+    out, seen, stack = [], set(), [sink]
+    while stack:
+        op = stack.pop()
+        if id(op) in seen:
+            continue
+        seen.add(id(op))
+        for attr, rep in op_reports(op):
+            out.append((op, attr, rep))
+        stack.extend(getattr(op, "parents", ()))
+    return out
+
+
+def chain_deterministic(op) -> bool:
+    return all(rep.deterministic for _, _, rep in chain_reports(op))
+
+
+# ===========================================================================
+# `python -m tuplex_tpu lint` — static lint of a pipeline script
+# ===========================================================================
+
+_UDF_METHODS = {"map", "filter", "withColumn", "mapColumn", "resolve",
+                "aggregate", "aggregateByKey"}
+
+
+def _collect_script_udfs(tree: ast.Module):
+    """(node, name) for every UDF passed to a DataSet-shaped method call:
+    inline lambdas plus module-level defs/lambda-assignments referenced by
+    name. Purely syntactic — the script is never imported or executed."""
+    module_fns: dict = {}
+    for s in ast.walk(tree):   # incl. defs nested inside functions — a UDF
+        # defined in main() must not silently escape a --strict gate
+        if isinstance(s, ast.FunctionDef):
+            module_fns.setdefault(s.name, s)
+        elif isinstance(s, ast.Assign) and isinstance(s.value, ast.Lambda):
+            for t in s.targets:
+                if isinstance(t, ast.Name):
+                    module_fns.setdefault(t.id, s.value)
+    out, seen = [], set()
+
+    def add(node, name):
+        if id(node) not in seen:
+            seen.add(id(node))
+            out.append((node, name))
+
+    for n in ast.walk(tree):
+        if not (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr in _UDF_METHODS):
+            continue
+        for a in n.args:
+            if isinstance(a, ast.Lambda):
+                add(a, "<lambda>")
+            elif isinstance(a, ast.Name) and a.id in module_fns:
+                add(module_fns[a.id], a.id)
+    return sorted(out, key=lambda p: getattr(p[0], "lineno", 0))
+
+
+def _script_module_names(tree: ast.Module) -> dict:
+    """{local binding -> real top-level module name} from the script's
+    imports, so `import random as rnd` still classifies as nondeterministic."""
+    mods: dict = {}
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Import):
+            for al in n.names:
+                base = al.name.split(".")[0]
+                mods[(al.asname or al.name).split(".")[0]] = base
+        elif isinstance(n, ast.ImportFrom) and n.module:
+            for al in n.names:
+                mods[al.asname or al.name] = n.module.split(".")[0]
+    return mods
+
+
+def lint_file(path: str, strict: bool = False, stream=None) -> int:
+    """Analyze every UDF a script hands to DataSet methods and print
+    per-UDF diagnostics with exact file:line locations. Returns a process
+    exit code: non-zero only under --strict with fallback findings."""
+    import sys
+
+    stream = stream if stream is not None else sys.stdout
+
+    def emit(line=""):
+        print(line, file=stream)
+
+    with open(path) as fp:
+        src = fp.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        emit(f"{path}: syntax error: {e}")
+        return 2
+    module_names = _script_module_names(tree)
+    udfs = _collect_script_udfs(tree)
+    if not udfs:
+        emit(f"{path}: no UDFs found (no DataSet-style "
+             f"map/filter/withColumn/... calls)")
+        return 0
+    n_fallback = n_sites = 0
+    emit(f"lint report for {path} — {len(udfs)} UDF(s)")
+    for node, name in udfs:
+        rpt = analyze_tree(node, name=name, module_names=module_names,
+                           filename=path,
+                           line_base=getattr(node, "lineno", 1),
+                           abs_lines=True)
+        n_fallback += len(rpt.fallback_findings)
+        n_sites += len(rpt.exception_findings)
+        emit()
+        for line in rpt.format():
+            emit(line)
+    emit()
+    emit(f"{len(udfs)} UDF(s): {n_fallback} fallback finding(s), "
+         f"{n_sites} exception site(s)")
+    return 1 if (strict and n_fallback) else 0

@@ -375,6 +375,14 @@ def _specialize_udf(udf, names: tuple):
     positionally, or None if the UDF can't be specialized."""
     if not udf.source or udf.tree is None:
         return None
+    from .analyzer import analyze_udf
+
+    if analyze_udf(udf).mutates_globals:
+        # the analyzer's verdict: a global/closure-mutating UDF must run as
+        # the LIVE function object — the rebuilt specialization executes
+        # against a COPY of the globals dict, so its writes would silently
+        # diverge from interpreter semantics
+        return None
     a = udf.tree.args
     if a.vararg or a.kwarg or a.kwonlyargs or a.posonlyargs or a.defaults:
         return None   # exotic signatures keep the generic calling convention

@@ -16,6 +16,22 @@ from ..runtime.jaxcfg import jnp
 from .values import CV, tuple_cv
 
 
+def require_traceable(ops, speculate: bool = True) -> None:
+    """Consume the plan-time traceability verdict (compiler/analyzer.py):
+    raise NotCompilable BEFORE any emitter work when a fused UDF is
+    statically known untraceable. With `speculate` on, findings inside
+    if-arms are left to the trace (branch pruning may remove them)."""
+    from .analyzer import op_analysis
+
+    for op in ops:
+        rep = op_analysis(op)
+        f = rep.routing_finding(speculate) if rep is not None else None
+        if f is not None:
+            raise NotCompilable(
+                f"UDF {rep.name} statically untraceable: {f.reason} "
+                f"({rep.loc(f)})")
+
+
 def leaf_cv(arrays: dict, path: str, t: T.Type) -> CV:
     """CV view over a staged leaf (see runtime.columns.stage_partition)."""
     base = t.without_option() if t.is_optional() else t

@@ -81,6 +81,14 @@ def exception_name(code: int) -> str:
     return name if name is not None else f"code{code}"
 
 
+def code_for_name(name: str):
+    """ExceptionCode for a Python exception-class NAME ('ValueError' →
+    VALUEERROR), or None when no compiled-path code exists for it. Static
+    analysis maps `raise X` sites through this without a live exception
+    instance (compiler/analyzer.py exception-site inventory)."""
+    return ExceptionCode.__members__.get(name.upper()) if name else None
+
+
 # Packed device-lattice layout: exception-class code in the low byte,
 # logical-operator id above it. One int32 per row carries both — a second
 # per-row operator lattice measured a 20x kLoop recompute pathology on

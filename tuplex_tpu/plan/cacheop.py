@@ -26,14 +26,41 @@ class CacheOperator(L.LogicalOperator):
         self._schema: Optional[T.RowType] = None
         self._exceptions: list = []
 
+    @property
+    def deterministic(self) -> bool:
+        """Plan-time purity verdict over the whole upstream chain
+        (compiler/analyzer.py). False means the cached materialization PINS
+        one nondeterministic outcome: re-running the same pipeline without
+        the cache would produce different rows, and speculative re-execution
+        of cached rows must not assume reproducibility."""
+        from ..compiler.analyzer import chain_deterministic
+
+        memo = getattr(self, "_det_memo", None)
+        if memo is None:
+            memo = self._det_memo = chain_deterministic(self.parent)
+        return memo
+
     # -- materialization (eager, like the reference) -----------------------
     def materialize(self, context) -> None:
         if self._partitions is not None:
             return
         from ..api.dataset import _source_partitions
+        from ..compiler import analyzer as _az
         from .physical import plan_stages
 
+        if not self.deterministic:
+            from ..utils.logging import get_logger
+
+            get_logger("plan").info(
+                "cache(): upstream chain is nondeterministic (random/time "
+                "UDFs) — materialized partitions pin this run's outcome; "
+                "cross-job sample/schema memoization is disabled for it")
+        snap = _az.snapshot()
         stages = plan_stages(self.parent, context.options_store)
+        d = _az.delta(snap)
+        context.metrics.record_plan({
+            "analyzer_ms": d["analyze_ms"],
+            "plan_fallback_ops": d["plan_fallback_ops"]})
         partitions = None
         for stage in stages:
             if getattr(stage, "source", None) is not None:

@@ -102,6 +102,14 @@ class LogicalOperator:
         ck = getattr(self, "_chain_key_memo", False)
         if ck is not False:
             return ck
+        from ..compiler.analyzer import op_nondeterministic
+
+        if op_nondeterministic(self):
+            # purity gate (compiler/analyzer.py): a nondeterministic UDF
+            # (random/time) makes content identity meaningless — rebuilding
+            # the pipeline must re-run its samples, not reuse memoized ones
+            self._chain_key_memo = None
+            return None
         import hashlib
 
         from .physical import _op_identity
@@ -193,6 +201,15 @@ class UDFOperator(LogicalOperator):
         instrumented re-run costs one python pass over the sample."""
         memo = getattr(self, "_branch_prof_memo", None)
         if memo is None:
+            from ..compiler.analyzer import op_analysis
+
+            rep = op_analysis(self)
+            if rep is not None and not rep.deterministic:
+                # purity gate: a nondeterministic UDF's sample run is not
+                # representative of execution — pruning arms it happened
+                # not to take would bounce live rows to the interpreter
+                self._branch_prof_memo = {}
+                return {}
             ck = self.chain_key()
             hit = _cross_job_branchprofs.get(ck) if ck is not None else None
             if hit is not None:

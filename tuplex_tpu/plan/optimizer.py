@@ -58,6 +58,7 @@ def _param_leaks(tree: ast.AST, p: str) -> bool:
     class V(ast.NodeVisitor):
         def __init__(self):
             self.leak = False
+            self.root = tree   # the UDF's own lambda/def binds p by design
 
         def visit_Subscript(self, node: ast.Subscript):
             if isinstance(node.value, ast.Name) and node.value.id == p and \
@@ -70,6 +71,32 @@ def _param_leaks(tree: ast.AST, p: str) -> bool:
         def visit_Name(self, node: ast.Name):
             if node.id == p:
                 self.leak = True
+
+        def _nested_scope(self, node):
+            # a nested lambda/def whose own parameter SHADOWS the row param
+            # creates a new binding: subscripts inside it are not row reads,
+            # but the walk in _param_subscript_reads can't tell them apart —
+            # treat the whole UDF as reading the full row (ast.arg is not a
+            # Name, so visit_Name alone never sees the shadowing)
+            if node is self.root:
+                self.generic_visit(node)
+                return node
+            from ..compiler.analyzer import _all_params
+
+            if p in _all_params(node):
+                self.leak = True
+                return node
+            self.generic_visit(node)
+            return node
+
+        def visit_Lambda(self, node: ast.Lambda):
+            return self._nested_scope(node)
+
+        def visit_FunctionDef(self, node):
+            return self._nested_scope(node)
+
+        def visit_AsyncFunctionDef(self, node):
+            return self._nested_scope(node)
 
     v = V()
     v.visit(tree)

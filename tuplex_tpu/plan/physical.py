@@ -46,6 +46,7 @@ class TransformStage:
         self.output_columns = last.columns()
 
     force_interpret = False   # set on segments around non-compilable ops
+    route_reason = ""         # why force_interpret was set (analyzer verdict)
     fold_op = None            # AggregateOperator whose pattern fold is fused
                               # into this stage's device fn (plan_stages)
     speculate_branches = True  # prune if/else arms the sample never took
@@ -60,6 +61,43 @@ class TransformStage:
         when no resolver exists (ResolveTask only runs for resolution)."""
         return any(isinstance(op, (L.ResolveOperator, L.IgnoreOperator))
                    for op in self.ops)
+
+    def udf_reports(self) -> list:
+        """Static-analysis reports for every UDF fused in this stage:
+        [(op, udf attr, UDFReport)] (compiler/analyzer.py). Memoized — the
+        per-UDF analysis itself is memoized per code object, so this is the
+        stage-level view physical planning and explain(lint=True) share."""
+        memo = getattr(self, "_udf_reports_memo", None)
+        if memo is None:
+            from ..compiler.analyzer import op_reports
+
+            memo = self._udf_reports_memo = [
+                (op, attr, rep)
+                for op in self.ops
+                for attr, rep in op_reports(op)]
+        return memo
+
+    def possible_exception_codes(self) -> list:
+        """Every ExceptionCode rows of this stage can carry, known at PLAN
+        time from the analyzer's exception-site inventory (no sampling):
+        per-UDF sites, decode codes for fused decodes, PYTHON_FALLBACK when
+        any part of the stage routes to the interpreter."""
+        from ..core.errors import ExceptionCode as EC
+
+        codes: set = set()
+        if self.force_interpret:
+            codes.add(EC.PYTHON_FALLBACK)
+        for op in self.ops:
+            if isinstance(op, L.DecodeOperator):
+                codes |= {EC.NULLERROR, EC.BADPARSE_STRING_INPUT,
+                          EC.NORMALCASEVIOLATION}
+        for op, attr, rep in self.udf_reports():
+            if isinstance(op, (L.ResolveOperator, L.IgnoreOperator)):
+                continue   # slow-path-only UDFs never emit device codes
+            codes |= rep.exception_codes()
+            if rep.must_fallback:
+                codes.add(EC.PYTHON_FALLBACK)
+        return sorted(codes)
 
     def python_pipeline(self, input_names: Optional[tuple] = None):
         """Cached per-stage compiled Python fallback pipeline (reference:
@@ -133,7 +171,16 @@ class TransformStage:
         out_schema = self.output_schema
 
         if self.force_interpret:
-            raise NotCompilable("stage segment forced to interpreter")
+            raise NotCompilable(self.route_reason
+                                or "stage segment forced to interpreter")
+        from ..compiler.stagefn import require_traceable
+
+        # plan-time traceability verdict (compiler/analyzer.py): raise
+        # BEFORE any emitter work for UDFs statically known untraceable.
+        # The general tier never speculates, so cold-arm findings that
+        # branch pruning might hide on the fast path count against it.
+        require_traceable(ops,
+                          speculate=self.speculate_branches and not general)
         if general and not any(
                 isinstance(op, L.DecodeOperator) and op.general is not None
                 for op in ops):
@@ -787,6 +834,14 @@ def op_compiles(op: L.LogicalOperator, input_schema: T.RowType,
     carries the same profile signature the jit cache does."""
     if isinstance(op, (L.ResolveOperator, L.IgnoreOperator, L.TakeOperator)):
         return True
+    from ..compiler import analyzer as _az
+
+    rep = _az.op_analysis(op)
+    if rep is not None and rep.must_fallback_now(speculate):
+        # statically untraceable: route to the interpreter pipeline at PLAN
+        # time — the emitter is never invoked, not even as a probe
+        _az.STATS["plan_fallback_ops"] += 1
+        return False
     ck = (_op_identity(op), input_schema.name,
           _branch_profile_sig(op) if speculate else None)
     hit = _op_compiles_cache.get(ck)
@@ -1011,6 +1066,19 @@ def segment_stage(stage: TransformStage) -> list:
                                  input_schema=schemas_before[start],
                                  input_op=ops_run[0])
         seg.force_interpret = bad
+        if bad:
+            from ..compiler.analyzer import op_analysis
+
+            reasons = []
+            for op in ops_run:
+                rep = op_analysis(op)
+                f = rep.routing_finding(stage.speculate_branches) \
+                    if rep is not None else None
+                if f is not None:
+                    reasons.append(f"{rep.name}: {f.reason} ({rep.loc(f)})")
+            if reasons:
+                seg.route_reason = "plan-time fallback — " + \
+                    "; ".join(reasons)
         seg.speculate_branches = stage.speculate_branches
         segments.append(seg)
     segments[-1].limit = stage.limit

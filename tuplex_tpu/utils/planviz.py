@@ -49,10 +49,12 @@ def plan_to_dot(sink) -> str:
     return "\n".join(lines)
 
 
-def explain(sink, options=None) -> str:
+def explain(sink, options=None, lint: bool = False) -> str:
     """Human-readable physical plan: stages, fused operators, and (when
     tuplex.optimizer.codeStats is on) per-stage jaxpr equation counts —
-    the reference logs the same shape at LocalBackend.cc:932-949."""
+    the reference logs the same shape at LocalBackend.cc:932-949.
+    `lint=True` appends each stage's UDF static-analysis reports and
+    possible row error codes (compiler/analyzer.py)."""
     from ..plan.physical import plan_stages
 
     stages = plan_stages(sink, options)
@@ -66,6 +68,9 @@ def explain(sink, options=None) -> str:
         if getattr(st, "force_interpret", False):
             head += " (interpreter segment)"
         out.append(head)
+        reason = getattr(st, "route_reason", "")
+        if reason:
+            out.append(f"  routed: {reason}")
         src = getattr(st, "source", None)
         if src is not None:
             out.append(f"  source: {type(src).__name__.replace('Operator', '')}")
@@ -75,6 +80,19 @@ def explain(sink, options=None) -> str:
             n = stage_eqn_count(st)
             if n is not None:
                 out.append(f"  codegen: {n} jaxpr equations (fast path)")
+        if lint and hasattr(st, "udf_reports"):
+            reports = st.udf_reports()
+            if reports:
+                out.append("  lint:")
+                for op, attr, rep in reports:
+                    lines = rep.format(indent="    ")
+                    if attr != "udf":
+                        lines[0] = f"{lines[0]} [{attr}]"
+                    out.extend(lines)
+            codes = st.possible_exception_codes()
+            if codes:
+                out.append("  possible row error codes: "
+                           + ", ".join(c.name for c in codes))
     return "\n".join(out)
 
 

@@ -37,13 +37,34 @@ class UDFSource:
         return [a.arg for a in args.args]
 
 
+def _strip_qualname_consts(consts: tuple) -> tuple:
+    """Mask the qualname string const that (on py<=3.10) follows each nested
+    code-object const: it encodes the DEFINING scope ('outer.<locals>.
+    <lambda>...'), so a candidate compiled in isolation can never match a
+    live lambda that nests another lambda inside a function."""
+    import sys
+
+    if sys.version_info >= (3, 11):   # qualname lives on the code object
+        return consts
+    out = []
+    prev_code = False
+    for c in consts:
+        if prev_code and isinstance(c, str):
+            out.append("<qualname>")
+            prev_code = False
+            continue
+        prev_code = isinstance(c, types.CodeType)
+        out.append(c)
+    return tuple(out)
+
+
 def _code_fingerprint(code: types.CodeType) -> tuple:
     """Location-independent fingerprint of a code object (bytecode + const
     structure), so identical-looking lambdas at different columns differ only
     if their bodies differ."""
     consts = tuple(
         _code_fingerprint(c) if isinstance(c, types.CodeType) else c
-        for c in code.co_consts
+        for c in _strip_qualname_consts(code.co_consts)
     )
     return (code.co_code, consts, code.co_names, code.co_varnames[: code.co_argcount])
 
@@ -56,7 +77,7 @@ def _loose_fingerprint(code: types.CodeType) -> tuple:
     consts: set = set()
 
     def walk(c: types.CodeType):
-        for k in c.co_consts:
+        for k in _strip_qualname_consts(c.co_consts):
             if isinstance(k, types.CodeType):
                 names.update(k.co_names)
                 names.update(k.co_freevars)
