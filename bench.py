@@ -157,6 +157,24 @@ def _timed(fn) -> float:
     return time.perf_counter() - t0
 
 
+def _vs_llvm(rate: float):
+    """Speedup vs the reference LLVM engine's rows/s on the same pipeline
+    (scripts/llvm_baseline.py records the denominator — measured where the
+    reference engine is installed, else an explicitly-labeled estimate —
+    into BASELINE_LLVM.json). (None, "") when no denominator is recorded."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE_LLVM.json")
+    try:
+        with open(path) as fp:
+            d = json.load(fp)
+        base = float(d["zillow_rows_per_sec"])
+        if base > 0:
+            return round(rate / base, 3), d.get("kind", "unknown")
+    except (OSError, KeyError, ValueError, TypeError):
+        pass
+    return None, ""
+
+
 def child() -> None:
     platform = os.environ["TPX_BENCH_PLATFORM"]
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -194,7 +212,15 @@ def child() -> None:
     # both sides see the same machine state; best-of-N per side.
     from tuplex_tpu.runtime import xferstats
 
-    ctx = tuplex_tpu.Context()
+    conf = {}
+    spec_env = os.environ.get("BENCH_SPECULATE")
+    spec_on = spec_env is not None and spec_env not in ("0", "false")
+    if spec_env is not None:
+        # A/B flag for the branch-speculation measurement (STATUS round 7):
+        # BENCH_SPECULATE=0 re-runs the same bench with sample-driven
+        # dead-branch pruning off so the kernel delta is one env var away
+        conf["tuplex.optimizer.speculateBranches"] = spec_on
+    ctx = tuplex_tpu.Context(conf)
     got = None
     times = []
     d2h_per_run = []
@@ -226,20 +252,34 @@ def child() -> None:
               file=sys.stderr)
 
     fast_s = ctx.metrics.fastPathWallTime()
+    vs_llvm, llvm_kind = _vs_llvm(rate)
     result = {
         "metric": "zillow_z1_rows_per_sec",
         "value": round(rate, 1),
         "unit": "rows/s",
         "vs_baseline": round(rate / base_rate, 3),
+        # vs the reference LLVM engine's measured-or-estimated rows/s
+        # (scripts/llvm_baseline.py -> BASELINE_LLVM.json); null until a
+        # denominator is recorded, and the kind says whether it was a real
+        # measurement or a labeled estimate
+        "vs_llvm": vs_llvm,
+        "vs_llvm_kind": llvm_kind,
         "platform": actual,
         "d2h_bytes": int(d2h_bytes),
         "n_trials": len(times),
         "spread": round(spread, 3),
+        # compile pipeline: total stage-executable compile seconds across
+        # the whole child (first run pays it, steady-state runs are free;
+        # 0.0 with a warm AOT artifact cache) + actual XLA compile count
+        "compile_s": round(ctx.metrics.compileTime(), 3),
+        "stage_compiles": ctx.metrics.stageCompileCount(),
         # plan-time static-analysis cost + how many operators the analyzer
         # routed to the interpreter without ever invoking the emitter
         "analyzer_ms": round(ctx.metrics.analyzerTimeMs(), 3),
         "plan_fallback_ops": ctx.metrics.planFallbackOps(),
     }
+    if spec_env is not None:
+        result["speculate_branches"] = spec_on
     # extra context on stderr (driver only parses stdout JSON line)
     print(json.dumps({
         "rows": N_ROWS, "best_s": round(best, 3),
@@ -252,6 +292,7 @@ def child() -> None:
         "output_matches_interpreter": ok,
         "fast_path_s": round(fast_s, 3),
         "slow_path_s": round(ctx.metrics.slowPathWallTime(), 3),
+        "compile_s": round(ctx.metrics.compileTime(), 3),
     }), file=sys.stderr)
     if fast_s == 0.0:
         # the whole pipeline ran on the interpreter: the number above does

@@ -1,0 +1,321 @@
+"""Compile-pipeline tests: parallel pool, content-addressed AOT reuse,
+isomorphic-stage dedup, cache-key sensitivity, stale-artifact eviction."""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import time
+
+import pytest
+
+import tuplex_tpu
+from tuplex_tpu.exec import compilequeue as CQ
+
+
+# module-level UDFs: reflection needs real source files
+def m1(x):
+    return x * 2 + 1
+
+
+def m2(x):
+    return x - 3
+
+
+def m3(x):
+    return x * x + 7
+
+
+def m4(x):
+    return x + 100
+
+
+def m5(x):
+    return x // 3
+
+
+def m6(x):
+    return x - 50
+
+
+K_A = 5
+K_B = 7
+
+
+def add_a(x):
+    return x + K_A
+
+
+def add_b(x):
+    return x + K_B
+
+
+@pytest.fixture()
+def fresh_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("TUPLEX_AOT_CACHE", str(tmp_path / "aot"))
+    CQ.clear()
+    yield str(tmp_path / "aot")
+    CQ.clear()
+
+
+def _plan_and_first_part(ctx, ds):
+    from tuplex_tpu.api.dataset import _source_partitions
+    from tuplex_tpu.plan.physical import plan_stages
+
+    stages = plan_stages(ds._op, ctx.options_store)
+    parts = _source_partitions(ctx, stages[0])
+    return stages, parts[0]
+
+
+def test_parallel_pool_beats_serial_sum(fresh_cache, monkeypatch):
+    """Acceptance: a cold plan of >=3 stages compiles all stages
+    CONCURRENTLY — wall under 0.6x the serial sum of the individual
+    compile times. Latency is injected into the one expensive call
+    (_compile_lowered) so the assertion measures pool concurrency, not
+    XLA's mood."""
+    real = CQ._compile_lowered
+
+    def slow_compile(lowered):
+        time.sleep(0.35)
+        return real(lowered)
+
+    monkeypatch.setattr(CQ, "_compile_lowered", slow_compile)
+    ctx = tuplex_tpu.Context({"tuplex.tpu.maxStageOps": 2})
+    data = list(range(4096))
+    ds = ctx.parallelize(data).map(m1).map(m2).map(m3) \
+        .map(m4).map(m5).map(m6)
+    stages, first = _plan_and_first_part(ctx, ds)
+    n_transform = sum(1 for s in stages if getattr(s, "ops", None))
+    assert n_transform >= 3
+
+    snap = CQ.snapshot()
+    t0 = time.perf_counter()
+    futs = ctx.backend._precompile_driver(stages, first)
+    assert len(futs) >= 3
+    for f in futs:
+        f.result()
+    wall = time.perf_counter() - t0
+    d = CQ.delta(snap)
+    assert d["stage_compiles"] >= 3
+    serial_sum = d["compile_s"]          # summed per-compile wall seconds
+    assert serial_sum >= 3 * 0.35
+    assert wall < 0.6 * serial_sum, \
+        f"pool wall {wall:.2f}s vs serial sum {serial_sum:.2f}s"
+
+    # ... and execution finds every executable already built: zero compiles
+    snap = CQ.snapshot()
+    out = ds.collect()
+    assert out == [m6(m5(m4(m3(m2(m1(x)))))) for x in data]
+    assert CQ.delta(snap)["stage_compiles"] == 0
+
+
+_CHILD_SCRIPT = """
+import json, sys
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {here!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import tuplex_tpu
+from tuplex_tpu.exec import compilequeue as CQ
+from test_compilequeue import m1, m2, m3, m4
+
+ctx = tuplex_tpu.Context({{"tuplex.tpu.maxStageOps": 2}})
+data = list(range(2000))
+out = ctx.parallelize(data).map(m1).map(m2).map(m3).map(m4).collect()
+print(json.dumps({{"rows": out[:5] + out[-5:], "n": len(out),
+                  "stats": CQ.snapshot(),
+                  "metric_compile_s": ctx.metrics.compileTime(),
+                  "metric_compiles": ctx.metrics.stageCompileCount()}}))
+"""
+
+
+def test_aot_reuse_across_processes(fresh_cache, tmp_path):
+    """Acceptance: a second PROCESS re-running the same pipeline records
+    zero stage compiles — every executable deserializes from the
+    content-addressed artifact store (hit counter proves it)."""
+    script = tmp_path / "pipe_child.py"
+    script.write_text(_CHILD_SCRIPT.format(
+        repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        here=os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["TUPLEX_AOT_CACHE"] = fresh_cache
+    env.pop("JAX_PLATFORMS", None)
+
+    def run():
+        r = subprocess.run([sys.executable, str(script)],
+                           capture_output=True, text=True, env=env,
+                           timeout=300)
+        assert r.returncode == 0, r.stderr[-2000:]
+        return json.loads(r.stdout.splitlines()[-1])
+
+    first = run()
+    assert first["stats"]["stage_compiles"] >= 2      # cold: real compiles
+    assert first["metric_compile_s"] > 0              # surfaced in metrics
+    second = run()
+    assert second["stats"]["stage_compiles"] == 0, second["stats"]
+    assert second["stats"]["aot_hits"] >= first["stats"]["stage_compiles"]
+    assert second["metric_compiles"] == 0
+    assert second["rows"] == first["rows"] and second["n"] == first["n"]
+
+
+def test_fingerprint_salt_and_donation_sensitivity(fresh_cache):
+    """The cache key must move with anything that changes what the
+    executable MEANS: donation spec, packing flag, mesh epoch salt."""
+    import jax
+    import numpy as np
+
+    def fn(d):
+        return {"y": d["x"] * 2}
+
+    avals = ({"x": jax.ShapeDtypeStruct((64,), np.int64)},)
+    base = CQ.fingerprint_fn(fn, avals)
+    assert base == CQ.fingerprint_fn(fn, avals)             # deterministic
+    assert base != CQ.fingerprint_fn(fn, avals, donate_argnums=(0,))
+    assert base != CQ.fingerprint_fn(fn, avals, salt="pack")
+    assert base != CQ.fingerprint_fn(fn, avals, salt="/mesh1x8")
+    assert CQ.fingerprint_fn(fn, avals, salt="/mesh1x8") != \
+        CQ.fingerprint_fn(fn, avals, salt="/mesh2x8")       # epoch bump
+    # different input avals: different executable
+    avals2 = ({"x": jax.ShapeDtypeStruct((128,), np.int64)},)
+    assert base != CQ.fingerprint_fn(fn, avals2)
+
+    # the OUTPUT pytree is part of the contract: same computation under a
+    # different output key must not share (the stored out_tree would
+    # replay the wrong column names)
+    def fn_renamed(d):
+        return {"z": d["x"] * 2}
+
+    assert base != CQ.fingerprint_fn(fn_renamed, avals)
+
+
+def test_fingerprint_const_value_sensitivity(fresh_cache, ctx):
+    """Two stages identical in STRUCTURE but with different captured
+    constant values must not share an executable; identical pipelines over
+    different data of the same schema must."""
+    from tuplex_tpu.plan.physical import plan_stages, stage_fingerprint
+
+    def fp(ds):
+        stages = plan_stages(ds._op, ctx.options_store)
+        [st] = [s for s in stages if getattr(s, "ops", None)]
+        return stage_fingerprint(st)
+
+    fa = fp(ctx.parallelize(list(range(100))).map(add_a))
+    fb = fp(ctx.parallelize(list(range(100))).map(add_b))
+    fa2 = fp(ctx.parallelize(list(range(200, 300))).map(add_a))
+    assert fa is not None and fb is not None
+    assert fa != fb                       # K_A vs K_B: different kernels
+    assert fa == fa2                      # isomorphic: same executable
+
+
+def test_isomorphic_stages_share_one_executable(fresh_cache):
+    """In-process dedup: an isomorphic pipeline in a SECOND context (own
+    backend, own jit cache — only the process-wide content-addressed store
+    is shared) compiles nothing and records a dedup hit."""
+    ctx_a = tuplex_tpu.Context()
+    ctx_b = tuplex_tpu.Context()
+    a = ctx_a.parallelize(list(range(5000))).map(m1).map(m2)
+    b = ctx_b.parallelize(list(range(7000, 12000))).map(m1).map(m2)
+    snap = CQ.snapshot()
+    out_a = a.collect()
+    d1 = CQ.delta(snap)
+    snap = CQ.snapshot()
+    out_b = b.collect()
+    d2 = CQ.delta(snap)
+    assert out_a == [m2(m1(x)) for x in range(5000)]
+    assert out_b == [m2(m1(x)) for x in range(7000, 12000)]
+    assert d1["stage_compiles"] >= 1      # cold first pipeline compiled...
+    assert d2["stage_compiles"] == 0      # ...the clone reuses it
+    assert d2["dedup_hits"] >= 1
+
+
+def test_compile_deadline_and_negative_cache(fresh_cache, monkeypatch):
+    """Opt-in compile deadline: a compile that exceeds it raises
+    CompileTimeout (the dispatch ladder then interprets the stage), writes
+    a content-addressed marker, and every later attempt — including a
+    fresh in-process store, i.e. what a new process would see — skips
+    instantly instead of re-burning the deadline."""
+    import jax
+    import numpy as np
+
+    real = CQ._compile_lowered
+
+    def slow_compile(lowered):
+        time.sleep(1.2)
+        return real(lowered)
+
+    monkeypatch.setattr(CQ, "_compile_lowered", slow_compile)
+
+    def fn(d):
+        return {"y": d["x"] * 11}
+
+    avals = ({"x": jax.ShapeDtypeStruct((32,), np.int64)},)
+    with pytest.raises(CQ.CompileTimeout):
+        CQ.compile_traced(fn, avals, deadline_s=0.2)
+    assert CQ.STATS["deadline_timeouts"] == 1
+    # in-process negative cache: immediate skip, no second wait
+    t0 = time.time()
+    with pytest.raises(CQ.CompileTimeout):
+        CQ.compile_traced(fn, avals, deadline_s=0.2)
+    assert time.time() - t0 < 0.15
+    assert CQ.STATS["deadline_skips"] >= 1
+    # the marker is on DISK: a cleared store (fresh process) still skips
+    CQ._TIMEOUTS.clear()
+    with pytest.raises(CQ.CompileTimeout):
+        CQ.compile_traced(fn, avals, deadline_s=5.0)
+    # ... but once the abandoned compile eventually finishes and lands an
+    # artifact, the artifact WINS over the marker
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            exec_ = CQ.compile_traced(fn, avals, deadline_s=5.0)
+            break
+        except CQ.CompileTimeout:
+            time.sleep(0.2)
+    out = exec_({"x": np.arange(32, dtype=np.int64)})
+    assert int(np.asarray(out["y"])[3]) == 33
+    # no deadline configured (the default): nothing times out
+    def fn2(d):
+        return {"y": d["x"] * 13}
+
+    assert CQ.compile_traced(fn2, avals, deadline_s=0) is not None
+
+
+def test_prune_stale_platform_artifacts(tmp_path):
+    """Eviction: artifacts for another platform or jax version are
+    removed; current-platform artifacts survive."""
+    import jax
+
+    d = tmp_path / "store"
+    d.mkdir()
+
+    def write(name, platform, jaxver, version=CQ._ARTIFACT_VERSION):
+        with open(d / name, "wb") as f:
+            pickle.dump({"meta": {"v": version, "platform": platform,
+                                  "jax": jaxver, "created": 0.0},
+                         "payload": b"", "in_tree": None,
+                         "out_tree": None}, f)
+
+    write("aaaa.aot", "tpu", jax.__version__)              # wrong platform
+    write("bbbb.aot", jax.default_backend(), "0.0.1")      # wrong jax
+    write("cccc.aot", jax.default_backend(), jax.__version__, version=-1)
+    write("dddd.aot", jax.default_backend(), jax.__version__)   # current
+    (d / "junk.aot").write_bytes(b"not a pickle")          # unreadable
+    removed = CQ.prune_stale(str(d))
+    assert removed == 4
+    assert sorted(os.listdir(d)) == ["dddd.aot"]
+
+
+def test_compile_seconds_in_context_metrics(fresh_cache):
+    """Acceptance: per-stage compile_s appears in Context.metrics (and
+    hence the bench JSON, which reads metrics.compileTime())."""
+    ctx = tuplex_tpu.Context()
+    ds = ctx.parallelize(list(range(3000))).map(m3)
+    ds.collect()
+    bd = ctx.metrics.stage_breakdown()
+    assert any("compile_s" in s for s in bd)
+    total = ctx.metrics.compileTime()
+    as_dict = ctx.metrics.as_dict()
+    assert "compile_s" in as_dict and "stage_compiles" in as_dict
+    if ctx.metrics.stageCompileCount():
+        assert total > 0

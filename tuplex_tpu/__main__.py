@@ -7,6 +7,7 @@ TuplexShell, launched by the `tuplex` console entry point). Subcommands:
     python -m tuplex_tpu                  # interactive shell (default)
     python -m tuplex_tpu shell            # same, explicit
     python -m tuplex_tpu lint script.py   # plan-time UDF static analysis
+    python -m tuplex_tpu compilestats script.py   # compile forecast
     python -m tuplex_tpu version          # print the package version
 
 `lint` runs the compiler's static analyzer (compiler/analyzer.py) over every
@@ -14,6 +15,12 @@ UDF the script hands to DataSet methods — purely syntactic, the script is
 never imported or executed — and prints per-UDF fallback, exception-site,
 and purity findings with file:line locations. `--strict` exits non-zero
 when any fallback finding exists.
+
+`compilestats` imports the script with actions stubbed out (no stage
+executes, nothing compiles), plans each action, and prints per-stage op
+counts, predicted compile seconds from the split tuner's measured curve,
+and which stages the content-addressed compile cache would dedup into one
+executable (utils/compilestats.py).
 """
 
 from __future__ import annotations
@@ -33,6 +40,12 @@ def main(argv=None) -> int:
     lint.add_argument("script", help="path to a python pipeline script")
     lint.add_argument("--strict", action="store_true",
                       help="exit non-zero on any fallback finding")
+    cs = sub.add_parser(
+        "compilestats",
+        help="per-stage op counts, predicted compile seconds, dedup groups")
+    cs.add_argument("script", help="path to a python pipeline script")
+    cs.add_argument("--platform", default=None,
+                    help="compile-model platform (default: jax backend)")
     sub.add_parser("version", help="print the package version")
     args = parser.parse_args(argv)
 
@@ -48,6 +61,14 @@ def main(argv=None) -> int:
             return lint_file(args.script, strict=args.strict)
         except OSError as e:
             print(f"lint: {e}", file=sys.stderr)
+            return 2
+    if args.cmd == "compilestats":
+        from .utils.compilestats import main as cs_main
+
+        try:
+            return cs_main(args.script, platform=args.platform)
+        except OSError as e:
+            print(f"compilestats: {e}", file=sys.stderr)
             return 2
     # bare invocation or explicit `shell`
     from .utils.repl import interactive_shell

@@ -299,20 +299,26 @@ class DataSet:
                             isinstance(stage, TransformStage)
                         partitions = _source_partitions(
                             self._context, stage, lazy=lazy)
+                        if si == 0 and not lazy:
+                            # ahead-of-time compile of the WHOLE plan on
+                            # the pool: stage i+1's (predicted-spec)
+                            # compile overlaps stage i's execution
+                            # (exec/compilequeue; remote XLA compiles are
+                            # minutes, not the reference's milliseconds)
+                            pre = getattr(backend, "precompile_plan", None)
+                            if pre is not None:
+                                try:
+                                    pre(stages, partitions)
+                                except Exception:
+                                    pass
                     # device handoff: tell the backend WHO consumes this
                     # stage's output ("stage"/"agg"/"join" — all three
                     # drain device views now; round 5 excluded joins and
                     # aggregates, which made q19/flights round-trip every
                     # boundary through the ~50 MB/s tunnel)
-                    nxt = stages[si + 1] if si + 1 < len(stages) else None
-                    consumer = False
-                    if not getattr(nxt, "force_interpret", False):
-                        if isinstance(nxt, AggregateStage):
-                            consumer = "agg"
-                        elif isinstance(nxt, JoinStage):
-                            consumer = "join"
-                        elif isinstance(nxt, TransformStage):
-                            consumer = "stage"
+                    from ..plan.physical import consumer_kind
+
+                    consumer = consumer_kind(stages, si)
                     kw = {}
                     if output_sink is not None and \
                             si == len(stages) - 1 and \

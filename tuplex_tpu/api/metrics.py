@@ -46,6 +46,20 @@ class Metrics:
     def totalWallTime(self) -> float:
         return sum(float(m.get("wall_s", 0.0)) for m in self.stages)
 
+    def compileTime(self) -> float:
+        """Total stage-executable compile seconds (JobMetrics.h
+        get_compile_time analog). Attributed per stage by the compile
+        queue: inline first-dispatch compiles AND ahead-of-time pool
+        compiles both count; content-addressed cache hits (in-process
+        dedup or cross-process AOT artifacts) cost zero here — a fully
+        warm second run reports 0.0."""
+        return sum(float(m.get("compile_s", 0.0)) for m in self.stages)
+
+    def stageCompileCount(self) -> int:
+        """Number of actual XLA compiles across stages (0 on a warm AOT
+        cache — the cross-process reuse proof)."""
+        return sum(int(m.get("stage_compiles", 0)) for m in self.stages)
+
     def totalRowsOut(self) -> int:
         return sum(int(m.get("rows_out", 0)) for m in self.stages)
 
@@ -96,6 +110,8 @@ class Metrics:
             "general_path_s": self.generalPathWallTime(),
             "slow_path_s": self.slowPathWallTime(),
             "wall_s": self.totalWallTime(),
+            "compile_s": self.compileTime(),
+            "stage_compiles": self.stageCompileCount(),
             "rows_out": self.totalRowsOut(),
             "exception_rows": self.totalExceptionCount,
             "analyzer_ms": self.analyzerTimeMs(),

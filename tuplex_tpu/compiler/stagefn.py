@@ -32,6 +32,78 @@ def require_traceable(ops, speculate: bool = True) -> None:
                 f"({rep.loc(f)})")
 
 
+def partition_avals(part, bucket_mode: str = "q8"):
+    """Abstract (ShapeDtypeStruct) mirror of ``columns.stage_partition``
+    for `part` — the exact avals its dispatch batch will have, computed
+    without copying a byte. Feeds the ahead-of-time compile pool
+    (exec/compilequeue): compiling against these avals means the real
+    dispatch finds its executable already built. None when a leaf has no
+    device layout."""
+    import numpy as np
+
+    from ..runtime import columns as C
+    from ..runtime.jaxcfg import jax
+
+    b = C.bucket_size(part.num_rows, bucket_mode)
+    avals: dict = {}
+    for path, leaf in part.leaves.items():
+        ks = C._leaf_keys(path, leaf)
+        if ks is None:
+            return None                     # host-only ObjectLeaf
+        if not ks:
+            continue                        # NullLeaf: layout-free
+        if isinstance(leaf, C.NumericLeaf):
+            avals[path] = jax.ShapeDtypeStruct((b,), leaf.data.dtype)
+        else:
+            wb = C.bucket_size(max(leaf.width, 1), bucket_mode, minimum=8)
+            avals[path + "#bytes"] = jax.ShapeDtypeStruct((b, wb), np.uint8)
+            avals[path + "#len"] = jax.ShapeDtypeStruct(
+                (b,), leaf.lengths.dtype)
+        if path + "#valid" in ks:
+            avals[path + "#valid"] = jax.ShapeDtypeStruct((b,), np.bool_)
+    avals["#rowvalid"] = jax.ShapeDtypeStruct((b,), np.bool_)
+    avals["#seed"] = jax.ShapeDtypeStruct((), np.uint32)
+    return avals
+
+
+def restage_avals(out_avals: dict, bucket_mode: str = "q8"):
+    """Predicted input avals of the NEXT stage, given this stage's
+    ``jax.eval_shape`` output avals: control keys drop, data keys re-stage
+    at the same batch size (exact when every input row emits one output
+    row — the chain stops at filters/limits upstream), and str widths
+    re-bucket from the TRACE width (partition_from_result_arrays keeps the
+    device array's byte width, so the next staging pads to
+    bucket(trace_width) — predictable without looking at content). None
+    when the layout can't be predicted (compacted outputs, structural
+    markers)."""
+    from ..runtime import columns as C
+    from ..runtime.jaxcfg import jax
+
+    import numpy as np
+
+    if "#rowidx" in out_avals:
+        return None        # compaction: output batch size is data-dependent
+    avals: dict = {}
+    b = None
+    for k, v in out_avals.items():
+        if k.startswith("#"):
+            continue       # '#err'/'#keep'/fold lattice: not re-staged
+        if k.endswith(("#null", "#unit", "#opt")):
+            return None    # structural markers re-stage under other keys
+        if k.endswith("#bytes"):
+            wb = C.bucket_size(max(int(v.shape[1]), 1), bucket_mode,
+                               minimum=8)
+            avals[k] = jax.ShapeDtypeStruct((v.shape[0], wb), v.dtype)
+        else:
+            avals[k] = jax.ShapeDtypeStruct(v.shape, v.dtype)
+        b = int(v.shape[0])
+    if not avals or b is None:
+        return None
+    avals["#rowvalid"] = jax.ShapeDtypeStruct((b,), np.bool_)
+    avals["#seed"] = jax.ShapeDtypeStruct((), np.uint32)
+    return avals
+
+
 def leaf_cv(arrays: dict, path: str, t: T.Type) -> CV:
     """CV view over a staged leaf (see runtime.columns.stage_partition)."""
     base = t.without_option() if t.is_optional() else t

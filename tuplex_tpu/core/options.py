@@ -89,6 +89,23 @@ DEFAULTS: dict[str, str] = {
     "tuplex.tpu.interpretOnly": "false",        # force interpreter (debugging)
     "tuplex.tpu.jitCacheSize": "128",
     "tuplex.tpu.profileDir": "",            # jax.profiler trace per action
+    "tuplex.tpu.compileBudgetS": "480",     # ceiling on a stage's predicted
+                                            # compile seconds: the split
+                                            # tuner (plan/splittuner.py)
+                                            # splits finer or degrades to a
+                                            # host-CPU compile to stay under
+    "tuplex.tpu.compileDeadlineS": "0",     # hard wait ceiling per stage
+                                            # compile; on timeout the stage
+                                            # falls back to the interpreter
+                                            # and a content-addressed marker
+                                            # skips it in later processes.
+                                            # OPT-IN (0=off): abandoning a
+                                            # native compile risks teardown
+                                            # crashes (STATUS r7)
+    "tuplex.tpu.parallelCompile": "true",   # plan-level AOT compile pool
+                                            # (exec/compilequeue.py);
+                                            # TUPLEX_PARALLEL_COMPILE=0 also
+                                            # disables
 }
 
 

@@ -30,6 +30,15 @@ from ..runtime import columns as C
 from .local import ExceptionRecord
 
 
+def _aot(fn):
+    """Content-addressed compile for the scan-fold executables (the agg
+    analog of the stage fns' compilequeue route: identical fold structures
+    across jobs/processes reuse one executable)."""
+    from .compilequeue import aot_jit
+
+    return aot_jit(fn, tag="agg")
+
+
 from ..parallel.collectives import reduce_identity as _identity
 
 
@@ -59,9 +68,13 @@ class AggregateExecutor:
             parts, excs = self._aggregate(op, partitions, by_key=False)
         else:
             raise NotCompilable(f"aggregate stage op {op!r}")
+        from . import compilequeue as _cq
+
+        cs, cn = _cq.consume_tag("agg")
         m = {"wall_s": time.perf_counter() - t0,
              "rows_out": sum(p.num_rows for p in parts),
-             "exception_rows": len(excs)}
+             "exception_rows": len(excs),
+             "compile_s": cs, "stage_compiles": cn}
         return StageResult(parts, excs, m)
 
     # ==================================================================
@@ -203,7 +216,7 @@ class AggregateExecutor:
                 try:
                     fn = self.backend.jit_cache.get_or_build(
                         ("scanfold", op.id, part.schema.name),
-                        lambda: jax.jit(scan.build_fn()))
+                        lambda: _aot(scan.build_fn()))
                     batch = C.stage_partition(part, self.backend.bucket_mode)
                     acc_in = scan.encode_acc(acc_val)
                     outs = jax.device_get(fn(batch.arrays, acc_in))
@@ -250,7 +263,7 @@ class AggregateExecutor:
         try:
             fn = self.backend.jit_cache.get_or_build(
                 ("scanfoldseg", op.id, part.schema.name),
-                lambda: jax.jit(A._seg_build_fn(scan)))
+                lambda: _aot(A._seg_build_fn(scan)))
             batch = C.stage_partition(part, self.backend.bucket_mode)
             b = batch.arrays["#rowvalid"].shape[0]
             codes_b = np.full(b, nseg_b, dtype=np.int32)

@@ -1,0 +1,693 @@
+"""Parallel + ahead-of-time stage compilation with content-addressed reuse.
+
+The reference JITs a stage in milliseconds (TransformStage compile logged in
+LocalBackend.cc:932-949; JobMetrics.h tracks compile seconds) because LLVM
+codegen is local and cheap. Here a stage compile is an XLA compile — minutes
+per stage over the remote TPU tunnel and superlinear in graph size — so the
+compile pipeline itself needs engineering:
+
+  * **trace != compile.** Tracing a stage fn to a jaxpr is milliseconds and
+    pure; compiling the lowering is the expensive part. Every entry point
+    here traces eagerly (cheap, and the canonical jaxpr is the content
+    address) and treats the COMPILE as the cacheable/parallelizable unit.
+  * **content addressing.** The fingerprint is a hash over the canonical
+    jaxpr text, the trace-hoisted constant VALUES, the input avals, the
+    effective platform (incl. the host-ISA tag for XLA:CPU artifacts), the
+    donation spec and caller salts (packing flag, mesh epoch). Two stages
+    that lower to the same jaxpr — flights' isomorphic join-probe segments,
+    re-planned pipelines in a fresh process — share one executable.
+  * **three stores.** (1) an in-process dict fingerprint -> executable (the
+    isomorphic-stage dedup), (2) an on-disk artifact cache of serialized
+    PJRT executables (cross-process AOT reuse: run 2 of a pipeline
+    deserializes instead of compiling), (3) an in-flight table so a pool
+    worker and a foreground dispatch never compile the same fingerprint
+    twice concurrently.
+  * **a compile pool.** Remote TPU compiles are I/O-bound on the tunnel;
+    a small thread pool compiles all of a plan's stages concurrently and
+    overlaps stage i+1's compile with stage i's execution (jax traces are
+    thread-safe; XLA compiles release the GIL).
+
+Everything is best-effort: any failure in the AOT machinery falls back to a
+plain ``jax.jit`` so behavior (including NotCompilable propagation and the
+local backend's trace-failure demotion ladder) is unchanged.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import queue
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeout
+from typing import Any, Optional
+
+import numpy as np
+
+# -- counters ---------------------------------------------------------------
+# stage_compiles: actual lowered.compile() invocations (the expensive event;
+#   the cross-process acceptance test asserts this is ZERO on a warm cache)
+# aot_hits/aot_misses: on-disk artifact lookups
+# dedup_hits: in-process fingerprint hits (isomorphic stages, re-dispatch)
+# compile_s: summed wall seconds spent inside lowered.compile()
+STATS: dict[str, Any] = {
+    "stage_compiles": 0, "compile_s": 0.0,
+    "aot_hits": 0, "aot_misses": 0, "aot_errors": 0,
+    "dedup_hits": 0, "pool_jobs": 0, "traces": 0,
+    "deadline_timeouts": 0, "deadline_skips": 0,
+}
+
+_LOCK = threading.Lock()
+# fingerprint -> jax.stages.Compiled, LRU-bounded (TUPLEX_AOT_MEM_ENTRIES,
+# default 256): an evicted executable's disk artifact remains, so a later
+# request deserializes instead of recompiling — eviction costs a load, not
+# a compile. Keeps a long-lived shell from pinning every executable the
+# process ever built (the backend JitCache is bounded; this must be too).
+_EXECS: "OrderedDict[str, Any]" = OrderedDict()
+_PENDING: dict[str, Future] = {}     # fingerprint -> in-flight compile
+_TAG: dict[str, list] = {}           # tag -> [seconds, count] (unconsumed)
+_POOL: Optional["_DaemonPool"] = None
+
+
+def _mem_capacity() -> int:
+    try:
+        return max(8, int(os.environ.get("TUPLEX_AOT_MEM_ENTRIES", "256")))
+    except ValueError:
+        return 256
+
+
+class CompileTimeout(Exception):
+    """A stage compile exceeded the compile deadline (or a previous run's
+    marker says it did). The caller's first-call failure ladder routes the
+    stage to the interpreter — correct, just slower — instead of wedging
+    the job on a pathological XLA compile (observed: a 3-op / 2.2k-eqn
+    string stage that XLA:CPU chews >20 min and >120 GB on)."""
+
+
+_TIMEOUTS: set = set()               # fingerprints that timed out (process)
+
+
+class _AotUnsupported(Exception):
+    """The AOT plumbing itself is unavailable (e.g. a jax without
+    jit().trace()) — callers fall back to a plain jit; never raised for a
+    genuine trace error, which must propagate like jit's would."""
+
+
+class _DaemonPool:
+    """Minimal thread pool on DAEMON threads. concurrent.futures'
+    ThreadPoolExecutor joins its (non-daemon) workers at interpreter exit,
+    so queued speculative stage compiles — minutes each on the tunnel —
+    would block a finished process from exiting. Speculative work must
+    never outlive the job that asked for it: daemon workers die with the
+    process, and pending queue items are simply dropped."""
+
+    def __init__(self, workers: int):
+        self._q: "queue.Queue" = queue.Queue()
+        for i in range(workers):
+            t = threading.Thread(target=self._run, daemon=True,
+                                 name=f"tpx-compile-{i}")
+            t.start()
+
+    def _run(self) -> None:
+        while True:
+            fut, fn, args, kwargs = self._q.get()
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn(*args, **kwargs))
+            except BaseException as e:  # noqa: BLE001 - future carries it
+                fut.set_exception(e)
+
+    def submit(self, fn, *args, **kwargs) -> Future:
+        fut: Future = Future()
+        self._q.put((fut, fn, args, kwargs))
+        return fut
+
+
+def snapshot() -> dict:
+    with _LOCK:
+        return dict(STATS)
+
+
+def delta(snap: dict) -> dict:
+    with _LOCK:
+        return {k: STATS[k] - snap.get(k, 0) for k in STATS}
+
+
+def consume_tag(tag: str) -> tuple[float, int]:
+    """Take (and reset) the compile seconds + count attributed to `tag`
+    since the last consume — the per-stage ``compile_s`` metric. Pool
+    compiles submitted during an earlier stage's window but tagged for a
+    later stage land on the later stage's record (attribution follows the
+    executable's owner, not the wall-clock window it compiled in)."""
+    with _LOCK:
+        s, n = _TAG.pop(tag, (0.0, 0))
+        return s, n
+
+
+def clear() -> None:
+    """Drop the in-process executable store + counters (tests). Disk
+    artifacts stay unless the cache dir itself is removed."""
+    with _LOCK:
+        _EXECS.clear()
+        _TAG.clear()
+        for k in STATS:
+            STATS[k] = type(STATS[k])()
+
+
+def pool() -> "_DaemonPool":
+    global _POOL
+    with _LOCK:
+        if _POOL is None:
+            _POOL = _DaemonPool(_workers())
+        return _POOL
+
+
+def _workers() -> int:
+    try:
+        return max(1, int(os.environ.get("TUPLEX_COMPILE_WORKERS", "4")))
+    except ValueError:
+        return 4
+
+
+def parallel_compile_enabled() -> bool:
+    """Pool gate (README: parallel-compile env toggle). Remote compiles are
+    I/O-bound on the tunnel, so the default worker count (4) exceeds the
+    core count harmlessly. TUPLEX_PARALLEL_COMPILE=0 disables."""
+    return os.environ.get("TUPLEX_PARALLEL_COMPILE", "1") != "0"
+
+
+# ---------------------------------------------------------------------------
+# fingerprinting
+# ---------------------------------------------------------------------------
+
+def _platform_salt() -> str:
+    from ..runtime.jaxcfg import aot_platform_tag
+
+    return aot_platform_tag()
+
+
+def fingerprint_traced(traced, salt: str = "") -> str:
+    """Content address of a traced stage fn: canonical jaxpr text (variable
+    names are already canonical in jaxpr pretty-printing) + the VALUES of
+    trace-hoisted constants (two stages with identical structure but a
+    different captured lookup table must not share an executable) + input
+    avals + platform/ISA/x64 + caller salt (donation, packing, mesh epoch).
+    """
+    h = hashlib.sha256()
+    cj = traced.jaxpr                      # ClosedJaxpr
+    h.update(str(cj.jaxpr).encode())
+    for c in cj.consts:
+        a = np.asarray(c)                  # device consts: one host fetch
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    for aval in getattr(traced, "in_avals", ()) or ():
+        h.update(repr(aval).encode())
+    # the OUTPUT pytree structure is not in the jaxpr (flat outputs) but
+    # IS part of the executable's contract: two fns computing the same
+    # values under different output dict keys must not share — the stored
+    # out_tree would replay the wrong keys (silently mis-labeled columns)
+    import jax
+
+    out_info = getattr(traced, "out_info", None)
+    if out_info is None:
+        raise _AotUnsupported("traced.out_info unavailable")
+    h.update(repr(jax.tree_util.tree_structure(out_info)).encode())
+    h.update(_platform_salt().encode())
+    h.update(salt.encode())
+    return h.hexdigest()
+
+
+def fingerprint_fn(fn, args: tuple, donate_argnums=(), salt: str = "") -> str:
+    """Fingerprint a python fn against abstract args (compilestats / the
+    isomorphic-dedup report use this without compiling anything)."""
+    import jax
+
+    traced = jax.jit(fn, donate_argnums=tuple(donate_argnums)).trace(*args)
+    return fingerprint_traced(traced, salt=salt + f"/don{tuple(donate_argnums)}")
+
+
+# ---------------------------------------------------------------------------
+# on-disk artifact store
+# ---------------------------------------------------------------------------
+
+_ARTIFACT_VERSION = 1
+
+
+def _artifact_path(fp: str) -> Optional[str]:
+    from ..runtime.jaxcfg import aot_cache_dir
+
+    d = aot_cache_dir()
+    if not d:
+        return None
+    return os.path.join(d, fp + ".aot")
+
+
+def _timeout_marker(fp: str):
+    path = _artifact_path(fp)
+    return None if path is None else path + ".timeout"
+
+
+def _deadline_known_exceeded(fp: str) -> bool:
+    """True when this fingerprint's compile already blew the deadline —
+    in this process or (via the on-disk marker) any earlier one. A later
+    SUCCESSFUL compile wins: the artifact is checked before the marker."""
+    if fp in _TIMEOUTS:
+        return True
+    m = _timeout_marker(fp)
+    return m is not None and os.path.exists(m)
+
+
+def _note_deadline_exceeded(fp: str) -> None:
+    _TIMEOUTS.add(fp)
+    m = _timeout_marker(fp)
+    if m is None:
+        return
+    try:
+        with open(m, "w") as f:
+            f.write(_platform_salt())
+    except OSError:   # pragma: no cover - marker is best-effort
+        pass
+
+
+def _artifact_meta() -> dict:
+    import jax
+
+    return {"v": _ARTIFACT_VERSION, "platform": jax.default_backend(),
+            "jax": jax.__version__, "created": time.time()}
+
+
+def _disk_load(fp: str):
+    """Deserialize an AOT artifact, or None. A mismatched platform/jax
+    version is a miss (prune_stale() reclaims such files)."""
+    path = _artifact_path(fp)
+    if path is None or not os.path.exists(path):
+        return None
+    import jax
+    from jax.experimental import serialize_executable as se
+
+    with open(path, "rb") as f:
+        rec = pickle.load(f)
+    meta = rec.get("meta", {})
+    if meta.get("v") != _ARTIFACT_VERSION \
+            or meta.get("platform") != jax.default_backend() \
+            or meta.get("jax") != jax.__version__:
+        return None
+    return se.deserialize_and_load(rec["payload"], rec["in_tree"],
+                                   rec["out_tree"])
+
+
+def _disk_store(fp: str, compiled) -> None:
+    path = _artifact_path(fp)
+    if path is None:
+        return
+    from jax.experimental import serialize_executable as se
+
+    payload, in_tree, out_tree = se.serialize(compiled)
+    rec = {"meta": _artifact_meta(), "payload": payload,
+           "in_tree": in_tree, "out_tree": out_tree}
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        pickle.dump(rec, f)
+    os.replace(tmp, path)                  # atomic vs concurrent writers
+
+
+def prune_stale(cache_dir: Optional[str] = None) -> int:
+    """Evict artifacts compiled for a different platform or jax version
+    (a CPU artifact is useless — and on a different ISA dangerous — once
+    the effective backend changes; fingerprints already partition them,
+    this reclaims the disk). Returns the number of files removed."""
+    import jax
+
+    from ..runtime.jaxcfg import aot_cache_dir
+
+    d = cache_dir or aot_cache_dir()
+    if not d or not os.path.isdir(d):
+        return 0
+    removed = 0
+    for name in os.listdir(d):
+        if not name.endswith(".aot"):
+            continue
+        path = os.path.join(d, name)
+        try:
+            with open(path, "rb") as f:
+                meta = pickle.load(f).get("meta", {})
+            stale = meta.get("v") != _ARTIFACT_VERSION \
+                or meta.get("platform") != jax.default_backend() \
+                or meta.get("jax") != jax.__version__
+        except Exception:
+            stale = True                   # unreadable artifact: reclaim
+        if stale:
+            try:
+                os.remove(path)
+                removed += 1
+            except OSError:
+                pass
+    return removed
+
+
+# ---------------------------------------------------------------------------
+# the compile core
+# ---------------------------------------------------------------------------
+
+def _compile_lowered(lowered):
+    """The single expensive call — tests inject latency here to prove the
+    pool actually runs compiles concurrently."""
+    return lowered.compile()
+
+
+_CENSOR_INTERVAL_S = 60.0
+
+
+def _compile_with_watchdog(lowered, n_ops: int):
+    """Compile, and while the compile runs feed the split tuner CENSORED
+    lower-bound observations (n_ops, seconds-so-far) every minute. A
+    compile that wedges or is killed mid-flight — the flights 43-op
+    XLA:CPU blowup ran >20 min before being killed — thereby still
+    teaches the model it is expensive; finished compiles are exactly the
+    ones the observation set would otherwise be biased toward."""
+    if n_ops <= 0:
+        return _compile_lowered(lowered)
+    stop = threading.Event()
+    t0 = time.perf_counter()
+
+    def watch():
+        while not stop.wait(_CENSOR_INTERVAL_S):
+            try:
+                from ..plan.splittuner import model_for
+
+                model_for().record_running(
+                    n_ops, time.perf_counter() - t0)
+            except Exception:   # pragma: no cover - model is best-effort
+                return
+
+    t = threading.Thread(target=watch, daemon=True,
+                         name="tpx-compile-watchdog")
+    t.start()
+    try:
+        return _compile_lowered(lowered)
+    finally:
+        stop.set()
+
+
+def _note_compile(tag: str, dt: float, n_ops: int) -> None:
+    with _LOCK:
+        STATS["stage_compiles"] += 1
+        STATS["compile_s"] += dt
+        rec = _TAG.setdefault(tag, [0.0, 0])
+        rec[0] += dt
+        rec[1] += 1
+    if n_ops > 0:
+        try:     # feed the measured point into the stage-split tuner curve
+            from ..plan.splittuner import model_for
+
+            model_for().record_compile(n_ops, dt)
+        except Exception:   # pragma: no cover - the model is best-effort
+            pass
+
+
+def default_deadline_s() -> float:
+    """Hard ceiling on how long a dispatch will WAIT for one executable
+    (tuplex.tpu.compileDeadlineS carries it down from the backend; env
+    TUPLEX_COMPILE_DEADLINE_S for bare aot_jit users). Default 0 = OFF:
+    abandoning a native XLA compile leaves it burning on a daemon thread,
+    which can segfault interpreter teardown, and the interpreter-fallback
+    mix it forces mid-plan diverged on flights (observed; see STATUS r7) —
+    so the deadline is an explicit opt-in until compiles can be abandoned
+    in a subprocess."""
+    try:
+        return float(os.environ.get("TUPLEX_COMPILE_DEADLINE_S", "0"))
+    except ValueError:
+        return 0.0
+
+
+def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
+                   tag: str = "", n_ops: int = 0,
+                   deadline_s: Optional[float] = None):
+    """Trace `fn` against `args` (avals or concrete arrays) and return a
+    compiled executable for it, via — in order — the in-process fingerprint
+    store, the on-disk AOT artifact cache, or an actual XLA compile (counted,
+    timed, tuner-fed, persisted to disk).
+
+    Trace-time exceptions (NotCompilable, emitter rejections) propagate to
+    the caller exactly as they would from ``jax.jit(fn)(args)`` — the local
+    backend's first-call demotion ladder depends on that.
+    """
+    import jax
+
+    from ..runtime.jaxcfg import aot_cache_enabled
+
+    if deadline_s is None:
+        deadline_s = default_deadline_s()
+    donate = tuple(donate_argnums)
+    jfn = jax.jit(fn, donate_argnums=donate)
+    trace_m = getattr(jfn, "trace", None)
+    if trace_m is None:     # jax without the AOT .trace() entry point
+        raise _AotUnsupported("jax.jit(...).trace unavailable")
+    # errors OUT of the trace itself (NotCompilable, emitter rejections)
+    # propagate exactly as they would from jax.jit(fn)(*args) — the local
+    # backend's first-call demotion ladder depends on that
+    traced = trace_m(*args)
+    with _LOCK:
+        STATS["traces"] += 1
+    try:
+        fp = fingerprint_traced(traced, salt=salt + f"/don{donate}")
+    except Exception:
+        # content addressing unavailable for this trace (e.g. a const
+        # that can't be fetched/hashed): compile without caching — still
+        # counted and timed, never a behavior change
+        t0 = time.perf_counter()
+        compiled = _compile_with_watchdog(traced.lower(), n_ops)
+        _note_compile(tag, time.perf_counter() - t0, n_ops)
+        return compiled
+
+    while True:
+        with _LOCK:
+            cached = _EXECS.get(fp)
+            if cached is not None:
+                _EXECS.move_to_end(fp)
+                STATS["dedup_hits"] += 1
+                return cached
+            fut = _PENDING.get(fp)
+            if fut is None:
+                fut = Future()
+                _PENDING[fp] = fut
+                break
+        try:            # someone else is compiling this very fingerprint
+            return fut.result(timeout=deadline_s if deadline_s else None)
+        except FutureTimeout:
+            raise CompileTimeout(
+                f"waited {deadline_s:.0f}s on an in-flight compile "
+                f"({fp[:12]}…)") from None
+        except Exception:
+            continue    # their attempt failed; try to own it ourselves
+
+    def _publish(compiled):
+        """Store a finished executable process-wide (+ disk happened in
+        the job). Runs even when the waiting dispatch already gave up —
+        a post-deadline completion still serves every later request."""
+        with _LOCK:
+            _EXECS[fp] = compiled
+            _EXECS.move_to_end(fp)
+            while len(_EXECS) > _mem_capacity():
+                _EXECS.popitem(last=False)   # disk artifact remains
+        return compiled
+
+    def _compile_job():
+        t0 = time.perf_counter()
+        compiled = _compile_with_watchdog(traced.lower(), n_ops)
+        _note_compile(tag, time.perf_counter() - t0, n_ops)
+        if aot_cache_enabled():
+            try:
+                _disk_store(fp, compiled)
+            except Exception:   # pragma: no cover - disk best-effort
+                with _LOCK:
+                    STATS["aot_errors"] += 1
+        return _publish(compiled)
+
+    try:
+        compiled = None
+        if aot_cache_enabled():
+            try:
+                compiled = _disk_load(fp)
+            except Exception:
+                compiled = None
+                with _LOCK:
+                    STATS["aot_errors"] += 1
+            with _LOCK:
+                STATS["aot_hits" if compiled is not None
+                      else "aot_misses"] += 1
+            if compiled is not None:
+                _publish(compiled)
+        if compiled is None and deadline_s and deadline_s > 0 \
+                and _deadline_known_exceeded(fp):
+            # negative cache: this fingerprint's compile blew the deadline
+            # before (this process or an earlier one's on-disk marker) and
+            # no artifact ever appeared — route to the interpreter NOW
+            # instead of re-burning the deadline every process. Gated on
+            # the deadline being ENABLED: a run with the default (off)
+            # config must compile normally — a stale marker from an
+            # opted-in run must not force the interpreter on runs that
+            # never opted in, and a successful unbounded compile then
+            # lands the artifact that overrides the marker for everyone.
+            with _LOCK:
+                STATS["deadline_skips"] += 1
+            raise CompileTimeout(
+                f"compile of {fp[:12]}… previously exceeded the deadline")
+        if compiled is None:
+            if deadline_s and deadline_s > 0:
+                # dedicated daemon thread (NOT the pool: a pool worker
+                # waiting on a nested pool job can deadlock the pool) so
+                # a pathological XLA compile can be abandoned — it keeps
+                # burning in background and publishes if it ever finishes,
+                # but the job moves on (interpreter) at the deadline
+                cfut: Future = Future()
+
+                def _runner():
+                    try:
+                        cfut.set_result(_compile_job())
+                    except BaseException as e:  # noqa: BLE001
+                        cfut.set_exception(e)
+
+                threading.Thread(target=_runner, daemon=True,
+                                 name="tpx-compile-deadline").start()
+                try:
+                    compiled = cfut.result(timeout=deadline_s)
+                except FutureTimeout:
+                    _note_deadline_exceeded(fp)
+                    with _LOCK:
+                        STATS["deadline_timeouts"] += 1
+                    raise CompileTimeout(
+                        f"stage compile exceeded the {deadline_s:.0f}s "
+                        f"deadline ({fp[:12]}…); falling back") from None
+            else:
+                compiled = _compile_job()
+        with _LOCK:
+            _PENDING.pop(fp, None)
+        fut.set_result(compiled)
+        return compiled
+    except BaseException as e:
+        with _LOCK:
+            _PENDING.pop(fp, None)
+        fut.set_exception(e)
+        raise
+
+
+def submit_compile(fn, args: tuple, donate_argnums=(), salt: str = "",
+                   tag: str = "", n_ops: int = 0,
+                   deadline_s=None) -> Future:
+    """Queue a compile on the pool (ahead-of-time / overlapped with
+    execution). Foreground dispatches of the same fingerprint join the
+    in-flight future instead of compiling again."""
+    with _LOCK:
+        STATS["pool_jobs"] += 1
+    return pool().submit(compile_traced, fn, args,
+                         donate_argnums=donate_argnums, salt=salt,
+                         tag=tag, n_ops=n_ops, deadline_s=deadline_s)
+
+
+# ---------------------------------------------------------------------------
+# the jit-compatible wrapper
+# ---------------------------------------------------------------------------
+
+def _leaf_aval(x):
+    import jax
+
+    return jax.ShapeDtypeStruct(np.shape(x), x.dtype)
+
+
+def _args_avals(args: tuple):
+    """Abstract (ShapeDtypeStruct) mirror of concrete call args, or None
+    when a leaf has no array protocol (python scalar etc.) — such calls
+    use the plain-jit fallback, whose weak-type semantics differ."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    if any(not hasattr(l, "dtype") for l in leaves):
+        return None, None
+    avals = jax.tree_util.tree_unflatten(
+        treedef, [_leaf_aval(l) for l in leaves])
+    key = (treedef, tuple((np.shape(l), str(l.dtype)) for l in leaves))
+    return avals, key
+
+
+_FALLBACK = object()
+
+
+class AotJit:
+    """Drop-in for ``jax.jit(fn)`` that routes per-input-spec compilation
+    through the content-addressed store: dispatch never compiles an
+    executable another stage (or another process) already built. Falls back
+    to a plain jit on any AOT-machinery failure."""
+
+    def __init__(self, fn, donate: bool = False, salt: str = "",
+                 tag: str = "", n_ops: int = 0, deadline=None):
+        self._fn = fn
+        self._donate = (0,) if donate else ()
+        self._salt = salt
+        self._tag = tag
+        self._n_ops = n_ops
+        self._deadline = deadline
+        self._by_spec: dict = {}
+        self._jit = None
+
+    def _plain(self):
+        if self._jit is None:
+            import jax
+
+            self._jit = jax.jit(self._fn, donate_argnums=self._donate)
+        return self._jit
+
+    def __call__(self, *args):
+        entry = None
+        key = None
+        try:
+            avals, key = self._args_key(args)
+        except Exception:
+            avals = None
+        if avals is not None:
+            entry = self._by_spec.get(key)
+            if entry is None:
+                # trace-time errors must escape like jit's would; only the
+                # compile/AOT plumbing itself may fall back
+                try:
+                    entry = compile_traced(
+                        self._fn, avals, donate_argnums=self._donate,
+                        salt=self._salt, tag=self._tag, n_ops=self._n_ops,
+                        deadline_s=self._deadline)
+                except _AotUnsupported:
+                    entry = None
+                self._by_spec[key] = entry if entry is not None else _FALLBACK
+        if entry in (None, _FALLBACK):
+            return self._plain()(*args)
+        try:
+            return entry(*args)
+        except TypeError:
+            # call-convention mismatch (aval/weak-type drift): pin this
+            # spec to the plain jit, which retraces with jit's own rules
+            self._by_spec[key] = _FALLBACK
+            return self._plain()(*args)
+
+    def _args_key(self, args):
+        avals, key = _args_avals(args)
+        return avals, key
+
+
+def aot_jit(fn, donate: bool = False, salt: str = "", tag: str = "",
+            n_ops: int = 0, deadline=None):
+    """The AOT-routed drop-in for ``jax.jit(fn)``; cached by the backend's
+    JitCache exactly like a jit. Always the wrapper — disabling the disk
+    cache (TUPLEX_AOT_CACHE=0) or the pool only turns those legs off,
+    while compile counting, the in-process dedup store and the opt-in
+    deadline keep working. TUPLEX_AOT_JIT=0 is the debugging escape hatch
+    back to a bare jit (which silently drops all of the above)."""
+    if os.environ.get("TUPLEX_AOT_JIT", "1") == "0":
+        import jax
+
+        return jax.jit(fn, donate_argnums=(0,) if donate else ())
+    return AotJit(fn, donate=donate, salt=salt, tag=tag, n_ops=n_ops,
+                  deadline=deadline)

@@ -93,9 +93,13 @@ class JoinExecutor:
                                              excs)
             self.backend.mm.register(outp)
             out_parts.append(outp)
+        from . import compilequeue as _cq
+
+        cs, cn = _cq.consume_tag("join")
         m = {"wall_s": time.perf_counter() - t0,
              "rows_out": sum(p.num_rows for p in out_parts),
-             "exception_rows": len(excs)}
+             "exception_rows": len(excs),
+             "compile_s": cs, "stage_compiles": cn}
         return StageResult(out_parts, excs, m)
 
     # ------------------------------------------------------------------
@@ -681,15 +685,21 @@ def _build_probe_fn(u: int, nw: int, mesh=None):
     lower_bound = lower_bound_direct if direct else lower_bound_search
 
     if mesh is None:
-        return jax.jit(lower_bound)
-    from jax import shard_map
-    from jax.sharding import PartitionSpec as P
-    from ..parallel.mesh import DATA_AXIS
+        # content-addressed compile (exec/compilequeue): flights' probe
+        # stages are isomorphic up to the build table — which is an
+        # ARGUMENT here, so equal (u, nw) probes share one executable
+        # in-process and reuse the serialized artifact across processes
+        from .compilequeue import aot_jit
 
-    fn = shard_map(lower_bound, mesh=mesh,
-                   in_specs=(P(DATA_AXIS), P()),
-                   out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
-                   check_vma=False)
+        return aot_jit(lower_bound, tag="join")
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+    from ..runtime.jaxcfg import shard_map_compat
+
+    fn = shard_map_compat(lower_bound, mesh,
+                          (P(DATA_AXIS), P()),
+                          (P(DATA_AXIS), P(DATA_AXIS)))
     return jax.jit(fn)
 
 
@@ -741,7 +751,9 @@ def _build_assemble_fn(pairs: tuple, left_join: bool):
             out[outkey] = g
         return out
 
-    return jax.jit(fn)
+    from .compilequeue import aot_jit
+
+    return aot_jit(fn, salt=f"assemble{int(left_join)}", tag="join")
 
 
 def _build_gather_fn(lkeys: tuple, rkeys: tuple, left_join: bool):
@@ -765,7 +777,9 @@ def _build_gather_fn(lkeys: tuple, rkeys: tuple, left_join: bool):
             out[k] = g
         return out
 
-    return jax.jit(gather)
+    from .compilequeue import aot_jit
+
+    return aot_jit(gather, salt=f"gather{int(left_join)}", tag="join")
 
 
 class _DeviceProbe(_VectorBuild):

@@ -163,6 +163,9 @@ class LocalBackend:
         # stages whose sample-estimated compaction bucket overflowed: re-run
         # and remember to build without compaction from then on
         self._compaction_off: set[str] = set()
+        # stages whose per-boundary dispatch cost was already sampled into
+        # the split-tuner model (one clean sample per stage)
+        self._boundary_sampled: set[str] = set()
         from ..runtime.spill import MemoryManager
 
         self.mm = MemoryManager(
@@ -181,7 +184,8 @@ class LocalBackend:
     def touch_partition(self, part) -> None:
         self.mm.touch(part)
 
-    def _jit_stage_fn(self, raw_fn, packed: bool = True):
+    def _jit_stage_fn(self, raw_fn, packed: bool = True, tag: str = "",
+                      n_ops: int = 0):
         """Compile a stage fn for dispatch (overridden by MultiHostBackend
         to row-shard over a mesh). Input buffers are donated off-CPU: the
         staged batch is dead once the kernel reads it (consumers re-stage
@@ -191,21 +195,119 @@ class LocalBackend:
 
         packed=False keeps per-leaf dict outputs — required where a
         consumer needs device-resident arrays (the intermediate-stage
-        handoff, _attach_device_view)."""
-        import jax
+        handoff, _attach_device_view).
 
+        Per-spec compilation routes through exec/compilequeue: the
+        content-addressed store dedups isomorphic stages in-process and
+        reuses serialized executables across processes; `tag` attributes
+        compile seconds to the owning stage (metrics 'compile_s') and
+        `n_ops` feeds the stage-split tuner's measured curve."""
         from ..runtime.jaxcfg import donation_enabled
         from ..runtime.packing import PackedStageFn, packing_enabled
+        from .compilequeue import aot_jit
 
         donate = donation_enabled() and self.options.get_bool(
             "tuplex.tpu.donateBuffers", True)
+        deadline = self.options.get_float("tuplex.tpu.compileDeadlineS", 0.0)
         if packed and type(self) is LocalBackend and packing_enabled():
             # single-buffer transfers both ways (see runtime/packing.py);
             # mesh backends keep per-leaf staging (sharded device_put)
-            return PackedStageFn(raw_fn, donate)
-        if donate:
-            return jax.jit(raw_fn, donate_argnums=0)
-        return jax.jit(raw_fn)
+            return PackedStageFn(raw_fn, donate, tag=tag, n_ops=n_ops,
+                                 deadline=deadline)
+        return aot_jit(raw_fn, donate=donate, salt=self.fn_cache_salt(),
+                       tag=tag, n_ops=n_ops, deadline=deadline)
+
+    # ------------------------------------------------------------------
+    def precompile_plan(self, stages, partitions) -> None:
+        """Kick off ahead-of-time compilation of the whole plan on the
+        compile pool (exec/compilequeue). Speculative and asynchronous:
+        stage avals are PREDICTED by chaining abstract shape evaluation
+        from the first source partition, so stage i+1 (and i+2, ...)
+        compiles while stage i executes; a wrong prediction only wastes a
+        background compile — dispatch always verifies by content address.
+        The reference compiles a stage in the milliseconds before its first
+        task (LocalBackend.cc:865); remote XLA compiles are minutes, so
+        here the plan's compiles must all be in flight before stage 0's
+        first batch lands."""
+        from . import compilequeue as CQ
+
+        if type(self) is not LocalBackend:
+            return   # mesh/serverless dispatch builds different executables
+        if self.interpret_only or not CQ.parallel_compile_enabled() \
+                or not self.options.get_bool(
+                    "tuplex.tpu.parallelCompile", True):
+            return
+        first = partitions[0] if isinstance(partitions, list) \
+            and partitions else None
+        if first is None:
+            return
+        CQ.pool().submit(self._precompile_driver, list(stages), first)
+
+    def _precompile_driver(self, stages, first_part):
+        """Walk the plan predicting each stage's dispatch avals and submit
+        pool compiles. Returns the submitted futures (tests drive this
+        synchronously). Prediction stops where shapes become
+        data-dependent: pipeline breakers, filters/limits (output row
+        count), compacted outputs, host-repacked wire layouts."""
+        from ..compiler import stagefn as SF
+        from ..plan import logical as L
+        from ..plan.physical import TransformStage, consumer_kind
+        from ..runtime.jaxcfg import (device_handoff_enabled,
+                                      donation_enabled, jax)
+        from ..runtime.packing import packing_enabled
+        from . import compilequeue as CQ
+
+        futs: list = []
+        try:
+            avals = SF.partition_avals(first_part, self.bucket_mode)
+            schema = first_part.schema
+        except Exception:
+            return futs
+        donate = donation_enabled() and self.options.get_bool(
+            "tuplex.tpu.donateBuffers", True)
+        for si, stage in enumerate(stages):
+            if avals is None or not isinstance(stage, TransformStage) \
+                    or stage.force_interpret \
+                    or getattr(stage, "cpu_compile", False):
+                break
+            skey = stage.key() + "/" + schema.name + self.fn_cache_salt()
+            if skey in self._not_compilable:
+                break
+            use_comp = (self.supports_compaction
+                        and self.options.get_bool(
+                            "tuplex.tpu.filterCompaction", True)
+                        and stage.key() not in self._compaction_off)
+            consumer = consumer_kind(stages, si)
+            packed = True
+            if consumer:
+                packed = not device_handoff_enabled(consumer)
+            try:
+                raw = stage.build_device_fn(
+                    schema, compaction=use_comp,
+                    fused_fold=self.supports_fused_fold)
+                out = jax.eval_shape(raw, avals)
+            except Exception:
+                break
+            if not (packed and type(self) is LocalBackend
+                    and packing_enabled()):
+                # the packed variant traces a different (wire-layout) fn
+                # whose spec depends on content — skip its compile but keep
+                # chaining shapes through the raw fn
+                futs.append(CQ.submit_compile(
+                    raw, (avals,), donate_argnums=(0,) if donate else (),
+                    salt=self.fn_cache_salt(), tag=stage.key(),
+                    n_ops=len(stage.ops),
+                    deadline_s=self.options.get_float(
+                        "tuplex.tpu.compileDeadlineS", 0.0)))
+            if stage.limit >= 0 or any(
+                    isinstance(op, L.FilterOperator) for op in stage.ops):
+                break        # output row count is data-dependent
+            avals = SF.restage_avals(out, self.bucket_mode)
+            nxt = stages[si + 1] if si + 1 < len(stages) else None
+            if not isinstance(nxt, TransformStage):
+                break
+            schema = nxt.input_schema
+        return futs
 
     # ------------------------------------------------------------------
     def execute_any(self, stage, partitions, context,
@@ -459,6 +561,15 @@ class LocalBackend:
             check_interrupted()
             collect_one()
 
+        # per-stage compile seconds (JobMetrics.h discipline): whatever the
+        # compile queue spent building THIS stage's executables — whether
+        # inline at first dispatch or ahead-of-time on the pool — lands on
+        # this stage's record; AOT/dedup hits cost 0 here by construction
+        from . import compilequeue as _cq
+
+        cs, cn = _cq.consume_tag(stage.key())
+        metrics["compile_s"] += cs
+        metrics["stage_compiles"] = cn
         metrics["wall_s"] = time.perf_counter() - t0
         metrics["rows_out"] = emitted_total
         metrics["exception_rows"] = len(exceptions)
@@ -634,14 +745,34 @@ class LocalBackend:
         """Build + jit the fast-path fn. A build failure under compaction
         retries without it (an opt-in optimization must never demote the
         stage to the interpreter); only a plain build failure does that."""
+        cpu_pin = getattr(stage, "cpu_compile", False) and \
+            _cpu_device() is not None
+        if cpu_pin:
+            from ..runtime.jaxcfg import jax as _jax
+
+            cpu_pin = _jax.default_backend() != "cpu"
         while True:
             try:
                 raw_fn = stage.build_device_fn(
                     in_schema, compaction=use_comp,
                     fused_fold=self.supports_fused_fold)
+                if cpu_pin:
+                    # compile-budget degrade (plan/splittuner): the stage's
+                    # predicted accelerator compile blows the budget, so it
+                    # compiles on the host CPU backend instead — device
+                    # transfers still happen at the stage boundary, only
+                    # the compute stays host-side. Limitation: _CpuJit
+                    # wraps a plain jit (the device pin happens at call
+                    # time), so this compile is invisible to the compile
+                    # queue's metrics/AOT store — see ROADMAP.
+                    return self.jit_cache.get_or_build(
+                        ("stagefn", skey, use_comp, "cpupin"),
+                        lambda: _CpuJit(raw_fn)), use_comp
                 return self.jit_cache.get_or_build(
                     ("stagefn", skey, use_comp, packed),
-                    lambda: self._jit_stage_fn(raw_fn, packed=packed)), \
+                    lambda: self._jit_stage_fn(raw_fn, packed=packed,
+                                               tag=stage.key(),
+                                               n_ops=len(stage.ops))), \
                     use_comp
             except NotCompilable:
                 self._not_compilable.add(skey)
@@ -683,6 +814,24 @@ class LocalBackend:
         try:
             outs = device_fn(batch.arrays)
             self.jit_cache.note_traced(cache_key, spec)
+            if not first_call and stage is not None \
+                    and stage.source is None \
+                    and stage.key() not in self._boundary_sampled:
+                # measured per-boundary dispatch tax (re-stage + H2D +
+                # launch of a stage fed by a previous stage): one sample
+                # per stage feeds the split tuner's boundary-cost side.
+                # Only an ALREADY-TRACED spec qualifies (first_call spans
+                # the inline XLA compile — minutes on the tunnel — and a
+                # single poisoned sample would become the model's median,
+                # steering the tuner back to mega-fused stages).
+                self._boundary_sampled.add(stage.key())
+                try:
+                    from ..plan.splittuner import model_for
+
+                    model_for().record_boundary(
+                        time.perf_counter() - t0)
+                except Exception:
+                    pass
         except NotCompilable:
             # surfaces at TRACE time (first call): drop compaction first if
             # it was on (it may be the culprit) and re-dispatch THIS
@@ -794,7 +943,8 @@ class LocalBackend:
                     nkey, lambda: self._jit_stage_fn(
                         stage.build_device_fn(part.schema,
                                               compaction=False),
-                        packed=packed))
+                        packed=packed, tag=stage.key(),
+                        n_ops=len(stage.ops)))
                 batch = C.stage_partition(part, self.bucket_mode)
                 pending2 = nfn(batch.arrays)
                 outs = _get_outs(pending2)
@@ -984,7 +1134,9 @@ class LocalBackend:
             gfn = self.jit_cache.get_or_build(
                 gckey,
                 lambda: (_CpuJit if host_resolve else
-                         jax.jit if local_jit else self._jit_stage_fn)(
+                         jax.jit if local_jit else
+                         (lambda f: self._jit_stage_fn(
+                             f, tag=stage.key())))(
                     stage.build_device_fn(part.schema, general=True)))
         except NotCompilable:
             self._not_compilable.add(gkey)

@@ -118,10 +118,13 @@ class MultiHostBackend(LocalBackend):
         return self.jit_cache.get_or_build(
             ("elastic", skey), lambda: jax.jit(raw))
 
-    def _jit_stage_fn(self, raw_fn, packed: bool = True):
-        """Row-shard over ALL mesh devices (`packed` is accepted for
-        interface parity and ignored: mesh staging is per-leaf sharded
-        device_put). Non-pow2 meshes work too: the
+    def _jit_stage_fn(self, raw_fn, packed: bool = True, tag: str = "",
+                      n_ops: int = 0):
+        """Row-shard over ALL mesh devices (`packed`/`tag`/`n_ops` are
+        accepted for interface parity and ignored: mesh staging is per-leaf
+        sharded device_put, and sharded executables stay outside the AOT
+        artifact store — serialized sharding layouts are not portable
+        across mesh epochs). Non-pow2 meshes work too: the
         batch pads up to a multiple of the mesh size before dispatch (padded
         rows carry #rowvalid=False and the host slices outputs back to the
         partition's row count) — round 1 silently rounded 6 devices down to

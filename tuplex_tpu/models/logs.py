@@ -1,9 +1,13 @@
 """Apache-log parsing pipeline (reference: benchmarks/logs/runtuplex.py —
 regex and string-strip parse variants over loglines, endpoint filter).
 
-The strip variant compiles fully to the device (find/slice chains + dict
-row); the regex variant exercises the interpreter path (re.search is outside
-the compiled subset, like the reference's slower generality modes).
+Both variants compile to the device: the strip variant as find/slice
+chains + dict row, and the regex variant through the compiled re.search
+subset (ops/regex.py lowers the anchored pattern to whole-column kernel
+steps; ops/nfa.py and ops/pallas_nfa.py are the NFA fallbacks for patterns
+the direct lowering rejects). Rows the compiled matcher cannot decide
+fail-safe to the interpreter — it never succeeds with a different answer
+than CPython's re.
 """
 
 from __future__ import annotations

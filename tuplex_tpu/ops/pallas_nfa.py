@@ -142,8 +142,16 @@ def match_pallas(rx, bytes_, lens, interpret=None):
     # TPU lowering recurses without bound in jax 0.9 (RecursionError even at
     # limit 100k — minimized repro in tpu_diag/aot_lower_tpu.py notes). All
     # kernel inputs are explicitly 32-bit, so narrowing the promotion rules
-    # changes nothing semantically.
-    with jax.enable_x64(False):
+    # changes nothing semantically. `jax.enable_x64` is the new-jax name;
+    # older releases ship the same context manager as
+    # jax.experimental.disable_x64.
+    try:
+        _x64_off = jax.enable_x64(False)
+    except AttributeError:
+        from jax.experimental import disable_x64 as _dx64
+
+        _x64_off = _dx64()
+    with _x64_off:
         out = run(
             padrows(bytes_), padrows(lens64.astype(jnp.int32)),
             padrows(end_at.astype(jnp.int32)),

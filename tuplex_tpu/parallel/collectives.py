@@ -54,8 +54,9 @@ def sharded_fold_fn(eval_exprs: Callable, reducers: Sequence[str], mesh,
     Each device reduces its row shard locally, then combines with psum/
     pmin/pmax over the mesh axis; the result replicates on every device.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ..runtime.jaxcfg import shard_map_compat
 
     def local_fold(arrays):
         vals, ok = eval_exprs(arrays)
@@ -73,9 +74,8 @@ def sharded_fold_fn(eval_exprs: Callable, reducers: Sequence[str], mesh,
         return tuple(outs) + (ok,)
 
     specs = _batch_specs(arrays_example, axis)
-    fn = shard_map(local_fold, mesh=mesh, in_specs=(specs,),
-                   out_specs=tuple(P() for _ in reducers) + (P(axis),),
-                   check_vma=False)
+    fn = shard_map_compat(local_fold, mesh, (specs,),
+                          tuple(P() for _ in reducers) + (P(axis),))
     return jax.jit(fn)
 
 
@@ -86,8 +86,9 @@ def sharded_segment_fold_fn(eval_exprs: Callable, reducers: Sequence[str],
     rows, then psum/pmin/pmax of the [nseg] partial tables across the mesh
     (the shuffle-free grouped aggregate: key codes are global, partial
     tables combine on ICI)."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ..runtime.jaxcfg import shard_map_compat
 
     def local_fold(arrays, codes):
         vals, ok = eval_exprs(arrays)
@@ -114,7 +115,6 @@ def sharded_segment_fold_fn(eval_exprs: Callable, reducers: Sequence[str],
         return tuple(outs) + (counts, ok)
 
     specs = _batch_specs(arrays_example, axis)
-    fn = shard_map(local_fold, mesh=mesh, in_specs=(specs, P(axis)),
-                   out_specs=tuple(P() for _ in reducers) + (P(), P(axis)),
-                   check_vma=False)
+    fn = shard_map_compat(local_fold, mesh, (specs, P(axis)),
+                          tuple(P() for _ in reducers) + (P(), P(axis)))
     return jax.jit(fn)

@@ -47,6 +47,11 @@ class TransformStage:
 
     force_interpret = False   # set on segments around non-compilable ops
     route_reason = ""         # why force_interpret was set (analyzer verdict)
+    cpu_compile = False       # compile-budget degrade (plan/splittuner):
+                              # build the stage fn on the host CPU backend
+    split_decision = None     # splittuner.SplitDecision when the tuner ran
+    predicted_compile_s = None  # tuner-predicted compile seconds for THIS
+                                # stage/segment (history + compilestats)
     fold_op = None            # AggregateOperator whose pattern fold is fused
                               # into this stage's device fn (plan_stages)
     speculate_branches = True  # prune if/else arms the sample never took
@@ -755,6 +760,25 @@ def plan_stages(sink: L.LogicalOperator, options=None):
     return out
 
 
+def consumer_kind(stages: list, si: int):
+    """Who consumes stage `si`'s output: False (terminal / interpreter
+    consumer) or the consumer kind "stage"/"join"/"agg" — the value
+    execute_any's `intermediate` parameter takes. Shared by the driver
+    loop (api/dataset.py) and the ahead-of-time compile planner
+    (exec/local.py precompile_plan) so the two can never disagree on the
+    packed-vs-handoff build variant."""
+    nxt = stages[si + 1] if si + 1 < len(stages) else None
+    if nxt is None or getattr(nxt, "force_interpret", False):
+        return False
+    if isinstance(nxt, AggregateStage):
+        return "agg"
+    if isinstance(nxt, JoinStage):
+        return "join"
+    if isinstance(nxt, TransformStage):
+        return "stage"
+    return False
+
+
 def _apply_projection(stage: TransformStage, output_required=None) -> None:
     """Prune unread columns at the Arrow read: unread columns are never
     parsed, decoded, or staged to HBM."""
@@ -937,6 +961,31 @@ def abstract_batch_arrays(input_schema: T.RowType):
     return arrays
 
 
+def stage_fingerprint(stage: TransformStage,
+                      input_schema: Optional[T.RowType] = None):
+    """Content address of the stage's fast-path executable over an abstract
+    8-row batch (exec/compilequeue fingerprint: canonical jaxpr + hoisted
+    const values + avals + platform). Stages that differ only in logical
+    identity — flights' isomorphic join-probe segments, equal re-planned
+    pipelines — share a fingerprint and hence ONE compiled executable.
+    None when the stage has no compilable device fn. NOTE: shape-specific
+    (8-row probe shapes); equal fingerprints here imply the runtime
+    executables dedup too, since runtime shapes derive from the same
+    inputs."""
+    try:
+        schema = input_schema if input_schema is not None \
+            else stage.input_schema
+        arrays = abstract_batch_arrays(schema)
+        if arrays is None or stage.force_interpret:
+            return None
+        fn = stage.build_device_fn(schema)
+        from ..exec.compilequeue import fingerprint_fn
+
+        return fingerprint_fn(fn, (arrays,))
+    except Exception:
+        return None
+
+
 def _op_compiles_uncached(op: L.LogicalOperator,
                           input_schema: T.RowType,
                           speculate: bool = True) -> bool:
@@ -972,15 +1021,52 @@ def _split_oversize(stage: TransformStage, options) -> list:
     executables compile far faster and the intermediate rides the
     device-resident handoff. CPU keeps maximal fusion (local XLA compiles
     are cheap and stage boundaries cost real memcpys there).
-    tuplex.tpu.maxStageOps=0 disables."""
+
+    The split point is MEASURED, not hardcoded (plan/splittuner.py): the
+    per-platform compile-seconds-vs-op-count curve (fed by every actual
+    compile) is balanced against the observed per-boundary dispatch tax,
+    under the ``tuplex.tpu.compileBudgetS`` ceiling; a stage whose finest
+    split still blows the budget degrades to a host-CPU compile with
+    device transfer. An explicit ``tuplex.tpu.maxStageOps`` (>0) overrides
+    the tuner; =0 disables splitting entirely."""
     max_ops = 0
     if options is not None:
         max_ops = options.get_int("tuplex.tpu.maxStageOps", -1)
-    if max_ops < 0:       # auto: only when an accelerator is the target
+    n = len(stage.ops)
+    dec = None
+    if max_ops < 0:       # auto: ask the tuner
         from ..runtime.jaxcfg import jax
 
-        max_ops = 20 if jax.default_backend() != "cpu" else 0
-    n = len(stage.ops)
+        from . import splittuner as ST
+
+        on_cpu = jax.default_backend() == "cpu"
+        budget = options.get_float(
+            "tuplex.tpu.compileBudgetS", 480.0) if options is not None \
+            else 480.0
+        # CPU prefers fusion (boundaries are real memcpys, compiles are
+        # usually cheap) and splits ONLY when the predicted compile blows
+        # the budget — flights' 43-op mega-fusion ran >20 min at >120 GB
+        # on XLA:CPU, the same superlinear pathology as the tunnel.
+        # Accelerators cost-minimize across the whole curve.
+        dec = ST.plan_split(n, budget, ST.model_for(),
+                            prefer_fusion=on_cpu)
+        stage.split_decision = dec
+        stage.predicted_compile_s = dec.predicted_compile_s
+        if dec.k > 1 or dec.degrade:
+            ST.log_decision(dec)
+        if dec.degrade and not on_cpu:
+            # budget-degraded stages compile on the HOST CPU, where
+            # fusion is cheap and every extra boundary costs a real
+            # device transfer — so keep the stage fused rather than
+            # applying the accelerator split, and predict off the CPU
+            # curve
+            stage.cpu_compile = True
+            stage.predicted_compile_s = ST.model_for("cpu").predict(n)
+            max_ops = 0
+        else:
+            # on CPU a degrade verdict has nowhere cheaper to go — take
+            # the least-bad split and proceed
+            max_ops = dec.per if dec.k > 1 else 0
     if not max_ops or n <= max_ops or stage.force_interpret:
         return [stage]
     import math
@@ -1010,6 +1096,12 @@ def _split_oversize(stage: TransformStage, options) -> list:
             seg = TransformStage(None, ops_run, input_schema=schema,
                                  input_op=ops_run[0])
         seg.speculate_branches = stage.speculate_branches
+        seg.cpu_compile = stage.cpu_compile
+        if dec is not None:
+            from . import splittuner as ST
+
+            seg.split_decision = dec
+            seg.predicted_compile_s = ST.model_for().predict(len(ops_run))
         for op in ops_run:
             if not isinstance(op, (L.ResolveOperator, L.IgnoreOperator)):
                 schema = op.schema()

@@ -1,0 +1,348 @@
+"""Measured stage-split tuner: compile-cost curve vs boundary tax.
+
+Replaces the hardcoded ``maxStageOps=20`` auto-split. That constant was a
+workaround for superlinear remote-TPU compile times (the 43-op flights stage
+took >20 min in one tunnel call vs ~2-3 min for zillow's 13), but it trades
+compile seconds against a REAL per-boundary cost — every extra stage boundary
+pays a dispatch + D2H/H2D round trip — and the right cut point is a property
+of the platform, not a constant. SystemML's fusion-plan work (PAPERS:
+arXiv:1801.00829) and FusionStitching (arXiv:1811.05213) both cost this
+granularity tradeoff explicitly; this module does the same with numbers
+measured on THIS machine:
+
+  * every actual stage compile (exec/compilequeue.py) records
+    (op count, seconds) into a per-platform JSON model persisted under the
+    cache dir — the compile-seconds-vs-op-count curve is FIT (power law,
+    log-log least squares) once enough distinct sizes accumulate, with
+    platform defaults anchored on the observed zillow/flights compiles
+    until then;
+  * the first device dispatch of every boundary-fed stage (exec/local.py)
+    records the measured per-boundary dispatch cost;
+  * ``plan_split`` picks the segment count k minimizing
+    predicted_compile(k) + (k-1) * boundary_cost, subject to the
+    ``tuplex.tpu.compileBudgetS`` ceiling — and when even the finest split
+    blows the budget, degrades the stage to a host-CPU compile with device
+    transfer (the stage still runs, just without an accelerator kernel).
+
+The decision (prediction + chosen split) is logged at plan time and recorded
+on the stage for metrics/history/compilestats.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+# default power-law curves t(n) = a + b * n^c, anchored on measured compiles:
+# zillow's 13-op stage ~150 s and flights' 43-op stage >20 min over the TPU
+# tunnel (c = ln(1270/150)/ln(43/13) ~= 1.8). CPU XLA is NOT flat either:
+# zillow's 13-op stage compiles in ~40 s locally but flights' 43-op stage
+# ran >20 min at >120 GB RSS before being killed (c >= ln(30)/ln(3.3) ~=
+# 2.9 between those two anchors — the barrier-laden mega-fusions blow up
+# XLA:CPU superlinearly), so the CPU default is steep too
+_DEFAULT_CURVE = {"cpu": (0.3, 0.05, 2.5)}
+_DEFAULT_CURVE_ACCEL = (20.0, 1.5, 1.8)
+_DEFAULT_BOUNDARY = {"cpu": 0.005}
+_DEFAULT_BOUNDARY_ACCEL = 0.35
+
+_MAX_OBS = 256          # persisted observation window per platform
+
+
+def _model_dir() -> str:
+    d = os.environ.get("TUPLEX_COMPILE_MODEL_DIR", "")
+    if not d:
+        d = os.path.join(os.path.expanduser("~"), ".cache", "tuplex_tpu")
+    try:
+        os.makedirs(d, exist_ok=True)
+    except OSError:
+        return ""
+    return d
+
+
+class CompileModel:
+    """Per-platform compile-time model: raw (op count, seconds) observations
+    plus per-boundary dispatch samples, persisted as JSON; predictions come
+    from a power-law fit when >=3 distinct op counts are on record, else
+    from the platform default curve."""
+
+    def __init__(self, platform: str, path: Optional[str] = None):
+        self.platform = platform
+        d = _model_dir()
+        self.path = path if path is not None else (
+            os.path.join(d, f"compile_model_{platform}.json") if d else "")
+        self.obs: list[list] = []        # [n_ops, seconds]
+        self.boundary: list[float] = []
+        # n_ops -> best-known LOWER BOUND seconds for compiles that have
+        # not (yet) finished: a watchdog in the compile queue refreshes
+        # this while a compile runs, so a compile that is killed /
+        # wedges forever still teaches the model — without this, the
+        # catastrophic compiles are exactly the ones the observation set
+        # never contains (survivorship bias), and the fit extrapolated
+        # from small finished compiles keeps predicting they are fine
+        self.censored: dict[int, float] = {}
+        self._fit: Optional[tuple] = None
+        self._lock = threading.Lock()
+        self._load()
+
+    # -- persistence ----------------------------------------------------
+    def _load(self) -> None:
+        if not self.path or not os.path.exists(self.path):
+            return
+        try:
+            with open(self.path) as fp:
+                d = json.load(fp)
+            self.obs = [o for o in d.get("obs", [])
+                        if isinstance(o, list) and len(o) == 2][-_MAX_OBS:]
+            self.boundary = [float(b) for b in
+                             d.get("boundary", [])][-_MAX_OBS:]
+            self.censored = {int(k): float(v) for k, v in
+                             d.get("censored", {}).items()}
+        except Exception:   # pragma: no cover - corrupt model: start fresh
+            self.obs, self.boundary, self.censored = [], [], {}
+        self._fit = None
+
+    def _save(self) -> None:
+        if not self.path:
+            return
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as fp:
+                json.dump({"platform": self.platform, "updated": time.time(),
+                           "obs": self.obs[-_MAX_OBS:],
+                           "boundary": self.boundary[-_MAX_OBS:],
+                           "censored": {str(k): v for k, v in
+                                        self.censored.items()}}, fp)
+            os.replace(tmp, self.path)
+        except OSError:   # pragma: no cover - model persistence best-effort
+            pass
+
+    # -- recording ------------------------------------------------------
+    def record_compile(self, n_ops: int, seconds: float) -> None:
+        if n_ops <= 0 or seconds <= 0:
+            return
+        with self._lock:
+            self.obs.append([int(n_ops), float(seconds)])
+            self.obs = self.obs[-_MAX_OBS:]
+            self._fit = None
+            self._save()
+
+    def record_running(self, n_ops: int, seconds_so_far: float) -> None:
+        """Censored observation: a compile of `n_ops` has been running
+        for `seconds_so_far` and is not done. Keeps the best lower bound
+        per size; survives the process being killed mid-compile."""
+        if n_ops <= 0 or seconds_so_far <= 0:
+            return
+        with self._lock:
+            if seconds_so_far > self.censored.get(int(n_ops), 0.0):
+                self.censored[int(n_ops)] = float(seconds_so_far)
+                self._fit = None
+                self._save()
+
+    def record_boundary(self, seconds: float) -> None:
+        if seconds <= 0:
+            return
+        with self._lock:
+            self.boundary.append(float(seconds))
+            self.boundary = self.boundary[-_MAX_OBS:]
+            self._save()
+
+    # -- prediction -----------------------------------------------------
+    def _default_curve(self) -> tuple:
+        return _DEFAULT_CURVE.get(self.platform, _DEFAULT_CURVE_ACCEL)
+
+    def curve(self) -> tuple[tuple, bool]:
+        """((a, b, c), fitted?) for t(n) = a + b * n^c. The fit is a
+        2-parameter log-log least squares over per-size medians (the fixed
+        term a is dropped once real data exists — it is inside the
+        measurements), with censored lower-bound points (compiles that
+        never finished) included as regular observations; the exponent
+        clamps to [0.8, 3.0] so a couple of noisy points can't produce an
+        absurd extrapolation."""
+        with self._lock:
+            if self._fit is not None:
+                return self._fit
+            by_n: dict[int, list[float]] = {}
+            for n, s in self.obs:
+                by_n.setdefault(int(n), []).append(float(s))
+            max_done = max(by_n, default=0)
+            for n, s in self.censored.items():
+                # censored lower bounds join the fit only ABOVE the
+                # finished-compile range: that is where survivorship bias
+                # lives (big fused stages that never finish). A wedge at
+                # a SMALL op count (XLA choking on one pathological fn
+                # shape, not on size) must not bend the whole curve —
+                # the per-fingerprint deadline marker handles those
+                # (exec/compilequeue CompileTimeout negative cache).
+                if int(n) > max_done and s > max(by_n.get(int(n), [0.0])):
+                    by_n.setdefault(int(n), []).append(float(s))
+            if len(by_n) >= 3:
+                xs, ys = [], []
+                for n, ss in by_n.items():
+                    ss = sorted(ss)
+                    med = ss[len(ss) // 2]
+                    xs.append(math.log(max(n, 1)))
+                    ys.append(math.log(max(med, 1e-4)))
+                k = len(xs)
+                mx, my = sum(xs) / k, sum(ys) / k
+                den = sum((x - mx) ** 2 for x in xs)
+                if den > 1e-9:
+                    c = sum((x - mx) * (y - my)
+                            for x, y in zip(xs, ys)) / den
+                    c = min(3.0, max(0.8, c))
+                    b = math.exp(my - c * mx)
+                    self._fit = ((0.0, b, c), True)
+                    return self._fit
+            self._fit = (self._default_curve(), False)
+            return self._fit
+
+    def _max_observed_n(self) -> int:
+        n = max((int(o[0]) for o in self.obs), default=0)
+        return max(n, max(self.censored, default=0))
+
+    def predict(self, n_ops: int) -> float:
+        """Predicted compile seconds for a fused stage of `n_ops`
+        operators. Beyond 1.5x the largest size ever observed the
+        prediction never drops below the platform DEFAULT curve: a fit
+        over small finished compiles must not extrapolate a regime change
+        away (XLA's blowup on mega-fusions starts where the observations
+        stop, precisely because those compiles don't finish)."""
+        n_ops = max(int(n_ops), 1)
+        (a, b, c), fitted = self.curve()
+        pred = a + b * n_ops ** c
+        if fitted and n_ops > 1.5 * max(self._max_observed_n(), 1):
+            da, db, dc = self._default_curve()
+            pred = max(pred, da + db * n_ops ** dc)
+        # hard floor at censored lower bounds (compile time is monotone in
+        # op count): a least-squares fit may pass BELOW a lower-bound
+        # point. Same above-the-finished-range scoping as the fit.
+        with self._lock:
+            max_done = max((int(o[0]) for o in self.obs), default=0)
+            for cn, cs in self.censored.items():
+                if cn > max_done and n_ops >= cn:
+                    pred = max(pred, cs)
+        return pred
+
+    def boundary_cost(self) -> float:
+        """Measured per-boundary dispatch+transfer tax (median), or the
+        platform default before any boundary has been observed."""
+        with self._lock:
+            if self.boundary:
+                b = sorted(self.boundary)
+                return b[len(b) // 2]
+        return _DEFAULT_BOUNDARY.get(self.platform, _DEFAULT_BOUNDARY_ACCEL)
+
+
+_MODELS: dict[str, CompileModel] = {}
+_MODELS_LOCK = threading.Lock()
+
+
+def model_for(platform: Optional[str] = None) -> CompileModel:
+    if platform is None:
+        from ..runtime.jaxcfg import jax
+
+        platform = jax.default_backend()
+    with _MODELS_LOCK:
+        m = _MODELS.get(platform)
+        if m is None:
+            m = _MODELS[platform] = CompileModel(platform)
+        return m
+
+
+def reset_models() -> None:
+    """Drop the singleton cache (tests repoint TUPLEX_COMPILE_MODEL_DIR)."""
+    with _MODELS_LOCK:
+        _MODELS.clear()
+
+
+# ---------------------------------------------------------------------------
+# the split decision
+# ---------------------------------------------------------------------------
+
+@dataclass
+class SplitDecision:
+    n_ops: int
+    k: int                  # number of segments
+    per: int                # max ops per segment
+    predicted_compile_s: float   # summed over segments (serial; the compile
+                                 # pool overlaps them, so wall is lower)
+    boundary_s: float       # added per-boundary tax, (k-1) * unit cost
+    budget_s: float         # tuplex.tpu.compileBudgetS (0 = unbounded)
+    degrade: bool           # even the finest split blows the budget:
+                            # compile on host CPU with device transfer
+    fitted: bool            # curve came from measured points, not defaults
+    reason: str = ""
+
+    def describe(self) -> str:
+        shape = (f"{self.n_ops} ops -> {self.k} segment(s) of <="
+                 f"{self.per}")
+        pred = (f"predicted compile {self.predicted_compile_s:.1f}s"
+                f" ({'measured curve' if self.fitted else 'default curve'})"
+                f", boundary tax {self.boundary_s:.2f}s")
+        bud = f"budget {self.budget_s:.0f}s" if self.budget_s > 0 \
+            else "no budget"
+        tail = " — DEGRADED to host-CPU compile" if self.degrade else ""
+        return f"stage-split tuner: {shape}; {pred}; {bud}{tail}"
+
+
+def _chunk_sizes(n: int, k: int) -> list[int]:
+    per = math.ceil(n / k)
+    sizes, left = [], n
+    while left > 0:
+        sizes.append(min(per, left))
+        left -= per
+    return sizes
+
+
+def plan_split(n_ops: int, budget_s: float,
+               model: Optional[CompileModel] = None,
+               max_segments: int = 32,
+               prefer_fusion: bool = False) -> SplitDecision:
+    """Pick the segment count for an `n_ops` fused stage.
+
+    Minimizes predicted_compile + boundary tax over k; a positive
+    `budget_s` is a ceiling on the predicted compile total — among the k
+    that fit the budget the cheapest overall wins. With
+    ``prefer_fusion=True`` (the CPU policy) the SMALLEST k that fits the
+    budget wins instead: stage boundaries cost real memcpys there and the
+    compile is a one-time cost the AOT artifact store amortizes away, so
+    fusion is kept unless the predicted compile itself is pathological
+    (flights' 43-op stage: >20 min / >120 GB on XLA:CPU). When nothing
+    fits, the decision carries ``degrade=True`` with the cheapest split's
+    numbers (what the accelerator WOULD cost): the physical planner then
+    keeps the stage fused and pins its compile to the host CPU instead of
+    the accelerator (_split_oversize)."""
+    model = model or model_for()
+    n_ops = max(int(n_ops), 1)
+    bcost = model.boundary_cost()
+    (_, _, _), fitted = model.curve()
+    cands = []
+    for k in range(1, min(n_ops, max_segments) + 1):
+        sizes = _chunk_sizes(n_ops, k)
+        comp = sum(model.predict(s) for s in sizes)
+        bnd = (len(sizes) - 1) * bcost
+        cands.append((k, max(sizes), comp, bnd))
+    in_budget = [c for c in cands if budget_s <= 0 or c[2] <= budget_s]
+    if in_budget:
+        key = (lambda c: c[0]) if prefer_fusion \
+            else (lambda c: c[2] + c[3])
+        k, per, comp, bnd = min(in_budget, key=key)
+        return SplitDecision(n_ops, k, per, comp, bnd, budget_s,
+                             degrade=False, fitted=fitted)
+    # nothing fits: finest split, degraded to a host-CPU compile
+    k, per, comp, bnd = min(cands, key=lambda c: c[2])
+    return SplitDecision(
+        n_ops, k, per, comp, bnd, budget_s, degrade=True, fitted=fitted,
+        reason=f"finest split still predicts {comp:.0f}s compile "
+               f"> budget {budget_s:.0f}s")
+
+
+def log_decision(dec: SplitDecision) -> None:
+    from ..utils.logging import get_logger
+
+    log = get_logger("plan")
+    (log.warning if dec.degrade else log.info)("%s", dec.describe())

@@ -172,6 +172,61 @@ def stmt_barriers_enabled() -> bool:
     return fusion_barriers_enabled()
 
 
+def shard_map_compat(fn, mesh, in_specs, out_specs, check: bool = False):
+    """``jax.shard_map`` across jax versions: the top-level export (and its
+    ``check_vma`` kwarg) only exists on newer jax; older releases ship it
+    as ``jax.experimental.shard_map`` with ``check_rep``. Import jax's
+    shard_map ONLY through here (same rule as the jax import itself)."""
+    try:
+        from jax import shard_map as _sm  # jax >= 0.6
+
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=check)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check)
+
+
+def aot_cache_enabled() -> bool:
+    """Content-addressed AOT executable reuse (exec/compilequeue.py): stage
+    executables serialize to disk keyed on (canonical jaxpr fingerprint,
+    platform/ISA, avals, donation/packing flags, mesh epoch) so a second
+    process re-running the same pipeline deserializes instead of compiling.
+    This sits ABOVE jax's own persistent compilation cache: that one still
+    re-runs the XLA pipeline front-end per process; this one skips the
+    compile call entirely (the hit/miss counters in compilequeue.STATS are
+    the proof). TUPLEX_AOT_CACHE=0 disables; =<path> relocates the store."""
+    return os.environ.get("TUPLEX_AOT_CACHE", "") != "0"
+
+
+def aot_cache_dir() -> str:
+    """On-disk artifact directory for serialized stage executables.
+    Partitioned by the same host-ISA tag as the XLA compile cache (XLA:CPU
+    artifacts encode machine features; loading cross-ISA risks SIGILL —
+    same rationale as _host_tag above)."""
+    v = os.environ.get("TUPLEX_AOT_CACHE", "")
+    if v == "0":
+        return ""
+    d = v or os.path.join(os.path.expanduser("~"), ".cache",
+                          f"tuplex_aot_{_host_tag()}")
+    try:
+        os.makedirs(d, exist_ok=True)
+    except OSError:
+        return ""
+    return d
+
+
+def aot_platform_tag() -> str:
+    """Platform component of the AOT fingerprint: effective backend +
+    host-ISA tag + x64 mode + jax version. Anything that changes what a
+    compiled executable MEANS must appear here."""
+    return "/".join((jax.default_backend(), _host_tag(),
+                     f"x64={int(bool(jax.config.jax_enable_x64))}",
+                     f"jax={jax.__version__}"))
+
+
 def donation_enabled() -> bool:
     """Whether stage dispatch donates its input device buffers to XLA
     (halves per-stage HBM residency: the staged input is dead the moment

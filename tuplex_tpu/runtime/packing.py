@@ -569,13 +569,17 @@ class PackedStageFn:
     actual row bytes — on zillow that's the difference between ~170 B/row
     of padding and ~30 B of content over a ~50 MB/s tunnel."""
 
-    def __init__(self, raw_fn, donate: bool):
+    def __init__(self, raw_fn, donate: bool, tag: str = "", n_ops: int = 0,
+                 deadline=None):
         from .jaxcfg import varlen_wire_enabled
 
         self._raw = raw_fn
         self._donate = donate
         self._varlen = varlen_wire_enabled()
         self._fns: dict = {}
+        self._tag = tag          # compile-seconds attribution (stage key)
+        self._n_ops = n_ops      # feeds the stage-split tuner curve
+        self._deadline = deadline   # compile deadline (CompileTimeout)
 
     def __call__(self, arrays: dict):
         spec, total = _host_spec(arrays)
@@ -606,8 +610,15 @@ class PackedStageFn:
                 cell["vspec"] = vspec
                 return obuf, vbuf, extra_outs
 
-            fn = jax.jit(traced, donate_argnums=0) if self._donate \
-                else jax.jit(traced)
+            # content-addressed AOT route (exec/compilequeue): the trace —
+            # which records ospec/vspec into `cell` as a side effect — runs
+            # on every path (fingerprinting always traces); only the XLA
+            # compile is skipped on a fingerprint or disk-artifact hit
+            from ..exec.compilequeue import aot_jit
+
+            fn = aot_jit(traced, donate=self._donate, salt="pack",
+                         tag=self._tag, n_ops=self._n_ops,
+                         deadline=self._deadline)
             entry = (fn, cell)
             self._fns[(spec, ekey)] = entry
         fn, cell = entry

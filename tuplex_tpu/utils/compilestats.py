@@ -1,0 +1,159 @@
+"""`python -m tuplex_tpu compilestats <script.py>` — plan-time compile
+forecast.
+
+Runs the pipeline script with every DataSet ACTION stubbed out (collect/
+take/show/tocsv/... capture the plan and return empty), plans each captured
+action, and prints per stage: fused op count, jaxpr equation count, the
+split tuner's predicted compile seconds (plan/splittuner.py — the measured
+per-platform curve), and which stages would share one executable under the
+content-addressed compile cache (exec/compilequeue.py fingerprints).
+
+Unlike `lint` (purely syntactic, never imports the script), compilestats
+MUST import the script to build its operator graph — sources are sniffed
+and samples traced, but no stage executes and nothing compiles.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional
+
+
+def _capture_plans(script: str) -> list:
+    """Import/run the script with actions stubbed; returns captured
+    (action, sink_op, options_store) triples in call order."""
+    import runpy
+
+    from ..api.dataset import DataSet
+
+    captured: list = []
+    saved = {name: getattr(DataSet, name)
+             for name in ("_execute", "_execute_partitions",
+                          "tocsv", "toorc", "totuplex")}
+
+    def fake_execute(self, limit: int):
+        captured.append(("collect" if limit < 0 else f"take({limit})",
+                         self._op, self._context.options_store))
+        return []
+
+    def fake_partitions(self, limit: int, output_sink=None):
+        captured.append(("write", self._op, self._context.options_store))
+        self._t_job = 0.0
+        return []
+
+    def fake_sink(self, path, *a, **kw):
+        # capture WITHOUT creating an (empty) output file on disk
+        captured.append((f"write({path!r})", self._op,
+                         self._context.options_store))
+
+    DataSet._execute = fake_execute
+    DataSet._execute_partitions = fake_partitions
+    DataSet.tocsv = DataSet.toorc = DataSet.totuplex = fake_sink
+    try:
+        runpy.run_path(script, run_name="__main__")
+    finally:
+        for name, fn in saved.items():
+            setattr(DataSet, name, fn)
+    return captured
+
+
+def _stage_rows(stages, model) -> tuple[list, dict]:
+    """Per-stage stat rows + fingerprint groups for one plan."""
+    from ..plan.physical import TransformStage, stage_fingerprint
+    from .planviz import stage_eqn_count
+
+    rows = []
+    by_fp: dict[str, list[int]] = {}
+    for i, st in enumerate(stages):
+        kind = type(st).__name__
+        if not isinstance(st, TransformStage):
+            rows.append({"i": i, "kind": kind, "n_ops": None})
+            continue
+        n_ops = len(st.ops)
+        row = {"i": i, "kind": kind, "n_ops": n_ops,
+               "interpreter": bool(st.force_interpret),
+               "cpu_compile": bool(getattr(st, "cpu_compile", False))}
+        if not st.force_interpret:
+            row["eqns"] = stage_eqn_count(st)
+            pred = getattr(st, "predicted_compile_s", None)
+            row["predicted_s"] = float(pred) if pred is not None \
+                else model.predict(n_ops)
+            fp = stage_fingerprint(st)
+            if fp is not None:
+                row["fp"] = fp
+                by_fp.setdefault(fp, []).append(i)
+        dec = getattr(st, "split_decision", None)
+        if dec is not None:
+            row["split"] = dec.describe()
+        rows.append(row)
+    return rows, {fp: ix for fp, ix in by_fp.items() if len(ix) > 1}
+
+
+def main(script: str, platform: Optional[str] = None) -> int:
+    from ..plan.physical import plan_stages
+    from ..plan.splittuner import model_for
+
+    try:
+        captured = _capture_plans(script)
+    except SystemExit as e:
+        if e.code not in (0, None):
+            print(f"compilestats: script exited with {e.code}",
+                  file=sys.stderr)
+            return 2
+        captured = []
+    if not captured:
+        print("compilestats: the script ran no DataSet action "
+              "(collect/take/show/tocsv/...)", file=sys.stderr)
+        return 1
+
+    model = model_for(platform)
+    (_, _, curve_c), fitted = model.curve()
+    print(f"compile model: platform={model.platform} "
+          f"{'measured curve' if fitted else 'default curve'} "
+          f"(exponent {curve_c:.2f}), "
+          f"boundary cost {model.boundary_cost() * 1e3:.1f} ms")
+    rc = 0
+    for pi, (action, sink, options) in enumerate(captured):
+        print(f"\nplan {pi + 1} ({action}):")
+        try:
+            stages = plan_stages(sink, options)
+        except Exception as e:
+            print(f"  planning failed: {type(e).__name__}: {e}")
+            rc = 1
+            continue
+        rows, dedup = _stage_rows(stages, model)
+        total = 0.0
+        for row in rows:
+            head = f"  stage {row['i']} [{row['kind']}]"
+            if row["n_ops"] is None:
+                print(f"{head}: pipeline breaker")
+                continue
+            bits = [f"{row['n_ops']} ops"]
+            if row.get("eqns") is not None:
+                bits.append(f"{row['eqns']} jaxpr eqns")
+            if row.get("interpreter"):
+                bits.append("interpreter segment (no compile)")
+            elif row.get("cpu_compile"):
+                bits.append("host-CPU compile (budget degrade)")
+            if row.get("predicted_s") is not None \
+                    and not row.get("interpreter"):
+                bits.append(f"predicted compile {row['predicted_s']:.1f}s")
+                total += row["predicted_s"]
+            print(f"{head}: {', '.join(bits)}")
+            if row.get("split"):
+                print(f"    {row['split']}")
+        saved = 0.0
+        for fp, ix in dedup.items():
+            dupes = ix[1:]
+            saved += sum(r["predicted_s"] for r in rows
+                         if r["i"] in dupes and r.get("predicted_s"))
+            print(f"  dedup: stages {ix} share one executable "
+                  f"(fingerprint {fp[:12]}…)")
+        budget = options.get_float("tuplex.tpu.compileBudgetS", 480.0)
+        line = (f"  predicted compile total: {total:.1f}s serial"
+                + (f", {total - saved:.1f}s after dedup" if saved else ""))
+        if budget > 0:
+            line += (f"; budget {budget:.0f}s -> "
+                     + ("fits" if total - saved <= budget else "OVER"))
+        print(line)
+    return rc
