@@ -224,6 +224,7 @@ def child() -> None:
     got = None
     times = []
     d2h_per_run = []
+    h2d_per_run = []
     base_times = []
     for i in range(RUNS + 1):
         xsnap = xferstats.snapshot()
@@ -233,7 +234,9 @@ def child() -> None:
         dt = time.perf_counter() - t0
         if i > 0:  # first run includes XLA compile
             times.append(dt)
-            d2h_per_run.append(xferstats.delta(xsnap)["d2h_bytes"])
+            xd = xferstats.delta(xsnap)
+            d2h_per_run.append(xd["d2h_bytes"])
+            h2d_per_run.append(xd["h2d_bytes"])
         base_times.append(_timed(
             lambda: zillow.run_reference_python(base_data)))
     best = min(times)
@@ -242,6 +245,7 @@ def child() -> None:
     # boundary-transfer tax of the steady-state run (runtime/xferstats):
     # this is the number the varlen wire + device-resident handoff shrink
     d2h_bytes = d2h_per_run[times.index(best)] if d2h_per_run else 0
+    h2d_bytes = h2d_per_run[times.index(best)] if h2d_per_run else 0
     spread = (max(times) - min(times)) / min(times) if times else 0.0
 
     # --- correctness gate --------------------------------------------------
@@ -266,6 +270,7 @@ def child() -> None:
         "vs_llvm_kind": llvm_kind,
         "platform": actual,
         "d2h_bytes": int(d2h_bytes),
+        "h2d_bytes": int(h2d_bytes),
         "n_trials": len(times),
         "spread": round(spread, 3),
         # compile pipeline: total stage-executable compile seconds across
@@ -286,6 +291,7 @@ def child() -> None:
         "runs_s": [round(t, 3) for t in times],
         "spread": round(spread, 3),
         "d2h_bytes_per_run": [int(b) for b in d2h_per_run],
+        "h2d_bytes_per_run": [int(b) for b in h2d_per_run],
         "platform": actual,
         "interp_rows_per_sec": round(base_rate, 1),
         "output_rows": len(got) if got else 0,

@@ -1,0 +1,102 @@
+#!/usr/bin/env python3
+"""Tracing smoke test: run the zillow model pipeline with structured
+tracing ON and assert the Chrome trace export is well-formed and covers
+every layer the ISSUE-4 acceptance criteria name — plan, analyzer,
+per-stage compile (with a cache verdict attribute), dispatch, resolve
+tiers, and merge.
+
+Run directly (CI wires it as a tier-1 test via tests/test_tracing.py):
+
+    JAX_PLATFORMS=cpu python scripts/trace_smoke.py
+
+Exits 0 and prints one `trace-smoke OK ...` line on success; any
+assertion failure is a non-zero exit. TRACE_SMOKE_ROWS overrides the
+input size (default 400 — matching tests/test_zillow_model.py so a warm
+AOT artifact cache skips the XLA compiles)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))          # run from anywhere
+
+N_ROWS = int(os.environ.get("TRACE_SMOKE_ROWS", "400"))
+
+# span names that must appear for a zillow run (ISSUE 4 acceptance):
+# nested spans for plan, analyzer, per-stage compile, dispatch, resolve
+# and merge. resolve:general / resolve:interpreter are data-dependent —
+# at least one tier must fire on zillow's dirty rows.
+REQUIRED = ("job", "plan", "plan:analyze-udf", "compile:trace",
+            "partition:dispatch", "partition:collect-fast",
+            "partition:merge", "stage:execute")
+RESOLVE_ANY = ("resolve:general", "resolve:interpreter")
+COMPILE_ANY = ("compile:xla", "compile:cache-hit", "compile:aot-load")
+
+
+def main() -> int:
+    import tuplex_tpu
+    from tuplex_tpu.models import zillow
+    from tuplex_tpu.runtime import tracing
+
+    with tempfile.TemporaryDirectory() as d:
+        data = os.path.join(d, "zillow.csv")
+        zillow.generate_csv(data, N_ROWS, seed=7)
+        ctx = tuplex_tpu.Context({"tuplex.tpu.trace": True})
+        assert tracing.enabled(), "tuplex.tpu.trace did not enable tracing"
+        got = zillow.build_pipeline(ctx.csv(data)).collect()
+        assert got == zillow.run_reference_python(data), \
+            "tracing changed pipeline output"
+
+        out = os.path.join(d, "trace.json")
+        ctx.metrics.export_trace(out)
+        with open(out) as fp:
+            doc = json.load(fp)
+
+        evs = doc["traceEvents"]
+        assert isinstance(evs, list) and evs, "empty traceEvents"
+        names = set()        # complete ("X") span families
+        all_names = set()    # includes instants — cache-hit is ph "i"
+        for e in evs:
+            # chrome trace-event schema: every event carries these
+            for k in ("name", "ph", "pid", "tid"):
+                assert k in e, f"event missing {k!r}: {e}"
+            all_names.add(e["name"])
+            if e["ph"] == "X":
+                assert isinstance(e["ts"], (int, float)), e
+                assert isinstance(e["dur"], (int, float)), e
+                assert e["dur"] >= 0, e
+                names.add(e["name"])
+        missing = [n for n in REQUIRED if n not in names]
+        assert not missing, f"missing span families: {missing}"
+        assert any(n in names for n in RESOLVE_ANY), \
+            f"no resolve-tier span fired (have: {sorted(names)})"
+        assert any(n in all_names for n in COMPILE_ANY), \
+            "no compile span (xla/cache-hit/aot-load) recorded"
+        # per-stage compile spans must carry the cache verdict attribute
+        cache_attrs = [e["args"].get("cache") for e in evs
+                       if e.get("args") and "cache" in e["args"]]
+        assert cache_attrs, "no span carries a cache hit/miss attribute"
+        # spans must actually nest: some X event starts inside another on
+        # the same thread
+        xs = [e for e in evs if e["ph"] == "X"]
+        nested = any(
+            a is not b and a["tid"] == b["tid"]
+            and a["ts"] <= b["ts"]
+            and b["ts"] + b["dur"] <= a["ts"] + a["dur"] + 1e-6
+            for a in xs for b in xs)
+        assert nested, "no nested spans found"
+
+        md = ctx.metrics.as_dict()
+        assert "h2d_bytes" in md and "d2h_bytes" in md
+        assert "counters" in md
+        print(f"trace-smoke OK — {len(evs)} events, "
+              f"{len(names)} span families, rows={len(got)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,6 +8,7 @@ TuplexShell, launched by the `tuplex` console entry point). Subcommands:
     python -m tuplex_tpu shell            # same, explicit
     python -m tuplex_tpu lint script.py   # plan-time UDF static analysis
     python -m tuplex_tpu compilestats script.py   # compile forecast
+    python -m tuplex_tpu trace out.json   # history -> Chrome trace JSON
     python -m tuplex_tpu version          # print the package version
 
 `lint` runs the compiler's static analyzer (compiler/analyzer.py) over every
@@ -46,6 +47,14 @@ def main(argv=None) -> int:
     cs.add_argument("script", help="path to a python pipeline script")
     cs.add_argument("--platform", default=None,
                     help="compile-model platform (default: jax backend)")
+    tr = sub.add_parser(
+        "trace",
+        help="replay the job history as Chrome trace-event JSON "
+             "(open in Perfetto / chrome://tracing)")
+    tr.add_argument("out", help="output .json path")
+    tr.add_argument("--log-dir", default=".",
+                    help="directory holding tuplex_history.jsonl "
+                         "(tuplex.logDir; default .)")
     sub.add_parser("version", help="print the package version")
     args = parser.parse_args(argv)
 
@@ -70,6 +79,16 @@ def main(argv=None) -> int:
         except OSError as e:
             print(f"compilestats: {e}", file=sys.stderr)
             return 2
+    if args.cmd == "trace":
+        from .history.recorder import history_to_chrome
+
+        try:
+            out = history_to_chrome(args.log_dir, args.out)
+        except OSError as e:
+            print(f"trace: {e}", file=sys.stderr)
+            return 2
+        print(f"wrote {out} — open at ui.perfetto.dev or chrome://tracing")
+        return 0
     # bare invocation or explicit `shell`
     from .utils.repl import interactive_shell
 

@@ -25,6 +25,13 @@ class Context:
                                             else None, **kwargs)
         if isinstance(conf, str):
             self.options_store.update(conf)
+        if self.options_store.get_bool("tuplex.tpu.trace", False):
+            # span tracing is process-wide (spans cross backend/compile-
+            # pool threads); the option turns it on, never off — another
+            # live Context (or TUPLEX_TRACE=1) may also depend on it
+            from ..runtime import tracing
+
+            tracing.enable(True)
         self.backend = self._make_backend()
         self.metrics = Metrics()
         from ..history import JobRecorder

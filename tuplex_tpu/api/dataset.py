@@ -257,36 +257,46 @@ class DataSet:
         from ..utils.signals import capture_sigint, check_interrupted
 
         self._t_job = _time.perf_counter()
-        prof_dir = self._context.options_store.get_str(
-            "tuplex.tpu.profileDir", "")
-        prof_cm = None
-        if prof_dir:
-            # capture an XLA/TPU trace of the whole job (open with
-            # tensorboard or xprof; VERDICT r1 asked for exactly this on
-            # the chip). Best-effort: profiling must never fail a job.
-            try:
-                import jax.profiler as _prof
+        from ..runtime import tracing as TR
 
-                prof_cm = _prof.trace(prof_dir)
-                prof_cm.__enter__()
-            except Exception:
-                prof_cm = None
-        sink = L.TakeOperator(self._op, limit) if limit >= 0 else self._op
-        from ..compiler import analyzer as _az
-
-        azsnap = _az.snapshot()
-        stages = plan_stages(sink, self._context.options_store)
-        azd = _az.delta(azsnap)
-        self._context.metrics.record_plan({
-            "analyzer_ms": azd["analyze_ms"],
-            "plan_fallback_ops": azd["plan_fallback_ops"]})
-        backend = self._context.backend
-        recorder = self._context.recorder
-        recorder.job_started("collect" if limit < 0 else f"take({limit})",
-                             stages)
+        # the history slice starts HERE — before the job span opens — so
+        # the job/plan/analyzer spans land in the per-job waterfall too
+        _tmark = TR.now_us()
+        _jsp = TR.span("job", "job")
+        _jsp.__enter__()
         partitions = None
         all_exceptions = []
+        prof_cm = None
         try:
+            _jsp.set("action", "collect" if limit < 0 else f"take({limit})")
+            prof_dir = self._context.options_store.get_str(
+                "tuplex.tpu.profileDir", "")
+            if prof_dir:
+                # capture an XLA/TPU trace of the whole job (open with
+                # tensorboard or xprof; VERDICT r1 asked for exactly this on
+                # the chip). Best-effort: profiling must never fail a job.
+                try:
+                    import jax.profiler as _prof
+
+                    prof_cm = _prof.trace(prof_dir)
+                    prof_cm.__enter__()
+                except Exception:
+                    prof_cm = None
+            sink = L.TakeOperator(self._op, limit) if limit >= 0 \
+                else self._op
+            from ..compiler import analyzer as _az
+
+            azsnap = _az.snapshot()
+            stages = plan_stages(sink, self._context.options_store)
+            azd = _az.delta(azsnap)
+            self._context.metrics.record_plan({
+                "analyzer_ms": azd["analyze_ms"],
+                "plan_fallback_ops": azd["plan_fallback_ops"]})
+            backend = self._context.backend
+            recorder = self._context.recorder
+            recorder.job_started(
+                "collect" if limit < 0 else f"take({limit})",
+                stages, trace_mark=_tmark)
             with capture_sigint():
                 for si, stage in enumerate(stages):
                     check_interrupted()
@@ -339,11 +349,37 @@ class DataSet:
                     recorder.stage_done(stage, result.metrics,
                                         result.exceptions)
         finally:
+            import sys as _sys
+
+            # pass the in-flight exception (if any) so a crashed job's
+            # span carries the error attribute like every other span
+            _jsp.__exit__(*_sys.exc_info())
             if prof_cm is not None:
                 try:
                     prof_cm.__exit__(None, None, None)
                 except Exception:
                     pass
+            # multihost: every process dumps its own span stream next to
+            # the history file; the driver merges the per-host lanes via
+            # `python -m tuplex_tpu trace` (history.recorder reads the
+            # tuplex_trace_host*.jsonl siblings). Lanes are keyed by the
+            # jax process index (tracing.set_host), so streams never
+            # collide in the merged timeline.
+            if TR.enabled():
+                try:
+                    import jax as _jax
+
+                    if _jax.process_count() > 1:
+                        import os as _os
+
+                        _ld = self._context.options_store.get_str(
+                            "tuplex.logDir", ".")
+                        TR.dump_jsonl(_os.path.join(
+                            _ld,
+                            f"tuplex_trace_host{_jax.process_index()}"
+                            ".jsonl"))
+                except Exception:
+                    pass    # span dump must never fail the job
             # interrupted jobs must not leave stale per-action state
             self._last_exceptions = all_exceptions
         return partitions or []

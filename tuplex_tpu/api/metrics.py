@@ -63,6 +63,16 @@ class Metrics:
     def totalRowsOut(self) -> int:
         return sum(int(m.get("rows_out", 0)) for m in self.stages)
 
+    def d2hBytes(self) -> int:
+        """Device->host transfer bytes attributed per stage (the boundary
+        tunnel tax the varlen wire / handoff work is judged against)."""
+        return sum(int(m.get("d2h_bytes", 0)) for m in self.stages)
+
+    def h2dBytes(self) -> int:
+        """Host->device upload bytes attributed per stage (packed dispatch
+        buffers + per-leaf staging)."""
+        return sum(int(m.get("h2d_bytes", 0)) for m in self.stages)
+
     def swapOutCount(self) -> int:
         return sum(int(m.get("swap_out", 0)) for m in self.stages)
 
@@ -104,6 +114,8 @@ class Metrics:
         return out
 
     def as_dict(self) -> dict:
+        from ..runtime import xferstats
+
         return {
             "stages": self.stage_breakdown(),
             "fast_path_s": self.fastPathWallTime(),
@@ -116,9 +128,28 @@ class Metrics:
             "exception_rows": self.totalExceptionCount,
             "analyzer_ms": self.analyzerTimeMs(),
             "plan_fallback_ops": self.planFallbackOps(),
+            "d2h_bytes": self.d2hBytes(),
+            "h2d_bytes": self.h2dBytes(),
+            # the process-wide tagged counter registry (runtime/xferstats):
+            # cumulative since process start — transfer bytes by call-site
+            # tag, spill volume, compile-cache hit/miss counts
+            "counters": xferstats.as_dict(),
         }
 
     def as_json(self) -> str:
         import json
 
         return json.dumps(self.as_dict())
+
+    def export_trace(self, path: str) -> str:
+        """Write the span timeline recorded so far (``tuplex.tpu.trace`` /
+        TUPLEX_TRACE=1) as Chrome trace-event JSON — open in Perfetto
+        (ui.perfetto.dev) or chrome://tracing. Raises RuntimeError when
+        tracing never recorded anything (almost always: tracing was off)."""
+        from ..runtime import tracing
+
+        if not tracing.events():
+            raise RuntimeError(
+                "no spans recorded — enable tracing with tuplex.tpu.trace "
+                "or TUPLEX_TRACE=1 before running the job")
+        return tracing.export_chrome_trace(path)

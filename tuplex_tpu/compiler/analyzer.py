@@ -540,18 +540,24 @@ def analyze_udf(udf) -> UDFReport:
     key = (code, _globals_sig(udf.globals)) if code is not None else None
     if key is not None and key in _udf_memo:
         return _udf_memo[key]
+    from ..runtime import tracing as _tr
+
     t0 = time.perf_counter()
     filename = code.co_filename if code is not None else "<udf>"
     line_base = code.co_firstlineno if code is not None else 1
-    if not udf.source:
-        rpt = UDFReport(name=udf.name, params=tuple(udf.params),
-                        filename=filename, line_base=line_base)
-        rpt.findings.append(Finding(
-            kind="fallback", reason="no retrievable UDF source",
-            lineno=1, col=0, conditional=False))
-    else:
-        rpt = analyze_tree(udf.tree, name=udf.name, globals_map=udf.globals,
-                           filename=filename, line_base=line_base)
+    with _tr.span("plan:analyze-udf", "plan") as _sp:
+        if not udf.source:
+            rpt = UDFReport(name=udf.name, params=tuple(udf.params),
+                            filename=filename, line_base=line_base)
+            rpt.findings.append(Finding(
+                kind="fallback", reason="no retrievable UDF source",
+                lineno=1, col=0, conditional=False))
+        else:
+            rpt = analyze_tree(udf.tree, name=udf.name,
+                               globals_map=udf.globals,
+                               filename=filename, line_base=line_base)
+        if _sp is not _tr.NOOP:
+            _sp.set("udf", udf.name).set("findings", len(rpt.findings))
     STATS["analyze_calls"] += 1
     STATS["analyze_ms"] += (time.perf_counter() - t0) * 1e3
     if key is not None:

@@ -106,6 +106,18 @@ DEFAULTS: dict[str, str] = {
                                             # (exec/compilequeue.py);
                                             # TUPLEX_PARALLEL_COMPILE=0 also
                                             # disables
+    "tuplex.tpu.trace": "false",            # structured span tracing
+                                            # (runtime/tracing.py): nested
+                                            # spans across plan/compile/
+                                            # execute/merge, exported as
+                                            # Chrome trace-event JSON via
+                                            # Metrics.export_trace(path) /
+                                            # `python -m tuplex_tpu trace`.
+                                            # Off = zero overhead (no-op
+                                            # spans). TUPLEX_TRACE=1 also
+                                            # enables; TUPLEX_TRACE_BUFFER
+                                            # sizes the ring (default 65536
+                                            # spans)
 }
 
 

@@ -56,23 +56,28 @@ class AggregateExecutor:
 
     # ==================================================================
     def execute(self, stage, partitions: list[C.Partition]):
+        from ..runtime import tracing as TR
         from .local import StageResult
 
         op = stage.op
         t0 = time.perf_counter()
-        if isinstance(op, A.UniqueOperator):
-            parts, excs = self._unique(op, partitions)
-        elif isinstance(op, A.AggregateByKeyOperator):
-            parts, excs = self._aggregate(op, partitions, by_key=True)
-        elif isinstance(op, A.AggregateOperator):
-            parts, excs = self._aggregate(op, partitions, by_key=False)
-        else:
-            raise NotCompilable(f"aggregate stage op {op!r}")
+        with TR.span("agg:execute", "exec") as _sp:
+            _sp.set("op", type(op).__name__)
+            if isinstance(op, A.UniqueOperator):
+                parts, excs = self._unique(op, partitions)
+            elif isinstance(op, A.AggregateByKeyOperator):
+                parts, excs = self._aggregate(op, partitions, by_key=True)
+            elif isinstance(op, A.AggregateOperator):
+                parts, excs = self._aggregate(op, partitions, by_key=False)
+            else:
+                raise NotCompilable(f"aggregate stage op {op!r}")
+            rows_out = sum(p.num_rows for p in parts)
+            _sp.set("rows_out", rows_out)
         from . import compilequeue as _cq
 
         cs, cn = _cq.consume_tag("agg")
         m = {"wall_s": time.perf_counter() - t0,
-             "rows_out": sum(p.num_rows for p in parts),
+             "rows_out": rows_out,
              "exception_rows": len(excs),
              "compile_s": cs, "stage_compiles": cn}
         return StageResult(parts, excs, m)

@@ -47,6 +47,9 @@ from typing import Any, Optional
 
 import numpy as np
 
+from ..runtime import tracing as TR
+from ..runtime import xferstats
+
 # -- counters ---------------------------------------------------------------
 # stage_compiles: actual lowered.compile() invocations (the expensive event;
 #   the cross-process acceptance test asserts this is ZERO on a warm cache)
@@ -401,6 +404,7 @@ def _note_compile(tag: str, dt: float, n_ops: int) -> None:
         rec = _TAG.setdefault(tag, [0.0, 0])
         rec[0] += dt
         rec[1] += 1
+    xferstats.bump("stage_compiles", 1, tag=tag or None)
     if n_ops > 0:
         try:     # feed the measured point into the stage-split tuner curve
             from ..plan.splittuner import model_for
@@ -451,7 +455,9 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
     # errors OUT of the trace itself (NotCompilable, emitter rejections)
     # propagate exactly as they would from jax.jit(fn)(*args) — the local
     # backend's first-call demotion ladder depends on that
-    traced = trace_m(*args)
+    with TR.span("compile:trace", "compile") as _sp:
+        _sp.set("tag", tag[:16])
+        traced = trace_m(*args)
     with _LOCK:
         STATS["traces"] += 1
     try:
@@ -461,7 +467,10 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
         # that can't be fetched/hashed): compile without caching — still
         # counted and timed, never a behavior change
         t0 = time.perf_counter()
-        compiled = _compile_with_watchdog(traced.lower(), n_ops)
+        with TR.span("compile:xla", "compile") as _sp:
+            _sp.set("tag", tag[:16]).set("n_ops", n_ops) \
+               .set("cache", "unaddressable")
+            compiled = _compile_with_watchdog(traced.lower(), n_ops)
         _note_compile(tag, time.perf_counter() - t0, n_ops)
         return compiled
 
@@ -471,14 +480,25 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
             if cached is not None:
                 _EXECS.move_to_end(fp)
                 STATS["dedup_hits"] += 1
-                return cached
-            fut = _PENDING.get(fp)
-            if fut is None:
-                fut = Future()
-                _PENDING[fp] = fut
-                break
+                fut = None
+            else:
+                fut = _PENDING.get(fp)
+                if fut is None:
+                    fut = Future()
+                    _PENDING[fp] = fut
+                    break
+        if cached is not None:
+            xferstats.bump("cache_hits", 1, tag="dedup")
+            TR.instant("compile:cache-hit", "compile",
+                       {"tag": tag[:16], "cache": "hit",
+                        "store": "in-process", "fp": fp[:12]})
+            return cached
         try:            # someone else is compiling this very fingerprint
-            return fut.result(timeout=deadline_s if deadline_s else None)
+            with TR.span("compile:queue-wait", "compile") as _sp:
+                _sp.set("tag", tag[:16]).set("join", "in-flight") \
+                   .set("fp", fp[:12])
+                return fut.result(
+                    timeout=deadline_s if deadline_s else None)
         except FutureTimeout:
             raise CompileTimeout(
                 f"waited {deadline_s:.0f}s on an in-flight compile "
@@ -499,7 +519,13 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
 
     def _compile_job():
         t0 = time.perf_counter()
-        compiled = _compile_with_watchdog(traced.lower(), n_ops)
+        with TR.span("compile:lower", "compile") as _sp:
+            _sp.set("tag", tag[:16])
+            lowered = traced.lower()
+        with TR.span("compile:xla", "compile") as _sp:
+            _sp.set("tag", tag[:16]).set("n_ops", n_ops) \
+               .set("cache", "miss").set("fp", fp[:12])
+            compiled = _compile_with_watchdog(lowered, n_ops)
         _note_compile(tag, time.perf_counter() - t0, n_ops)
         if aot_cache_enabled():
             try:
@@ -513,7 +539,11 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
         compiled = None
         if aot_cache_enabled():
             try:
-                compiled = _disk_load(fp)
+                with TR.span("compile:aot-load", "compile") as _sp:
+                    _sp.set("tag", tag[:16]).set("fp", fp[:12])
+                    compiled = _disk_load(fp)
+                    _sp.set("cache",
+                            "aot-hit" if compiled is not None else "miss")
             except Exception:
                 compiled = None
                 with _LOCK:
@@ -521,6 +551,8 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
             with _LOCK:
                 STATS["aot_hits" if compiled is not None
                       else "aot_misses"] += 1
+            xferstats.bump("cache_hits" if compiled is not None
+                           else "cache_misses", 1, tag="aot")
             if compiled is not None:
                 _publish(compiled)
         if compiled is None and deadline_s and deadline_s > 0 \
@@ -585,9 +617,24 @@ def submit_compile(fn, args: tuple, donate_argnums=(), salt: str = "",
     in-flight future instead of compiling again."""
     with _LOCK:
         STATS["pool_jobs"] += 1
-    return pool().submit(compile_traced, fn, args,
-                         donate_argnums=donate_argnums, salt=salt,
-                         tag=tag, n_ops=n_ops, deadline_s=deadline_s)
+    if not TR.enabled():
+        return pool().submit(compile_traced, fn, args,
+                             donate_argnums=donate_argnums, salt=salt,
+                             tag=tag, n_ops=n_ops, deadline_s=deadline_s)
+
+    t_sub = TR.now_us()
+
+    def _pool_job():
+        # the wait between submit and a worker picking the job up IS the
+        # pool's queue pressure — record it as a real interval so a plan
+        # whose compiles serialize behind each other shows the backlog
+        TR.complete("compile:pool-queue-wait", "compile", t_sub,
+                    TR.now_us() - t_sub, {"tag": tag[:16]})
+        return compile_traced(fn, args, donate_argnums=donate_argnums,
+                              salt=salt, tag=tag, n_ops=n_ops,
+                              deadline_s=deadline_s)
+
+    return pool().submit(_pool_job)
 
 
 # ---------------------------------------------------------------------------

@@ -40,22 +40,36 @@ class JoinExecutor:
 
     def execute(self, stage, left_partitions: list[C.Partition], context,
                 intermediate=False):
+        from ..runtime import tracing as TR
+
+        with TR.span("join:execute", "exec") as _sp:
+            res = self._execute_impl(stage, left_partitions, context,
+                                     intermediate=intermediate)
+            if _sp is not TR.NOOP:
+                _sp.set("rows_out", res.metrics.get("rows_out", 0))
+        return res
+
+    def _execute_impl(self, stage, left_partitions: list[C.Partition],
+                      context, intermediate=False):
         from ..plan.physical import plan_stages
+        from ..runtime import tracing as TR
 
         op = stage.op
         t0 = time.perf_counter()
         # --- build side: execute the right sub-plan (stage N-1) ------------
         from ..api.dataset import _source_partitions
 
-        right_stages = plan_stages(op.right, context.options_store)
-        rparts: Optional[list] = None
-        excs: list[ExceptionRecord] = []
-        for rs in right_stages:
-            if rparts is None and getattr(rs, "source", None) is not None:
-                rparts = _source_partitions(context, rs)
-            res = self.backend.execute_any(rs, rparts, context)
-            rparts = res.partitions
-            excs.extend(res.exceptions)
+        with TR.span("join:build-side", "exec"):
+            right_stages = plan_stages(op.right, context.options_store)
+            rparts: Optional[list] = None
+            excs: list[ExceptionRecord] = []
+            for rs in right_stages:
+                if rparts is None and \
+                        getattr(rs, "source", None) is not None:
+                    rparts = _source_partitions(context, rs)
+                res = self.backend.execute_any(rs, rparts, context)
+                rparts = res.partitions
+                excs.extend(res.exceptions)
 
         # one path for ALL partitions so every output shares one schema;
         # device probe when a mesh/accelerator is present (or forced)
@@ -83,14 +97,18 @@ class JoinExecutor:
         out_parts = []
         for part in left_partitions:
             self.backend.mm.touch(part)
-            if vec is not None:
-                outp = vec.probe(part, excs)
-                assert outp is not None
-            else:
-                if build is None:
-                    build = self._build_table(op, rparts or [])
-                outp = self._probe_partition(op, part, rparts or [], build,
-                                             excs)
+            with TR.span("join:probe", "exec") as _psp:
+                _psp.set("rows", part.num_rows) \
+                    .set("path", "device" if vec is not None else "host")
+                if vec is not None:
+                    outp = vec.probe(part, excs)
+                    assert outp is not None
+                else:
+                    if build is None:
+                        with TR.span("join:build-table", "exec"):
+                            build = self._build_table(op, rparts or [])
+                    outp = self._probe_partition(op, part, rparts or [],
+                                                 build, excs)
             self.backend.mm.register(outp)
             out_parts.append(outp)
         from . import compilequeue as _cq

@@ -30,7 +30,9 @@ from ..core.row import Row
 from ..plan import logical as L
 from ..plan.physical import TransformStage
 from ..runtime import columns as C
-from ..runtime.packing import PackedOuts
+from ..runtime import tracing as TR
+from ..runtime import xferstats
+from ..runtime.packing import PackedOuts, PackedStageFn
 
 
 def _get_outs(pending):
@@ -40,14 +42,15 @@ def _get_outs(pending):
 
     if isinstance(pending, PackedOuts):
         return pending.to_host()      # notes its own d2h bytes
-    outs = jax.device_get(pending)
-    try:
-        from ..runtime import xferstats
-
-        vals = outs.values() if isinstance(outs, dict) else outs
-        xferstats.note_d2h(sum(np.asarray(v).nbytes for v in vals))
-    except Exception:   # pragma: no cover - accounting is best-effort
-        pass
+    with TR.span("d2h:leaf-fetch", "xfer") as _sp:
+        outs = jax.device_get(pending)
+        try:
+            vals = outs.values() if isinstance(outs, dict) else outs
+            nb = sum(np.asarray(v).nbytes for v in vals)
+            xferstats.note_d2h(nb, tag="leaf_fetch")
+            _sp.set("bytes", nb)
+        except Exception:   # pragma: no cover - accounting is best-effort
+            pass
     return outs
 
 
@@ -65,12 +68,49 @@ class _CpuJit:
     """jit pinned to the host CPU backend: numpy args placed (and the
     executable compiled) on the CPU device regardless of the default
     accelerator — used for small resolve batches where the device
-    round-trip tax exceeds the compute."""
+    round-trip tax exceeds the compute, and for compile-budget-degraded
+    stages (plan/splittuner).
 
-    def __init__(self, fn):
+    Per-input-spec compilation routes through exec/compilequeue's
+    ``compile_traced`` (traced/lowered/compiled INSIDE the cpu
+    default_device pin), so these host compiles are counted into the
+    stage's ``compile_s``/``stage_compiles``, content-address-cached and
+    reused like any other stage executable — they used to bypass the
+    queue entirely (ROADMAP item). The "/cpupin" salt keeps the
+    fingerprints disjoint from accelerator compiles of the same jaxpr.
+    Any AOT-machinery failure falls back to the plain pinned jit; trace
+    errors (NotCompilable) propagate either way."""
+
+    def __init__(self, fn, tag: str = "", n_ops: int = 0):
         import jax
 
+        self._raw = fn
+        self._tag = tag
+        self._n_ops = n_ops
         self._fn = jax.jit(fn)
+        self._by_spec: dict = {}
+
+    def _queue_entry(self, args):
+        """(compiled-or-None, spec key) via the compile queue; None routes
+        the call to the plain pinned jit. Must run inside the cpu pin."""
+        from . import compilequeue as CQ
+
+        try:
+            avals, key = CQ._args_avals(args)
+        except Exception:
+            return None, None
+        if avals is None:
+            return None, None
+        if key in self._by_spec:
+            return self._by_spec[key], key
+        try:
+            entry = CQ.compile_traced(self._raw, avals, salt="/cpupin",
+                                      tag=self._tag, n_ops=self._n_ops,
+                                      deadline_s=0.0)
+        except (CQ._AotUnsupported, CQ.CompileTimeout):
+            entry = None
+        self._by_spec[key] = entry
+        return entry, key
 
     def __call__(self, *args, **kwargs):
         import jax
@@ -80,6 +120,15 @@ class _CpuJit:
         # default_backend() still reports the accelerator inside this
         # context, so force the CPU kernel formulations for the trace
         with jax.default_device(_cpu_device()), mxu_gather_override(False):
+            if not kwargs:
+                entry, key = self._queue_entry(args)
+                if entry is not None:
+                    try:
+                        return entry(*args)
+                    except TypeError:
+                        # call-convention mismatch (weak-type drift): pin
+                        # this spec to the plain jit like AotJit does
+                        self._by_spec[key] = None
             return self._fn(*args, **kwargs)
 
 
@@ -317,25 +366,53 @@ class LocalBackend:
         the device-resident handoff; terminal outputs only ever go to
         host). It is False or the CONSUMER KIND — "stage" / "join" /
         "agg" — so the handoff gate can be tuned per consumer
-        (jaxcfg.device_handoff_enabled)."""
+        (jaxcfg.device_handoff_enabled).
+
+        Transfer attribution happens HERE, for every stage kind: the
+        stage's xferstats delta (d2h/h2d bytes) lands on its metrics
+        record, so join/aggregate transfers count the same as transform
+        stages and `Metrics.d2hBytes()` agrees with the counter registry
+        for work done inside stages."""
         from ..plan.physical import AggregateStage, JoinStage
 
+        x_snap = xferstats.snapshot()
         if isinstance(stage, AggregateStage):
             from .aggexec import AggregateExecutor
 
-            return AggregateExecutor(self).execute(stage, partitions or [])
-        if isinstance(stage, JoinStage):
+            res = AggregateExecutor(self).execute(stage, partitions or [])
+        elif isinstance(stage, JoinStage):
             from .joinexec import JoinExecutor
 
-            return JoinExecutor(self).execute(stage, partitions or [],
-                                              context,
-                                              intermediate=intermediate)
-        return self.execute(stage, partitions or [],
-                            intermediate=intermediate)
+            res = JoinExecutor(self).execute(stage, partitions or [],
+                                             context,
+                                             intermediate=intermediate)
+        else:
+            res = self.execute(stage, partitions or [],
+                               intermediate=intermediate)
+        xd = xferstats.delta(x_snap)
+        res.metrics["d2h_bytes"] = xd["d2h_bytes"]
+        res.metrics["h2d_bytes"] = xd["h2d_bytes"]
+        return res
 
     # ------------------------------------------------------------------
     def execute(self, stage: TransformStage,
                 partitions, intermediate: bool = False) -> StageResult:
+        """Span-wrapped stage entry: one `stage:execute` span per stage
+        (runtime/tracing); transfer attribution happens in execute_any so
+        every stage kind gets it; the windowed impl below does the
+        dual-mode work."""
+        with TR.span("stage:execute", "exec") as sp:
+            if sp is not TR.NOOP:
+                sp.set("kind", type(stage).__name__)
+                sp.set("key", stage.key()[:12]).set("n_ops", len(stage.ops))
+            res = self._execute_windowed(stage, partitions, intermediate)
+            if sp is not TR.NOOP:
+                sp.set("rows_out", res.metrics.get("rows_out", 0))
+        return res
+
+    def _execute_windowed(self, stage: TransformStage,
+                          partitions,
+                          intermediate: bool = False) -> StageResult:
         """Window-pipelined dual-mode execution (reference analog:
         Executor/WorkQueue task parallelism, Executor.h:45-109 +
         LocalBackend.cc:1531-1586). Device dispatch is ASYNC — while the
@@ -761,13 +838,14 @@ class LocalBackend:
                     # predicted accelerator compile blows the budget, so it
                     # compiles on the host CPU backend instead — device
                     # transfers still happen at the stage boundary, only
-                    # the compute stays host-side. Limitation: _CpuJit
-                    # wraps a plain jit (the device pin happens at call
-                    # time), so this compile is invisible to the compile
-                    # queue's metrics/AOT store — see ROADMAP.
+                    # the compute stays host-side. _CpuJit routes the
+                    # compile through compilequeue.compile_traced (traced
+                    # under the cpu pin), so it is counted into the
+                    # stage's compile_s/stage_compiles, cached and reused.
                     return self.jit_cache.get_or_build(
                         ("stagefn", skey, use_comp, "cpupin"),
-                        lambda: _CpuJit(raw_fn)), use_comp
+                        lambda: _CpuJit(raw_fn, tag=stage.key(),
+                                        n_ops=len(stage.ops))), use_comp
                 return self.jit_cache.get_or_build(
                     ("stagefn", skey, use_comp, packed),
                     lambda: self._jit_stage_fn(raw_fn, packed=packed,
@@ -803,7 +881,25 @@ class LocalBackend:
         if device_fn is None or part.n_normal() == 0:
             return (part, None, 0.0)
         t0 = time.perf_counter()
-        batch = C.stage_partition(part, self.bucket_mode)
+        with TR.span("partition:dispatch", "exec") as _sp:
+            _sp.set("rows", part.num_rows).set("start", part.start_index)
+            batch = C.stage_partition(part, self.bucket_mode)
+            leaf_h2d = 0
+            if not isinstance(device_fn, PackedStageFn):
+                # per-leaf staging: the jit call uploads the numpy arrays
+                # (packed dispatch notes its own single-buffer H2D; arrays
+                # already device-resident — the handoff view — cost 0).
+                # Counted AFTER the call succeeds — a first-call trace
+                # failure re-enters here via _redispatch_plain and would
+                # otherwise double-count an upload that never happened
+                leaf_h2d = sum(v.nbytes for v in batch.arrays.values()
+                               if isinstance(v, np.ndarray))
+            return self._dispatch_launch(part, device_fn, skey, use_comp,
+                                         stage, packed, batch, t0,
+                                         leaf_h2d=leaf_h2d)
+
+    def _dispatch_launch(self, part, device_fn, skey, use_comp, stage,
+                         packed, batch, t0, leaf_h2d: int = 0):
         # `packed` mirrors the build-cache key: a stage built in BOTH
         # variants (handoff toggled) must not let one variant's traced
         # specs vouch for the other — a first-call trace failure would
@@ -812,7 +908,13 @@ class LocalBackend:
         spec = batch.spec()                     # jit retraces per shape
         first_call = not self.jit_cache.was_traced(cache_key, spec)
         try:
-            outs = device_fn(batch.arrays)
+            # name formatted only when tracing is on — dispatch is the
+            # per-partition hot path and the off-path must stay free
+            with TR.device_annotation(f"tpx:dispatch:{skey[:12]}"
+                                      if TR.enabled() else ""):
+                outs = device_fn(batch.arrays)
+            if leaf_h2d:
+                xferstats.note_h2d(leaf_h2d, tag="leaf_stage")
             self.jit_cache.note_traced(cache_key, spec)
             if not first_call and stage is not None \
                     and stage.source is None \
@@ -899,29 +1001,30 @@ class LocalBackend:
         lazy_data = None               # device-resident data columns (deferred)
         if pending_outs is not None:
             t0 = time.perf_counter()
-            if intermediate and isinstance(pending_outs, dict) \
-                    and type(self) is LocalBackend:
-                # handoff-bound partition: pull ONLY the control arrays
-                # ('#err'/'#keep'/compaction/fold lattice — a few KB) and
-                # leave the data columns on device. They reach the host
-                # later only if a slow path actually needs them; the clean
-                # fast path hands them straight to the next consumer
-                # (this is the boundary that cost ~0.30 s of zillow's
-                # 0.73 s over the ~50 MB/s tunnel)
-                import jax
+            with TR.span("partition:collect-fast", "exec") as _sp:
+                _sp.set("rows", n)
+                if intermediate and isinstance(pending_outs, dict) \
+                        and type(self) is LocalBackend:
+                    # handoff-bound partition: pull ONLY the control arrays
+                    # ('#err'/'#keep'/compaction/fold lattice — a few KB)
+                    # and leave the data columns on device. They reach the
+                    # host later only if a slow path actually needs them;
+                    # the clean fast path hands them straight to the next
+                    # consumer (this is the boundary that cost ~0.30 s of
+                    # zillow's 0.73 s over the ~50 MB/s tunnel)
+                    import jax
 
-                from ..runtime import xferstats
-
-                ctrl = {k: v for k, v in pending_outs.items()
-                        if k.startswith("#")}
-                outs = {k: np.asarray(v)
-                        for k, v in jax.device_get(ctrl).items()}
-                xferstats.note_d2h(
-                    sum(v.nbytes for v in outs.values()))
-                lazy_data = {k: v for k, v in pending_outs.items()
-                             if not k.startswith("#")}
-            else:
-                outs = _get_outs(pending_outs)
+                    ctrl = {k: v for k, v in pending_outs.items()
+                            if k.startswith("#")}
+                    outs = {k: np.asarray(v)
+                            for k, v in jax.device_get(ctrl).items()}
+                    xferstats.note_d2h(
+                        sum(v.nbytes for v in outs.values()),
+                        tag="handoff_ctrl")
+                    lazy_data = {k: v for k, v in pending_outs.items()
+                                 if not k.startswith("#")}
+                else:
+                    outs = _get_outs(pending_outs)
             rowidx = outs.pop("#rowidx", None)
             ovf = outs.pop("#overflow", None)
             if rowidx is not None and bool(np.asarray(ovf)):
@@ -1002,8 +1105,11 @@ class LocalBackend:
         if fallback_idx and pending_outs is not None \
                 and not self.interpret_only:
             t0 = time.perf_counter()
-            self._general_case_pass(stage, part, fallback_idx, resolved,
-                                    device_codes)
+            with TR.span("resolve:general", "exec") as _sp:
+                _sp.set("rows", len(fallback_idx))
+                self._general_case_pass(stage, part, fallback_idx, resolved,
+                                        device_codes)
+                _sp.set("resolved", len(resolved))
             metrics["general_path_s"] = time.perf_counter() - t0
 
         # ---- exact device exceptions (no-resolver fast exit) --------------
@@ -1039,38 +1145,44 @@ class LocalBackend:
         # op dispatch (reference: PythonPipelineBuilder.cc)
         t0 = time.perf_counter()
         if fallback_idx:
-            pipeline = stage.python_pipeline(part.user_columns)
-            order = sorted(fallback_idx)
-            for i, row in zip(order, C.decode_rows(part, order)):
-                status, payload = pipeline(row)
-                if status == "ok":
-                    resolved[i] = payload
-                elif status == "exc":
-                    op_id, exc_name, value = payload[:3]
-                    trace = payload[3] if len(payload) > 3 else None
-                    exc_by_row[i] = ExceptionRecord(op_id, exc_name, value,
-                                                    trace)
+            with TR.span("resolve:interpreter", "exec") as _sp:
+                _sp.set("rows", len(fallback_idx))
+                pipeline = stage.python_pipeline(part.user_columns)
+                order = sorted(fallback_idx)
+                for i, row in zip(order, C.decode_rows(part, order)):
+                    status, payload = pipeline(row)
+                    if status == "ok":
+                        resolved[i] = payload
+                    elif status == "exc":
+                        op_id, exc_name, value = payload[:3]
+                        trace = payload[3] if len(payload) > 3 else None
+                        exc_by_row[i] = ExceptionRecord(op_id, exc_name,
+                                                        value, trace)
         exceptions = [exc_by_row[i] for i in sorted(exc_by_row)]
         metrics["slow_path_s"] = time.perf_counter() - t0
 
         outp = None
-        if lazy_data is not None and not resolved:
-            # no python-spliced rows: the output partition can stay
-            # device-resident end to end (lazy host leaves + gathered view)
-            outp = self._lazy_merge(stage, part, compiled_ok, lazy_data,
-                                    src_map)
-        if outp is None:
-            if lazy_data is not None:
-                # a slow path touched this partition (or the lazy layout
-                # didn't qualify): pull the data columns after all
-                out_arrays = {k: np.asarray(v)
-                              for k, v in _get_outs(lazy_data).items()}
-            outp = self._merge(stage, part, compiled_ok, out_arrays,
-                               resolved, src_map=src_map)
-            if intermediate and device_outs is not None and not resolved \
-                    and not outp.fallback \
-                    and getattr(outp, "_gather_src", None) is not None:
-                self._attach_device_view(outp, device_outs)
+        with TR.span("partition:merge", "exec") as _msp:
+            if lazy_data is not None and not resolved:
+                # no python-spliced rows: the output partition can stay
+                # device-resident end to end (lazy host leaves + gathered
+                # view)
+                outp = self._lazy_merge(stage, part, compiled_ok, lazy_data,
+                                        src_map)
+                _msp.set("lazy", outp is not None)
+            if outp is None:
+                if lazy_data is not None:
+                    # a slow path touched this partition (or the lazy layout
+                    # didn't qualify): pull the data columns after all
+                    out_arrays = {k: np.asarray(v)
+                                  for k, v in _get_outs(lazy_data).items()}
+                outp = self._merge(stage, part, compiled_ok, out_arrays,
+                                   resolved, src_map=src_map)
+                if intermediate and device_outs is not None and not resolved \
+                        and not outp.fallback \
+                        and getattr(outp, "_gather_src", None) is not None:
+                    self._attach_device_view(outp, device_outs)
+            _msp.set("rows", outp.num_rows)
         if pending_outs is not None and fold_vals and foldok is not None \
                 and not resolved and not outp.fallback \
                 and getattr(stage, "fold_op", None) is not None:
@@ -1133,7 +1245,8 @@ class LocalBackend:
             # so build a plain single-host jit instead
             gfn = self.jit_cache.get_or_build(
                 gckey,
-                lambda: (_CpuJit if host_resolve else
+                lambda: ((lambda f: _CpuJit(f, tag=stage.key()))
+                         if host_resolve else
                          jax.jit if local_jit else
                          (lambda f: self._jit_stage_fn(
                              f, tag=stage.key())))(

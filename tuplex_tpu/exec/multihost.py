@@ -46,6 +46,13 @@ class MultiHostBackend(LocalBackend):
         self.mesh = M.make_mesh(n)
         self.n_devices = n
         self._mesh_epoch = 0    # bumped on elastic shrink
+        # span streams key their pid lane by the HOST (jax process index)
+        # so per-host dumps merge into one driver timeline without
+        # colliding; single-process runs keep the default OS pid
+        if jax.process_count() > 1:
+            from ..runtime import tracing
+
+            tracing.set_host(jax.process_index())
 
     def fn_cache_salt(self) -> str:
         """Stage-fn cache keys must change when the mesh does — a cached fn
@@ -147,7 +154,14 @@ class MultiHostBackend(LocalBackend):
                 getattr(first, "host_block", None) is not None:
             rest = list(it)
             assert not rest, "host-block sources produce one partition"
-            return self._execute_hostblock(stage, first)
+            from ..runtime import tracing as TR
+
+            with TR.span("hostblock:execute", "exec") as _sp:
+                res = self._execute_hostblock(stage, first)
+                if _sp is not TR.NOOP:
+                    _sp.set("key", stage.key()[:12])
+                    _sp.set("rows_out", res.metrics.get("rows_out", 0))
+            return res
         parts = [] if first is None else itertools.chain([first], it)
         return super().execute(stage, parts, intermediate=intermediate)
 
@@ -168,6 +182,7 @@ class MultiHostBackend(LocalBackend):
 
         from ..parallel.hostio import allgather_obj
         from ..runtime import columns as C
+        from ..runtime import tracing as TR
         from .local import ExceptionRecord, StageResult
 
         t0 = time.perf_counter()
@@ -191,7 +206,8 @@ class MultiHostBackend(LocalBackend):
                    if isinstance(leaf, C.StrLeaf)}
         mask_list = None if part.normal_mask is None \
             else part.normal_mask.tolist()
-        meta = allgather_obj({"w": local_w, "mask": mask_list})
+        with TR.span("hostblock:shape-exchange", "exec"):
+            meta = allgather_obj({"w": local_w, "mask": mask_list})
         fw = {p: max(m["w"].get(p, 8) for m in meta) for p in local_w}
 
         # ---- compiled fast path over the assembled global batch ----------
@@ -201,26 +217,29 @@ class MultiHostBackend(LocalBackend):
         err = keep = None
         if not self.interpret_only and skey not in self._not_compilable:
             try:
-                fn = self.jit_cache.get_or_build(
-                    ("stagefn", skey, bh),
-                    lambda: M.hostblock_stage_fn(
-                        stage.build_device_fn(
-                            part.schema, compaction=False,
-                            fused_fold=False),
-                        self.mesh, bh))
-                batch = C.stage_partition(part, self.bucket_mode,
-                                          force_b=bh, force_widths=fw)
-                # replicated scalars must be IDENTICAL across processes
-                # (device_put asserts it): the per-host seed derives from
-                # the host-local start_index — use the global block's
-                batch.arrays["#seed"] = C.partition_seed(
-                    C.Partition(schema=part.schema, num_rows=0,
-                                start_index=0))
-                outs = fn(batch.arrays)
-                outs = {k: M.materialize_np(v) for k, v in outs.items()}
-                err = outs.pop("#err")
-                keep = outs.pop("#keep")
-                out_arrays = outs
+                with TR.span("hostblock:fastpath", "exec") as _fsp:
+                    _fsp.set("slots", bh * nproc)
+                    fn = self.jit_cache.get_or_build(
+                        ("stagefn", skey, bh),
+                        lambda: M.hostblock_stage_fn(
+                            stage.build_device_fn(
+                                part.schema, compaction=False,
+                                fused_fold=False),
+                            self.mesh, bh))
+                    batch = C.stage_partition(part, self.bucket_mode,
+                                              force_b=bh, force_widths=fw)
+                    # replicated scalars must be IDENTICAL across processes
+                    # (device_put asserts it): the per-host seed derives
+                    # from the host-local start_index — use the global
+                    # block's
+                    batch.arrays["#seed"] = C.partition_seed(
+                        C.Partition(schema=part.schema, num_rows=0,
+                                    start_index=0))
+                    outs = fn(batch.arrays)
+                    outs = {k: M.materialize_np(v) for k, v in outs.items()}
+                    err = outs.pop("#err")
+                    keep = outs.pop("#keep")
+                    out_arrays = outs
             except NotCompilable:
                 self._not_compilable.add(skey)
         metrics["fast_path_s"] = time.perf_counter() - t0
@@ -276,9 +295,11 @@ class MultiHostBackend(LocalBackend):
                 dc = dict(zip(local_fb, unpack_device_codes(codes)))
             t1 = time.perf_counter()
             try:
-                self._general_case_pass(stage, part, fb_set,
-                                        resolved_local, device_codes=dc,
-                                        local_jit=True)
+                with TR.span("resolve:general", "exec") as _gsp:
+                    _gsp.set("rows", len(fb_set)).set("tier", "host-local")
+                    self._general_case_pass(stage, part, fb_set,
+                                            resolved_local, device_codes=dc,
+                                            local_jit=True)
             except Exception as e:
                 from ..utils.logging import get_logger
 
@@ -295,20 +316,24 @@ class MultiHostBackend(LocalBackend):
         local_fb = [i for i in local_fb
                     if i in fb_set and i not in resolved_local]
         if local_fb:
-            pipeline = stage.python_pipeline(part.user_columns)
-            for i, row in zip(local_fb, C.decode_rows(part, local_fb)):
-                status, pl = pipeline(row)
-                payload.append((lo + i, status, pl))
+            with TR.span("resolve:interpreter", "exec") as _isp:
+                _isp.set("rows", len(local_fb))
+                pipeline = stage.python_pipeline(part.user_columns)
+                for i, row in zip(local_fb, C.decode_rows(part, local_fb)):
+                    status, pl = pipeline(row)
+                    payload.append((lo + i, status, pl))
         resolved: dict = {}
         exc_by_slot: dict = {}
-        for host_payload in allgather_obj(payload):
-            for slot, status, pl in host_payload:
-                if status == "ok":
-                    resolved[slot] = pl
-                elif status == "exc":
-                    exc_by_slot[slot] = ExceptionRecord(
-                        pl[0], pl[1], pl[2],
-                        pl[3] if len(pl) > 3 else None)
+        with TR.span("hostblock:resolve-exchange", "exec") as _xsp:
+            _xsp.set("sent", len(payload))
+            for host_payload in allgather_obj(payload):
+                for slot, status, pl in host_payload:
+                    if status == "ok":
+                        resolved[slot] = pl
+                    elif status == "exc":
+                        exc_by_slot[slot] = ExceptionRecord(
+                            pl[0], pl[1], pl[2],
+                            pl[3] if len(pl) > 3 else None)
         metrics["slow_path_s"] = time.perf_counter() - t1
 
         pseudo = C.Partition(schema=part.schema, num_rows=nslots,

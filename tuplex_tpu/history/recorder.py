@@ -14,6 +14,11 @@ class JobRecorder:
     """Appends job/stage/exception events to <logDir>/tuplex_history.jsonl
     (reference events: job/stage/task/exception updates, thserver/rest.py)."""
 
+    #: spans per job embedded into the history file (waterfall rendering +
+    #: `python -m tuplex_tpu trace` replay); the tracing ring buffer keeps
+    #: the full stream — this is the per-job slice the dashboard needs
+    SPAN_EVENT_CAP = 400
+
     def __init__(self, log_dir: str, enabled: bool = True,
                  exception_display_limit: int = 5):
         self.exception_display_limit = exception_display_limit
@@ -21,6 +26,8 @@ class JobRecorder:
         self.path = os.path.join(log_dir or ".", "tuplex_history.jsonl")
         self.job_id = uuid.uuid4().hex[:12]
         self._stage_no = 0
+        self._warned_write = False
+        self._trace_mark = 0.0
 
     def _new_job(self) -> None:
         self.job_id = uuid.uuid4().hex[:12]
@@ -34,11 +41,27 @@ class JobRecorder:
         try:
             with open(self.path, "a") as fp:
                 fp.write(json.dumps(rec, default=str) + "\n")
-        except OSError:
-            pass
+        except OSError as e:
+            # warn ONCE so a bad logDir is diagnosable, then stay quiet —
+            # a recorder failure must never spam or kill the job
+            if not self._warned_write:
+                self._warned_write = True
+                from ..utils.logging import get_logger
 
-    def job_started(self, action: str, plan: list) -> None:
+                get_logger("history").warning(
+                    "history write to %s failed (%s: %s); further "
+                    "failures will be silent", self.path,
+                    type(e).__name__, e)
+
+    def job_started(self, action: str, plan: list,
+                    trace_mark: Optional[float] = None) -> None:
         self._new_job()  # each action is its own job in the dashboard
+        from ..runtime import tracing
+
+        # job_done slices spans from here; the caller passes a mark taken
+        # BEFORE its job span opened so job/plan spans make the slice
+        self._trace_mark = trace_mark if trace_mark is not None \
+            else tracing.now_us()
         previews = []
         for st in plan:
             for op in getattr(st, "ops", []) or []:
@@ -51,7 +74,11 @@ class JobRecorder:
                      "stages": [type(s).__name__ for s in plan],
                      # sample-time exception previews (reference:
                      # SampleProcessor feeding the webui BEFORE execution)
-                     "sample_exception_previews": previews})
+                     "sample_exception_previews": previews,
+                     # per-operator static-analyzer findings (the lint-
+                     # driven authoring loop: `python -m tuplex_tpu lint`
+                     # verdicts rendered per op in the dashboard)
+                     "lint": _plan_lint_findings(plan)})
 
     def stage_started(self, stage) -> None:
         """LIVE event: a stage began executing (reference: the driver posts
@@ -109,9 +136,106 @@ class JobRecorder:
                      "kind": rec.get("event", "update")})
 
     def job_done(self, rows: int, wall_s: float, exc_counts: dict) -> None:
+        self._write_job_spans()
         self._write({"event": "job_done", "rows": rows,
                      "wall_s": round(wall_s, 4),
                      "exception_counts": exc_counts})
+
+    def _write_job_spans(self) -> None:
+        """Embed this job's span slice (runtime/tracing, when enabled) into
+        the history file — the dashboard waterfall and the `trace` CLI
+        replay read it from here, so the timeline survives the process."""
+        if not self.enabled:
+            return
+        from ..runtime import tracing
+
+        evts = tracing.events_since(self._trace_mark)
+        if not evts:
+            return
+        n_total = len(evts)
+        if len(evts) > self.SPAN_EVENT_CAP:
+            # keep the top spans BY DURATION, not the first N to complete
+            # — a many-partition job's structural spans (job, stage
+            # executes, compiles) finish last and must survive the cap;
+            # only the shortest leaf spans drop. Re-sort by start so the
+            # slice stays a timeline.
+            evts = sorted(sorted(evts,
+                                 key=lambda e: -(e.get("dur") or 0.0))
+                          [: self.SPAN_EVENT_CAP],
+                          key=lambda e: e["ts"])
+        spans = [{"name": e["name"], "cat": e.get("cat", ""),
+                  "ts": round(float(e["ts"]), 1),
+                  "dur": round(float(e["dur"]), 1)
+                  if e.get("dur") is not None else 0.0,
+                  "tid": e.get("tid", 0), "depth": e.get("depth", 0),
+                  **({"args": e["args"]} if e.get("args") else {})}
+                 for e in evts]
+        self._write({"event": "spans", "n_total": n_total,
+                     "spans": spans})
+
+
+_LINT_CAP = 80
+
+
+def _plan_lint_findings(plan: list) -> list:
+    """Per-operator static-analyzer findings for the job_start record
+    (compiler/analyzer.py UDFReports, already memoized on the stages).
+    Best-effort: a lint failure must never block a job from starting."""
+    out: list = []
+    for st in plan:
+        reports = getattr(st, "udf_reports", None)
+        if reports is None:
+            continue
+        try:
+            for op, attr, rep in reports():
+                for f in rep.findings:
+                    if len(out) >= _LINT_CAP:
+                        return out
+                    out.append({
+                        "op": type(op).__name__, "op_id": op.id,
+                        "udf": f"{rep.name}.{attr}" if attr != "udf"
+                        else rep.name,
+                        "kind": f.kind, "reason": f.reason,
+                        "loc": rep.loc(f),
+                        "conditional": bool(f.conditional)})
+        except Exception:   # pragma: no cover - lint is advisory
+            continue
+    return out
+
+
+_WF_CAP = 120      # bars per job (longest-first keeps the picture honest)
+
+
+def _waterfall_html(sp_ev: dict) -> str:
+    """Span waterfall for one job: proportional bars over the job's trace
+    window, indented by nesting depth, colored by category."""
+    spans = sp_ev.get("spans", [])
+    if not spans:
+        return ""
+    t0 = min(s["ts"] for s in spans)
+    t1 = max(s["ts"] + (s.get("dur") or 0.0) for s in spans)
+    total = max(t1 - t0, 1e-6)
+    shown = sorted(spans, key=lambda s: -(s.get("dur") or 0.0))[:_WF_CAP]
+    shown.sort(key=lambda s: (s["ts"], s.get("depth", 0)))
+    bars = []
+    for s in shown:
+        left = (s["ts"] - t0) / total * 100.0
+        width = max((s.get("dur") or 0.0) / total * 100.0, 0.15)
+        dur_ms = (s.get("dur") or 0.0) / 1e3
+        cat = str(s.get("cat") or "exec")
+        label = f"{s['name']} {dur_ms:.1f}ms"
+        indent = int(s.get("depth", 0)) * 10
+        bars.append(
+            f'<div class=wfrow style="padding-left:{indent}px">'
+            f'<span class=wflabel>{html.escape(label)}</span>'
+            f'<span class=wftrack><span class="wfbar cat-'
+            f'{html.escape(cat)}" style="left:{left:.2f}%;'
+            f'width:{width:.2f}%"></span></span></div>')
+    n_total = sp_ev.get("n_total", len(spans))
+    head = (f"span waterfall — {len(shown)} of {n_total} span(s), "
+            f"{total / 1e3:.1f}ms window")
+    return (f"<details open class=waterfall><summary>{html.escape(head)}"
+            f"</summary>{''.join(bars)}</details>")
 
 
 def render_report(log_dir: str = ".", out_path: Optional[str] = None) -> str:
@@ -123,26 +247,38 @@ def render_report(log_dir: str = ".", out_path: Optional[str] = None) -> str:
     return out_path
 
 
+def _load_jobs(log_dir: str) -> dict:
+    """Parse <logDir>/tuplex_history.jsonl into {job_id: [events]} (insert
+    order preserved; undecodable lines skipped). Shared by the dashboard
+    and the Chrome-trace replay so the two read one format."""
+    src = os.path.join(log_dir or ".", "tuplex_history.jsonl")
+    jobs: dict = {}
+    if not os.path.exists(src):
+        raise FileNotFoundError(src)
+    with open(src) as fp:
+        for line in fp:
+            try:
+                r = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            jobs.setdefault(r.get("job", "?"), []).append(r)
+    return jobs
+
+
 def _render_doc(log_dir: str, live: bool) -> str:
     """Dashboard document; `live` adds the auto-refresh tag (served pages
     only — the on-disk report stays a static archival artifact)."""
     src = os.path.join(log_dir or ".", "tuplex_history.jsonl")
-    recs = []
-    if os.path.exists(src):
-        with open(src) as fp:
-            for line in fp:
-                try:
-                    recs.append(json.loads(line))
-                except json.JSONDecodeError:
-                    pass
-    jobs: dict = {}
-    for r in recs:
-        jobs.setdefault(r.get("job", "?"), []).append(r)
+    try:
+        jobs = _load_jobs(log_dir)
+    except FileNotFoundError:
+        jobs = {}
 
     rows_html = []
     for job_id, events in jobs.items():
         done = next((e for e in events if e["event"] == "job_done"), {})
         stages = [e for e in events if e["event"] == "stage"]
+        start = next((e for e in events if e["event"] == "job_start"), {})
         excs = done.get("exception_counts") or {}
         fast = sum(e["metrics"].get("fast_path_s", 0) for e in stages)
         slow = sum(e["metrics"].get("slow_path_s", 0) for e in stages)
@@ -151,8 +287,6 @@ def _render_doc(log_dir: str, live: bool) -> str:
             # progress events (the reference webui's live task updates).
             # The static archival report keeps the plain row — a crashed
             # job must not read as perpetually RUNNING there.
-            start = next((e for e in events if e["event"] == "job_start"),
-                         {})
             n_stages = len(start.get("stages", [])) or "?"
             cur = max((e["no"] for e in events
                        if e["event"] in ("stage_start", "stage")), default=0)
@@ -205,6 +339,27 @@ def _render_doc(log_dir: str, live: bool) -> str:
                 rows_html.append(
                     f"<tr class=exc><td colspan=7>↳ "
                     f"{html.escape(s)}</td></tr>")
+        # per-operator lint findings (job_start 'lint': the static
+        # analyzer's verdicts, rendered like the reference webui's
+        # per-operator detail rows)
+        for f in start.get("lint", []) or []:
+            cold = " [cold-arm]" if f.get("conditional") else ""
+            rows_html.append(
+                f"<tr class=lint><td colspan=7>⚐ "
+                f"{html.escape(str(f.get('op', '?')))}"
+                f"#{html.escape(str(f.get('op_id', '?')))} "
+                f"{html.escape(str(f.get('udf', '')))} — "
+                f"<b>{html.escape(str(f.get('kind', '')))}</b>: "
+                f"{html.escape(str(f.get('reason', '')))}"
+                f" ({html.escape(str(f.get('loc', '')))}){cold}</td></tr>")
+        # span waterfall (the 'spans' event job_done embeds when tracing
+        # was on): one bar per span, offset/width proportional to the
+        # job's trace window, lane color by category
+        sp_ev = next((e for e in events if e.get("event") == "spans"), None)
+        if sp_ev and sp_ev.get("spans"):
+            rows_html.append(
+                f"<tr class=wf><td colspan=7>{_waterfall_html(sp_ev)}"
+                f"</td></tr>")
 
     refresh = '<meta http-equiv="refresh" content="2">' if live else ""
     doc = f"""<!doctype html><meta charset="utf-8">
@@ -219,7 +374,24 @@ def _render_doc(log_dir: str, live: bool) -> str:
  tr.exc td {{ color: #a33; font-size: 12px; border-bottom: none; }}
  tr.task td {{ color: #567; font-size: 12px; border-bottom: none; }}
  tr.running td {{ color: #0a6; font-style: italic; }}
+ tr.lint td {{ color: #865; font-size: 12px; border-bottom: none; }}
+ tr.wf td {{ border-bottom: none; }}
  code {{ background: #f0f0f0; padding: 0 .3em; }}
+ .waterfall summary {{ font-size: 12px; color: #456; cursor: pointer; }}
+ .wfrow {{ display: flex; align-items: center; font-size: 11px;
+           line-height: 1.4; }}
+ .wflabel {{ flex: 0 0 260px; overflow: hidden; white-space: nowrap;
+             text-overflow: ellipsis; color: #345; }}
+ .wftrack {{ flex: 1; position: relative; height: 10px;
+             background: #f4f4f4; }}
+ .wfbar {{ position: absolute; top: 1px; height: 8px; min-width: 1px;
+           background: #8ab; }}
+ .wfbar.cat-plan {{ background: #7b6bd6; }}
+ .wfbar.cat-compile {{ background: #d6906b; }}
+ .wfbar.cat-exec {{ background: #5a9e6f; }}
+ .wfbar.cat-xfer {{ background: #4a90c2; }}
+ .wfbar.cat-mem {{ background: #c25a8a; }}
+ .wfbar.cat-job {{ background: #778; }}
 </style>
 <h1>tuplex_tpu job history</h1>
 <p>{len(jobs)} job(s) · {html.escape(src)}</p>
@@ -229,6 +401,93 @@ def _render_doc(log_dir: str, live: bool) -> str:
 {''.join(rows_html)}
 </table>"""
     return doc
+
+
+def history_to_chrome(log_dir: str = ".", out_path: str =
+                      "tuplex_trace.json") -> str:
+    """Replay the history file as one Chrome trace-event JSON: each job
+    becomes a pid lane (normalized to its own start), using the embedded
+    span slices (`spans` events, written when ``tuplex.tpu.trace`` was on)
+    and falling back to coarse stage bars synthesized from the job/stage
+    event wall-clock timestamps when a job ran without tracing."""
+    jobs = _load_jobs(log_dir)
+
+    trace_events: list = []
+    for lane, (job_id, events) in enumerate(jobs.items(), start=1):
+        trace_events.append({"name": "process_name", "ph": "M", "pid": lane,
+                             "tid": 0, "args": {"name": f"job {job_id}"}})
+        sp_ev = next((e for e in events if e.get("event") == "spans"), None)
+        if sp_ev and sp_ev.get("spans"):
+            t0 = min(s["ts"] for s in sp_ev["spans"])
+            for s in sp_ev["spans"]:
+                ev = {"name": s["name"], "cat": s.get("cat") or "exec",
+                      "ph": "X", "ts": round(s["ts"] - t0, 1),
+                      "dur": round(s.get("dur") or 0.0, 1),
+                      "pid": lane, "tid": s.get("tid", 0)}
+                if s.get("args"):
+                    ev["args"] = s["args"]
+                trace_events.append(ev)
+            continue
+        # no spans recorded: coarse bars off the event wall clocks
+        start = next((e for e in events if e.get("event") == "job_start"),
+                     None)
+        done = next((e for e in events if e.get("event") == "job_done"),
+                    None)
+        if start is None:
+            continue
+        t0 = float(start["ts"])
+        if done is not None:
+            trace_events.append({
+                "name": f"job:{start.get('action', '?')}", "cat": "job",
+                "ph": "X", "ts": 0.0,
+                "dur": round((float(done["ts"]) - t0) * 1e6, 1),
+                "pid": lane, "tid": 0,
+                "args": {"rows": done.get("rows"),
+                         "wall_s": done.get("wall_s")}})
+        starts = [e for e in events if e.get("event") == "stage_start"]
+        for st in events:
+            if st.get("event") != "stage":
+                continue
+            s0 = next((s for s in starts if s.get("no") == st.get("no")),
+                      None)
+            ts0 = float(s0["ts"]) if s0 is not None else float(st["ts"])
+            trace_events.append({
+                "name": f"stage{st.get('no', '?')}:"
+                        f"{st.get('kind', '?')}",
+                "cat": "exec", "ph": "X",
+                "ts": round((ts0 - t0) * 1e6, 1),
+                "dur": round((float(st["ts"]) - ts0) * 1e6, 1),
+                "pid": lane, "tid": 0,
+                "args": {k: v for k, v in
+                         (st.get("metrics") or {}).items()
+                         if isinstance(v, (int, float))}})
+    # multihost: merge per-host span streams (tuplex_trace_host<idx>.jsonl,
+    # dumped by every process at job end) into the same timeline. Each
+    # stream's events carry their host index as pid (tracing.set_host) —
+    # offset into a disjoint range so host lanes never collide with the
+    # job lanes numbered 1..N above. Host streams keep their own clock
+    # epoch (exact within a host; see runtime/tracing docstring).
+    import glob as _glob
+
+    from ..runtime.tracing import load_jsonl as _load_jsonl
+
+    _HOST_LANE_BASE = 1000
+    for hp in sorted(_glob.glob(os.path.join(log_dir or ".",
+                                             "tuplex_trace_host*.jsonl"))):
+        try:
+            stream = _load_jsonl(hp)
+        except OSError:
+            continue
+        for ev in stream:
+            try:
+                ev["pid"] = _HOST_LANE_BASE + int(ev.get("pid", 0))
+            except (TypeError, ValueError):
+                ev["pid"] = _HOST_LANE_BASE
+        trace_events.extend(stream)
+    obj = {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+    with open(out_path, "w") as fp:
+        json.dump(obj, fp)
+    return out_path
 
 
 def _make_server(log_dir: str, port: int, host: str):

@@ -56,6 +56,7 @@ def sharded_fold_fn(eval_exprs: Callable, reducers: Sequence[str], mesh,
     """
     from jax.sharding import PartitionSpec as P
 
+    from ..runtime import tracing as TR
     from ..runtime.jaxcfg import shard_map_compat
 
     def local_fold(arrays):
@@ -73,10 +74,12 @@ def sharded_fold_fn(eval_exprs: Callable, reducers: Sequence[str], mesh,
         # the interpreter fold
         return tuple(outs) + (ok,)
 
-    specs = _batch_specs(arrays_example, axis)
-    fn = shard_map_compat(local_fold, mesh, (specs,),
-                          tuple(P() for _ in reducers) + (P(axis),))
-    return jax.jit(fn)
+    with TR.span("collective:build-fold", "compile") as _sp:
+        _sp.set("reducers", list(reducers))
+        specs = _batch_specs(arrays_example, axis)
+        fn = shard_map_compat(local_fold, mesh, (specs,),
+                              tuple(P() for _ in reducers) + (P(axis),))
+        return jax.jit(fn)
 
 
 def sharded_segment_fold_fn(eval_exprs: Callable, reducers: Sequence[str],
@@ -114,7 +117,11 @@ def sharded_segment_fold_fn(eval_exprs: Callable, reducers: Sequence[str],
                                 num_segments=nseg + 1), axis)
         return tuple(outs) + (counts, ok)
 
-    specs = _batch_specs(arrays_example, axis)
-    fn = shard_map_compat(local_fold, mesh, (specs, P(axis)),
-                          tuple(P() for _ in reducers) + (P(), P(axis)))
-    return jax.jit(fn)
+    from ..runtime import tracing as TR
+
+    with TR.span("collective:build-segment-fold", "compile") as _sp:
+        _sp.set("reducers", list(reducers)).set("nseg", nseg)
+        specs = _batch_specs(arrays_example, axis)
+        fn = shard_map_compat(local_fold, mesh, (specs, P(axis)),
+                              tuple(P() for _ in reducers) + (P(), P(axis)))
+        return jax.jit(fn)

@@ -639,7 +639,20 @@ class JoinStage:
 
 def plan_stages(sink: L.LogicalOperator, options=None):
     """Walk the DAG sink→source splitting at pipeline breakers (reference:
-    PhysicalPlan.cc:60-238 splitIntoAndPlanStages)."""
+    PhysicalPlan.cc:60-238 splitIntoAndPlanStages). Wrapped in a `plan`
+    span (runtime/tracing) so planning cost shows up on the job timeline
+    next to compile and execute."""
+    from ..runtime import tracing as TR
+
+    with TR.span("plan", "plan") as _sp:
+        stages = _plan_stages_impl(sink, options)
+        if _sp is not TR.NOOP:
+            _sp.set("n_stages", len(stages))
+            _sp.set("kinds", [type(s).__name__ for s in stages])
+    return stages
+
+
+def _plan_stages_impl(sink: L.LogicalOperator, options=None):
     chain: list[L.LogicalOperator] = []
     limit = -1
     node = sink
@@ -1048,8 +1061,18 @@ def _split_oversize(stage: TransformStage, options) -> list:
         # the budget — flights' 43-op mega-fusion ran >20 min at >120 GB
         # on XLA:CPU, the same superlinear pathology as the tunnel.
         # Accelerators cost-minimize across the whole curve.
-        dec = ST.plan_split(n, budget, ST.model_for(),
-                            prefer_fusion=on_cpu)
+        from ..runtime import tracing as TR
+
+        with TR.span("plan:split-tune", "plan") as _sp:
+            dec = ST.plan_split(n, budget, ST.model_for(),
+                                prefer_fusion=on_cpu)
+            if _sp is not TR.NOOP:
+                # the tuner's verdict rides the span so a trace shows WHY
+                # a plan split (or degraded) without digging through logs
+                _sp.set("n_ops", n).set("k", dec.k) \
+                   .set("degrade", bool(dec.degrade)) \
+                   .set("predicted_compile_s",
+                        round(float(dec.predicted_compile_s or 0.0), 3))
         stage.split_decision = dec
         stage.predicted_compile_s = dec.predicted_compile_s
         if dec.k > 1 or dec.degrade:

@@ -476,19 +476,25 @@ class PackedOuts:
         import os
         import time
 
+        from . import tracing as TR
         from . import xferstats
 
         t0 = time.perf_counter()
-        host = np.asarray(jax.device_get(self.buf))
-        out = _unpack_host(host, self.spec)
-        fetched = host.nbytes
-        if self.vspec:
-            fetched += self._unpack_varlen(out)
-        if self.extras:
-            ex = jax.device_get(self.extras)
-            fetched += sum(np.asarray(v).nbytes for v in ex.values())
-            out.update(ex)
-        xferstats.note_d2h(fetched)
+        with TR.span("d2h:packed-fetch", "xfer") as _sp:
+            host = np.asarray(jax.device_get(self.buf))
+            out = _unpack_host(host, self.spec)
+            fetched = host.nbytes
+            if self.vspec:
+                with TR.span("d2h:varlen-unpack", "xfer") as _vsp:
+                    vb = self._unpack_varlen(out)
+                    _vsp.set("bytes", vb)
+                fetched += vb
+            if self.extras:
+                ex = jax.device_get(self.extras)
+                fetched += sum(np.asarray(v).nbytes for v in ex.values())
+                out.update(ex)
+            _sp.set("bytes", fetched)
+        xferstats.note_d2h(fetched, tag="packed_fetch")
         if os.environ.get("TUPLEX_PACK_DEBUG"):
             import sys
 
@@ -638,7 +644,13 @@ class PackedStageFn:
                   file=sys.stderr, flush=True)
             return PackedOuts(dbuf, cell["ospec"], extra_outs,
                               vbuf, cell["vspec"])
+        from . import xferstats
+
         buf = _pack_host(arrays, spec, total)
+        xferstats.note_h2d(
+            buf.nbytes + sum(np.asarray(v).nbytes
+                             for v in extras_in.values()),
+            tag="packed_dispatch")
         # explicit placement: measured 871 MB/s vs 534 MB/s letting the jit
         # call transfer its numpy argument over the tunnel
         dbuf, vbuf, extra_outs = fn(jax.device_put(buf), extras_in)

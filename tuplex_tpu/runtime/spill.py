@@ -25,6 +25,7 @@ import numpy as np
 
 from ..utils.logging import get_logger
 from . import columns as C
+from . import tracing, xferstats
 
 log = get_logger("spill")
 
@@ -195,6 +196,9 @@ class MemoryManager:
         sp = SpilledPartition(path, obj)
         self.swap_out_count += 1
         self.swapped_bytes += entry.nbytes
+        xferstats.bump("spill_bytes", entry.nbytes, tag="swap_out")
+        tracing.instant("mm:swap-out", "mem",
+                        {"rows": part.num_rows, "bytes": entry.nbytes})
         self._inmem -= entry.nbytes
         entry.nbytes = 0
         part._spilled = sp  # type: ignore[attr-defined]
@@ -209,7 +213,9 @@ class MemoryManager:
 
     def _swap_in_locked(self, part: C.Partition) -> None:
         sp = part._spilled  # type: ignore[attr-defined]
-        part.leaves = sp.load()
+        with tracing.span("mm:swap-in", "mem") as _sp:
+            part.leaves = sp.load()
+            _sp.set("rows", part.num_rows)
         part._spilled = None  # type: ignore[attr-defined]
         sp.delete()
         self.swap_in_count += 1

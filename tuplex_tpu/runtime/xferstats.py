@@ -1,15 +1,24 @@
-"""Process-wide transfer accounting for the stage-boundary data plane.
+"""Process-wide tagged counter registry for the data/compile plane.
 
-Every point that actually pulls device bytes to the host (packed-buffer
-fetch, per-leaf device_get, lazy handoff leaf materialization) notes its
-byte count here, so the D2H tunnel tax is MEASURED rather than asserted:
-bench.py reports the per-run delta as `d2h_bytes` and the varlen wire /
-device-resident handoff work is judged against it (VERDICT r5: ~0.30 s of
-a 0.73 s zillow job was boundary transfer).
+Grew out of D2H-only transfer accounting: every point that moves bytes or
+hits a cache notes it here, so the costs the perf PRs argue about are
+MEASURED rather than asserted — bench.py reports per-run deltas
+(`d2h_bytes`, `h2d_bytes`), `Metrics.as_dict()` exposes the registry, and
+the history dashboard renders it per job. Counter families today:
+
+  d2h_bytes/d2h_calls   device -> host transfers (packed-buffer fetch,
+                        per-leaf device_get, lazy handoff materialization)
+  h2d_bytes/h2d_calls   host -> device uploads (packed dispatch buffer,
+                        per-leaf staging at dispatch)
+  spill_bytes           MemoryManager swap-out volume
+  cache_hits/misses     compile-side content-address lookups (compilequeue)
 
 Counters are cumulative since process start; callers take snapshots and
-diff (same pattern as MemoryManager.metrics_snapshot). Thread safety:
-bumps happen under a lock — fetches are milliseconds, the lock is noise.
+diff (same pattern as MemoryManager.metrics_snapshot). Each bump may carry
+a call-site TAG (`note_d2h(n, tag="packed_fetch")`) — per-tag totals
+accumulate under "<name>:<tag>" and surface via ``tags()`` so a regression
+points at the site, not just the family. Thread safety: bumps happen under
+a lock — transfers are milliseconds, the lock is noise.
 """
 
 from __future__ import annotations
@@ -17,31 +26,103 @@ from __future__ import annotations
 import threading
 
 _lock = threading.Lock()
-_d2h_bytes = 0
-_d2h_calls = 0
+_counters: dict[str, int] = {}
+_tags: dict[str, int] = {}        # "name:tag" -> value
 
 
-def note_d2h(nbytes: int) -> None:
+def bump(name: str, n: int = 1, tag: str | None = None) -> None:
+    """Add `n` to counter `name` (and to its per-tag bucket when `tag` is
+    given). Zero/negative increments are dropped — a counter only ever
+    moves forward."""
+    if n <= 0:
+        return
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + int(n)
+        if tag:
+            key = f"{name}:{tag}"
+            _tags[key] = _tags.get(key, 0) + int(n)
+
+
+def counter(name: str) -> int:
+    with _lock:
+        return _counters.get(name, 0)
+
+
+def counters() -> dict:
+    """Copy of every named counter (no tags)."""
+    with _lock:
+        return dict(_counters)
+
+
+def tags() -> dict:
+    """Copy of the per-tag breakdown ("name:tag" -> value)."""
+    with _lock:
+        return dict(_tags)
+
+
+def as_dict() -> dict:
+    """Registry view for Metrics/bench: counters + per-tag breakdown."""
+    with _lock:
+        d = dict(_counters)
+        if _tags:
+            d["by_tag"] = dict(_tags)
+        return d
+
+
+def snapshot() -> dict:
+    """Point-in-time copy of all counters; feed to ``delta``."""
+    with _lock:
+        return dict(_counters)
+
+
+def delta(snap: dict) -> dict:
+    """Per-counter movement since `snap`. Always includes the transfer
+    families (zero if untouched) so callers can read d2h/h2d
+    unconditionally."""
+    with _lock:
+        cur = dict(_counters)
+    out = {k: v - snap.get(k, 0) for k, v in cur.items()}
+    for k in ("d2h_bytes", "d2h_calls", "h2d_bytes", "h2d_calls"):
+        out.setdefault(k, 0)
+    return out
+
+
+def reset() -> None:
+    """Drop every counter (tests)."""
+    with _lock:
+        _counters.clear()
+        _tags.clear()
+
+
+# -- transfer conveniences (the original xferstats API) ---------------------
+
+def note_d2h(nbytes: int, tag: str | None = None) -> None:
     """Record one host-bound transfer of `nbytes` bytes."""
-    global _d2h_bytes, _d2h_calls
     if nbytes <= 0:
         return
     with _lock:
-        _d2h_bytes += int(nbytes)
-        _d2h_calls += 1
+        _counters["d2h_bytes"] = _counters.get("d2h_bytes", 0) + int(nbytes)
+        _counters["d2h_calls"] = _counters.get("d2h_calls", 0) + 1
+        if tag:
+            key = f"d2h_bytes:{tag}"
+            _tags[key] = _tags.get(key, 0) + int(nbytes)
 
 
-def snapshot() -> tuple[int, int]:
+def note_h2d(nbytes: int, tag: str | None = None) -> None:
+    """Record one device-bound upload of `nbytes` bytes."""
+    if nbytes <= 0:
+        return
     with _lock:
-        return (_d2h_bytes, _d2h_calls)
-
-
-def delta(snap: tuple[int, int]) -> dict:
-    with _lock:
-        return {"d2h_bytes": _d2h_bytes - snap[0],
-                "d2h_calls": _d2h_calls - snap[1]}
+        _counters["h2d_bytes"] = _counters.get("h2d_bytes", 0) + int(nbytes)
+        _counters["h2d_calls"] = _counters.get("h2d_calls", 0) + 1
+        if tag:
+            key = f"h2d_bytes:{tag}"
+            _tags[key] = _tags.get(key, 0) + int(nbytes)
 
 
 def d2h_bytes() -> int:
-    with _lock:
-        return _d2h_bytes
+    return counter("d2h_bytes")
+
+
+def h2d_bytes() -> int:
+    return counter("h2d_bytes")
