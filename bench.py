@@ -282,6 +282,10 @@ def child() -> None:
         # routed to the interpreter without ever invoking the emitter
         "analyzer_ms": round(ctx.metrics.analyzerTimeMs(), 3),
         "plan_fallback_ops": ctx.metrics.planFallbackOps(),
+        # sample-free specialization: operators typed exactly from the AST
+        # and the CPython sample traces that verdict let planning skip
+        "analyzer_inferred_ops": ctx.metrics.analyzerInferredOps(),
+        "sample_traces_skipped": ctx.metrics.sampleTracesSkipped(),
     }
     if spec_env is not None:
         result["speculate_branches"] = spec_on

@@ -1,0 +1,36 @@
+"""Tier-1 lint gate over the bundled examples: `python -m tuplex_tpu lint
+--strict` must stay clean on every example script, so regressions in the
+analyzer's diagnostics (fallback verdicts, the new static-type lines, the
+dead-resolver warnings) fail the suite instead of shipping silently.
+
+Runs lint_file in-process — same code path as the CLI subcommand, without
+paying a subprocess + jax import per script."""
+
+import glob
+import io
+import os
+
+import pytest
+
+from tuplex_tpu.compiler.analyzer import lint_file
+
+_EXAMPLES_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+_SCRIPTS = sorted(
+    p for p in glob.glob(os.path.join(_EXAMPLES_DIR, "*.py"))
+    if not os.path.basename(p).startswith("_"))   # helpers, not pipelines
+
+
+def test_examples_exist():
+    assert len(_SCRIPTS) >= 6
+
+
+@pytest.mark.parametrize("script", _SCRIPTS,
+                         ids=[os.path.basename(p) for p in _SCRIPTS])
+def test_example_lints_clean_strict(script):
+    out = io.StringIO()
+    rc = lint_file(script, strict=True, stream=out)
+    assert rc == 0, (
+        f"`python -m tuplex_tpu lint --strict {script}` regressed:\n"
+        f"{out.getvalue()}")

@@ -125,6 +125,29 @@ def test_cold_arm_resolves_on_general_tier(tmp_path):
     assert calls["general"] > 0
 
 
+def test_pruned_arm_resolves_vectorized_without_decode():
+    """Regression: a parallelize stage (NO widened decode) with a pruned
+    cold arm must still offer the general tier — the non-speculating
+    re-compile — so violating rows resolve vectorized instead of falling
+    row-by-row to the interpreter. The plan-time ResolvePlan records the
+    eligibility (plan/physical.resolve_plan)."""
+    from tuplex_tpu.plan.physical import TransformStage, plan_stages
+
+    data = list(range(8000))
+    want = [_expensive_cold(x) for x in data]
+    ctx = tuplex_tpu.Context()
+    ds = ctx.parallelize(data).map(_expensive_cold)
+    st = [s for s in plan_stages(ds._op, ctx.options_store)
+          if isinstance(s, TransformStage)][0]
+    assert st.speculation_pruned()
+    assert st.resolve_plan().use_general
+    with _fallback_spy() as calls:
+        assert ds.collect() == want
+    # every cold-arm row was retired by the vectorized re-run: the per-row
+    # python pipeline was never even built
+    assert calls["general"] > 0 and calls["pipeline"] == 0
+
+
 def test_branch_profile_records_both_arms():
     data = [i % 10 for i in range(2000)]
 

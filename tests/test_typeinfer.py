@@ -1,0 +1,638 @@
+"""Sample-free specialization: abstract-interpretation type inference
+(compiler/typeinfer.py) + plan-time resolve-tier decisions
+(plan/physical.ResolvePlan) + the LRU memo fix (utils/lru.py).
+
+The acceptance bar: the zillow map/withColumn chain plans with ZERO
+cached_sample() invocations for its statically-typed operators, and an
+exact static verdict must equal what the sample trace would have
+speculated — any construct the abstract domain can't decide widens to
+undecidable and falls back to the trace, never to a wrong concrete type.
+"""
+
+import os
+
+import pytest
+
+from tuplex_tpu.compiler import typeinfer as TI
+from tuplex_tpu.core import typesys as T
+from tuplex_tpu.plan import logical as L
+from tuplex_tpu.utils.reflection import get_udf_source
+
+
+def _infer(func, **param_types):
+    """Verdict for `func` with named parameters bound to lattice types."""
+    udf = get_udf_source(func)
+    binds = {p: TI.AV(t) for p, t in param_types.items()}
+    return TI.infer_udf(udf, binds)
+
+
+# ---------------------------------------------------------------------------
+# transfer functions
+# ---------------------------------------------------------------------------
+
+def test_arithmetic_lattice():
+    assert _infer(lambda x: x + 1, x=T.I64).type is T.I64
+    assert _infer(lambda x: x + 1.5, x=T.I64).type is T.F64
+    assert _infer(lambda x: x / 2, x=T.I64).type is T.F64      # true div
+    assert _infer(lambda x: x // 2, x=T.I64).type is T.I64
+    assert _infer(lambda x: x // 2.0, x=T.I64).type is T.F64
+    assert _infer(lambda x: x % 3, x=T.I64).type is T.I64
+    assert _infer(lambda x: -x, x=T.F64).type is T.F64
+    assert _infer(lambda x: x < 3, x=T.I64).type is T.BOOL
+    # bools act as ints arithmetically
+    assert _infer(lambda x: x + True, x=T.I64).type is T.I64
+    # int ** data-dependent int may be float: must abort
+    assert _infer(lambda x: 2 ** x, x=T.I64).type is None
+    assert _infer(lambda x: 2 ** 3, x=T.I64).type is T.I64
+
+
+def test_str_chains_and_formatting():
+    assert _infer(lambda s: s.lower().strip(), s=T.STR).type is T.STR
+    assert _infer(lambda s: s.find("a"), s=T.STR).type is T.I64
+    assert _infer(lambda s: s.startswith("a"), s=T.STR).type is T.BOOL
+    v = _infer(lambda s: s.split(","), s=T.STR)
+    assert v.type is T.list_of(T.STR)
+    assert _infer(lambda s: s[1:-1], s=T.STR).type is T.STR
+    assert _infer(lambda s: "%05d" % int(s), s=T.STR).type is T.STR
+    assert _infer(lambda s: f"x={s}", s=T.STR).type is T.STR
+    assert _infer(lambda s: s + "y", s=T.STR).type is T.STR
+    assert _infer(lambda s: s * 3, s=T.STR).type is T.STR
+    # unknown method: abort, never guess
+    assert _infer(lambda s: s.frobnicate(), s=T.STR).type is None
+
+
+def test_conversions_are_type_total():
+    # rows where int()/len() raise become exception rows and leave the
+    # traced schema too, so the result type stands
+    assert _infer(lambda s: int(s), s=T.STR).type is T.I64
+    assert _infer(lambda s: float(s), s=T.STR).type is T.F64
+    assert _infer(lambda s: len(s), s=T.STR).type is T.I64
+    assert _infer(lambda x: str(x), x=T.I64).type is T.STR
+
+
+def test_row_subscripts():
+    row = T.row_of(["a", "n"], [T.STR, T.I64])
+    assert _infer(lambda x: x["n"] * 2, x=row).type is T.I64
+    assert _infer(lambda x: x["a"].upper(), x=row).type is T.STR
+    v = _infer(lambda x: x["missing"], x=row)
+    assert v.type is None and "missing" in v.why
+    # data-dependent key against a row: abort
+    assert _infer(lambda x: x[x["n"]], x=row).type is None
+
+
+def test_conditionals_join_both_arms():
+    def same_arms(x):
+        if x > 0:
+            return x + 1
+        return x - 1
+
+    assert _infer(same_arms, x=T.I64).type is T.I64
+
+    def mixed_arms(x):
+        if x > 0:
+            return 1
+        return "neg"
+
+    v = _infer(mixed_arms, x=T.I64)
+    assert v.type is None and "disagree" in v.why
+
+    def none_arm(x):
+        if x > 0:
+            return None
+        return x
+
+    # the Option SHAPE is sound but whether Nones occur is data: inexact
+    v = _infer(none_arm, x=T.I64)
+    assert v.type is None
+    assert v.shape is T.option(T.I64)
+
+
+def test_option_narrowing_matches_trace():
+    opt = T.option(T.STR)
+
+    def guarded(x):
+        if x is None:
+            return ""
+        return x.strip()
+
+    assert _infer(guarded, x=opt).type is T.STR
+
+    # passing input-schema optionality through stays exact (it was
+    # speculated from data already)
+    assert _infer(lambda x: x, x=opt).type is opt
+
+
+def test_containers_and_records():
+    assert _infer(lambda x: (x, x * 2), x=T.I64).type \
+        is T.tuple_of(T.I64, T.I64)
+    assert _infer(lambda x: [x, x + 1], x=T.I64).type is T.list_of(T.I64)
+    v = _infer(lambda x: {"a": x, "b": 2.0}, x=T.I64)
+    assert v.exact
+    # a dict literal with const str keys carries the record view: the
+    # verdict is the named ROW a dict-returning map would speculate
+    assert v.type is T.row_of(["a", "b"], [T.I64, T.F64])
+
+
+def test_undecidable_constructs_abort_cleanly():
+    g = {"data": object()}
+
+    def uses_global(x):
+        return data  # noqa: F821
+
+    udf = get_udf_source(uses_global)
+    udf.globals.update(g)
+    assert TI.infer_udf(udf, {"x": TI.AV(T.I64)}).type is None
+    # calls outside the table
+    assert _infer(lambda x: open(x), x=T.STR).type is None
+    # generators / unsupported statements
+    def gen(x):
+        yield x
+    assert _infer(gen, x=T.I64).type is None
+
+
+def test_loop_fixpoint_widen():
+    def loop(x):
+        total = 0
+        for c in x:
+            total = total + len(c)
+        return total
+
+    assert _infer(loop, x=T.list_of(T.STR)).type is T.I64
+
+    def unstable(x):
+        v = 0
+        for c in x:
+            v = c          # i64 -> str across iterations
+        return v
+
+    assert _infer(unstable, x=T.list_of(T.STR)).type is None
+
+
+# ---------------------------------------------------------------------------
+# operator-level verdicts + the sample-trace skip
+# ---------------------------------------------------------------------------
+
+def test_map_static_schema_skips_sample_trace(ctx):
+    from tuplex_tpu.compiler.analyzer import STATS
+
+    ds = ctx.parallelize([(i, f"s{i}") for i in range(50)],
+                         columns=["n", "s"]).map(lambda x: x["n"] * 2)
+    snap = dict(STATS)
+    calls = []
+    orig = L.LogicalOperator.cached_sample
+
+    def spy(self):
+        calls.append(type(self).__name__)
+        return orig(self)
+
+    L.LogicalOperator.cached_sample = spy
+    try:
+        schema = ds._op.schema()
+    finally:
+        L.LogicalOperator.cached_sample = orig
+    assert schema is T.row_of(["_0"], [T.I64])
+    assert calls == []
+    assert STATS["sample_traces_skipped"] - snap["sample_traces_skipped"] == 1
+    assert STATS["inferred_ops"] - snap["inferred_ops"] == 1
+    # and execution agrees
+    assert ds.collect() == [i * 2 for i in range(50)]
+
+
+def test_static_types_escape_hatch(ctx, monkeypatch):
+    monkeypatch.setenv("TUPLEX_STATIC_TYPES", "0")
+    ds = ctx.parallelize([1, 2, 3]).map(lambda x: x + 1)
+    assert TI.static_op_schema(ds._op) is None       # gate wins
+    calls = []
+    orig = L.LogicalOperator.cached_sample
+
+    def spy(self):
+        calls.append(1)
+        return orig(self)
+
+    L.LogicalOperator.cached_sample = spy
+    try:
+        schema = ds._op.schema()
+    finally:
+        L.LogicalOperator.cached_sample = orig
+    assert calls, "escape hatch must restore the sample trace"
+    assert schema is T.row_of(["_0"], [T.I64])
+
+
+def test_widened_verdict_falls_back_to_trace(ctx):
+    def none_arm(x):
+        if x > 2:
+            return None
+        return x
+
+    ds = ctx.parallelize([1, 2, 3, 4]).map(none_arm)
+    assert TI.static_op_schema(ds._op) is None       # widened, not guessed
+    # the trace speculates from data as before
+    assert ds._op.schema() is T.row_of(["_0"], [T.option(T.I64)])
+
+
+def test_withcolumn_and_mapcolumn_static_schema(ctx):
+    ds = ctx.parallelize([("a", 1), ("b", 2)], columns=["s", "n"])
+    wc = ds.withColumn("double", lambda x: x["n"] * 2)
+    assert TI.static_op_schema(wc._op) is T.row_of(
+        ["s", "n", "double"], [T.STR, T.I64, T.I64])
+    mc = ds.mapColumn("s", lambda v: v.upper())
+    assert TI.static_op_schema(mc._op) is T.row_of(
+        ["s", "n"], [T.STR, T.I64])
+    # dict-literal map output keeps named columns
+    dm = ds.map(lambda x: {"k": x["s"], "v": x["n"] + 0.5})
+    assert TI.static_op_schema(dm._op) is T.row_of(
+        ["k", "v"], [T.STR, T.F64])
+    assert dm.collect() == [("a", 1.5), ("b", 2.5)]
+
+
+def test_recordless_dict_map_result_widens(ctx):
+    # review regression: a map's dict result with NON-constant keys must
+    # widen — the trace names output columns from the OBSERVED keys
+    ds = ctx.parallelize(["k", "k", "k"]).map(lambda x: {x: 1})
+    v = TI.op_static_verdict(ds._op)
+    assert v is not None and not v.exact
+    assert TI.static_op_schema(ds._op) is None
+    # the traced schema names the observed key
+    assert ds._op.schema() is T.row_of(["k"], [T.I64])
+    # ...but the same dict as a withColumn CELL is exact (the trace types
+    # the cell via infer_type -> Dict, which the abstract value matches)
+    wc = ctx.parallelize([("a", 1)], columns=["s", "n"]) \
+        .withColumn("d", lambda x: {x["s"]: x["n"]})
+    assert TI.static_op_schema(wc._op) is T.row_of(
+        ["s", "n", "d"], [T.STR, T.I64, T.dict_of(T.STR, T.I64)])
+
+
+def test_preview_pass_is_idempotent(ctx):
+    # review regression: a clean statically-typed UDF must not re-run the
+    # sample on every job_started when the dashboard is enabled
+    from tuplex_tpu.plan.logical import preview_sample_exceptions
+
+    ds = ctx.parallelize([1, 2, 3]).map(lambda x: x + 1)
+    ds._op.schema()
+    assert getattr(ds._op, "_sample_trace_skipped", False)
+    assert preview_sample_exceptions(ds._op) == []
+    calls = []
+    orig = L.LogicalOperator.cached_sample
+
+    def spy(self):
+        calls.append(1)
+        return orig(self)
+
+    L.LogicalOperator.cached_sample = spy
+    try:
+        assert preview_sample_exceptions(ds._op) == []   # second job
+    finally:
+        L.LogicalOperator.cached_sample = orig
+    assert calls == []
+
+
+# ---------------------------------------------------------------------------
+# ACCEPTANCE: zillow plans sample-free; soundness over all bundled models
+# ---------------------------------------------------------------------------
+
+def _udf_ops(sink):
+    out, seen, stack = [], set(), [sink]
+    while stack:
+        op = stack.pop()
+        if id(op) in seen:
+            continue
+        seen.add(id(op))
+        if isinstance(op, (L.MapOperator, L.WithColumnOperator,
+                           L.MapColumnOperator)):
+            out.append(op)
+        stack.extend(getattr(op, "parents", ()))
+    return out
+
+
+def test_zillow_chain_plans_with_zero_sample_traces(ctx, tmp_path):
+    from tuplex_tpu.models import zillow
+
+    path = str(tmp_path / "z.csv")
+    zillow.generate_csv(path, 300, seed=42)
+    ds = zillow.build_pipeline(ctx.csv(path))
+    udf_ops = _udf_ops(ds._op)
+    assert len(udf_ops) >= 8
+    # every map/withColumn/mapColumn in the chain is statically typed
+    for op in udf_ops:
+        v = TI.op_static_verdict(op)
+        assert v is not None and v.exact, \
+            f"{type(op).__name__} not statically typed: {v}"
+    calls = []
+    orig = L.LogicalOperator.cached_sample
+
+    def spy(self):
+        calls.append(type(self).__name__)
+        return orig(self)
+
+    L.LogicalOperator.cached_sample = spy
+    try:
+        ds._op.schema()
+    finally:
+        L.LogicalOperator.cached_sample = orig
+    assert calls == [], \
+        f"schema inference ran the sample trace via: {calls}"
+
+
+def test_zillow_static_schema_equals_traced(ctx, tmp_path, monkeypatch):
+    from tuplex_tpu.models import zillow
+
+    path = str(tmp_path / "z.csv")
+    zillow.generate_csv(path, 300, seed=42)
+    static_schema = zillow.build_pipeline(ctx.csv(path))._op.schema()
+    # a content-identical rebuild with inference disabled AND the cross-job
+    # memo cleared must trace its way to the same schema
+    monkeypatch.setenv("TUPLEX_STATIC_TYPES", "0")
+    L._cross_job_schemas.clear()
+    L._cross_job_samples.clear()
+    traced_schema = zillow.build_pipeline(ctx.csv(path))._op.schema()
+    assert static_schema is traced_schema
+
+
+def _assert_sound(ctx, ds):
+    """Property: every EXACT verdict equals the traced schema — except
+    where the trace had zero successful sample outputs (its PYOBJECT
+    degradation carries no evidence; the static verdict is strictly
+    better-informed there)."""
+    n_exact = 0
+    for op in _udf_ops(ds._op):
+        v = TI.op_static_verdict(op)
+        if v is None or not v.exact:
+            continue
+        n_exact += 1
+        static = TI.static_op_schema(op)
+        if static is None:
+            continue
+        traced = op._infer_schema()
+        if static is not traced:
+            outs = []
+            for r in op.parent.cached_sample():
+                try:
+                    outs.append(L.apply_udf_python(op.udf, r))
+                except Exception:
+                    pass
+            assert not outs, (
+                f"unsound verdict for {type(op).__name__} "
+                f"({op.udf.name}): static={static.name} "
+                f"traced={traced.name} over {len(outs)} sample outputs")
+    return n_exact
+
+
+def test_soundness_zillow(ctx, tmp_path):
+    from tuplex_tpu.models import zillow
+
+    path = str(tmp_path / "z.csv")
+    zillow.generate_csv(path, 300, seed=42)
+    assert _assert_sound(ctx, zillow.build_pipeline(ctx.csv(path))) >= 8
+
+
+def test_soundness_flights(ctx, tmp_path):
+    from tuplex_tpu.models import flights
+
+    perf = str(tmp_path / "flights.csv")
+    carrier = str(tmp_path / "carrier.csv")
+    airport = str(tmp_path / "airports.txt")
+    flights.generate_perf_csv(perf, 300, seed=2)
+    flights.generate_carrier_csv(carrier)
+    flights.generate_airport_db(airport)
+    _assert_sound(ctx, flights.build_pipeline(ctx, perf, carrier, airport))
+
+
+def test_soundness_nyc311(ctx, tmp_path):
+    from tuplex_tpu.models import nyc311
+
+    path = str(tmp_path / "n.csv")
+    nyc311.generate_csv(path, 300)
+    _assert_sound(ctx, nyc311.build_pipeline(ctx, path))
+
+
+@pytest.mark.parametrize("mode", ["strip", "regex"])
+def test_soundness_logs(ctx, tmp_path, mode):
+    from tuplex_tpu.models import logs
+
+    path = str(tmp_path / "logs.txt")
+    logs.generate_log(path, 300)
+    _assert_sound(ctx, logs.build_pipeline(ctx.text(path), mode))
+
+
+def test_soundness_tpch(ctx, tmp_path):
+    from tuplex_tpu.models import tpch
+
+    li = str(tmp_path / "li.csv")
+    tpch.generate_csv(li, 300, seed=4)
+    _assert_sound(ctx, tpch.q6(ctx.csv(li)))
+    _assert_sound(ctx, tpch.q1(ctx.csv(li)))
+
+
+# ---------------------------------------------------------------------------
+# plan-time resolve tiers + per-code buffers
+# ---------------------------------------------------------------------------
+
+def _transform_stages(ds):
+    from tuplex_tpu.plan.physical import TransformStage, plan_stages
+
+    return [s for s in plan_stages(ds._op, ds._context.options_store)
+            if isinstance(s, TransformStage)]
+
+
+def test_resolve_plan_no_decode_no_general(ctx):
+    st = _transform_stages(
+        ctx.parallelize(["1", "x", "3"]).map(lambda s: int(s)))[0]
+    rp = st.resolve_plan()
+    from tuplex_tpu.core.errors import ExceptionCode as EC
+
+    assert not rp.use_general            # nothing widened to re-decode
+    assert int(EC.VALUEERROR) in rp.codes
+    assert not rp.interpreter_possible   # exact class, no resolver
+    assert rp.tier == "exact-exit"
+    # with a resolver the interpreter tier is back in play
+    st2 = _transform_stages(
+        ctx.parallelize(["1", "x", "3"]).map(lambda s: int(s))
+        .resolve(ValueError, lambda s: -1))[0]
+    assert st2.resolve_plan().tier == "interpreter"
+
+
+def test_resolve_plan_statically_clean_stage_is_tier_none(ctx):
+    st = _transform_stages(
+        ctx.parallelize([1, 2, 3]).map(lambda x: x + 1))[0]
+    assert st.resolve_plan().tier == "none"
+    assert st.resolve_plan().codes == ()
+
+
+def test_resolve_plan_dirty_csv_uses_general(ctx, tmp_path):
+    p = tmp_path / "d.csv"
+    rows = ["a,price"] + [f"c{i},{i}" for i in range(200)] + ["cx,N/A"] * 9
+    p.write_text("\n".join(rows) + "\n")
+    ds = ctx.csv(str(p)).withColumn("eur",
+                                    lambda x: int(x["price"]) * 2)
+    stages = _transform_stages(ds)
+    rp = stages[0].resolve_plan()
+    assert rp.use_general
+    assert rp.tier == "general+interpreter"
+    # and the tiers actually fire end-to-end
+    out = ds.collect()
+    assert len(out) == 200   # N/A rows become exceptions
+
+
+def test_resolve_buffers_bucketing():
+    import numpy as np
+
+    from tuplex_tpu.core.errors import ExceptionCode as EC, pack_device_code
+    from tuplex_tpu.plan.physical import ResolveBuffers
+
+    bufs = ResolveBuffers([EC.VALUEERROR, EC.NORMALCASEVIOLATION])
+    idx = np.array([3, 7, 11, 20])
+    packed = np.array([pack_device_code(EC.VALUEERROR, 2),
+                       pack_device_code(EC.NORMALCASEVIOLATION, 2),
+                       pack_device_code(EC.KEYERROR, 5),   # not in inventory
+                       pack_device_code(EC.VALUEERROR, 9)])
+    bufs.add_many(idx, packed)
+    assert bufs.by_code[int(EC.VALUEERROR)] == [
+        (3, int(EC.VALUEERROR), 2), (20, int(EC.VALUEERROR), 9)]
+    assert bufs.by_code[int(EC.NORMALCASEVIOLATION)] == [
+        (7, int(EC.NORMALCASEVIOLATION), 2)]
+    assert bufs.other == [(11, int(EC.KEYERROR), 5)]
+    # catch-all: attribution degrades,
+    # routing does not
+    assert [i for i, _, _ in bufs.exact_rows()] == [3, 11, 20]
+    assert [i for i, _, _ in bufs.internal_rows()] == [7]
+
+
+def test_general_tier_skip_does_not_change_results(ctx):
+    # a map whose rows raise an exact Python class: with no resolver the
+    # plan's exact-exit handles them without any re-run tier
+    ds = ctx.parallelize([2, 1, 0, 4]).map(lambda x: 10 // x)
+    out = ds.collect()
+    assert out == [5, 10, 2]
+    assert ds.exception_counts() == {"ZeroDivisionError": 1}
+
+
+# ---------------------------------------------------------------------------
+# dead-resolver lint
+# ---------------------------------------------------------------------------
+
+def test_dead_resolver_flagged_at_plan_time(ctx):
+    ds = (ctx.parallelize([1, 2, 3])
+          .map(lambda x: x + 1)
+          .resolve(ZeroDivisionError, lambda x: -1))
+    st = _transform_stages(ds)[0]
+    findings = st.dead_resolver_findings()
+    assert len(findings) == 1
+    rop, gop, reason = findings[0]
+    assert "ZeroDivisionError" in reason
+
+
+def test_unknown_callee_blocks_dead_resolver_proof(ctx):
+    # review regression: an unknown captured callee can raise the target
+    # even when the type verdict is exact (Undecidable is swallowed in
+    # type-total contexts like comparisons) — the proof must come from
+    # the call whitelist, so no warning here
+    def foo(x):
+        return {"a": 1}[x]
+
+    ds = (ctx.parallelize(["a", "b"])
+          .map(lambda x: foo(x) > 0)
+          .resolve(KeyError, lambda x: False))
+    assert _transform_stages(ds)[0].dead_resolver_findings() == []
+
+
+def test_live_resolver_not_flagged(ctx):
+    ds = (ctx.parallelize([1, 2, 3])
+          .map(lambda x: 10 // (x - 1))
+          .resolve(ZeroDivisionError, lambda x: -1))
+    assert _transform_stages(ds)[0].dead_resolver_findings() == []
+    # ValueError is outside the provable set (total calls can raise it)
+    ds2 = (ctx.parallelize([1, 2, 3])
+           .map(lambda x: x + 1)
+           .resolve(ValueError, lambda x: -1))
+    assert _transform_stages(ds2)[0].dead_resolver_findings() == []
+
+
+def test_dead_resolver_in_lint_cli(tmp_path, capsys):
+    from tuplex_tpu.compiler import analyzer as az
+
+    p = tmp_path / "pipe.py"
+    p.write_text(
+        "import tuplex_tpu as tuplex\n"
+        "c = tuplex.Context()\n"
+        "ds = (c.parallelize([1, 2, 3])\n"
+        "      .map(lambda x: x + 1)\n"
+        "      .resolve(ZeroDivisionError, lambda x: -1))\n")
+    rc = az.lint_file(str(p))
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "dead resolver" in out
+    assert "1 dead resolver(s)" in out
+    # --strict: dead resolvers fail the gate
+    assert az.lint_file(str(p), strict=True) == 1
+
+
+def test_lint_reports_static_type_verdicts(tmp_path, capsys):
+    from tuplex_tpu.compiler import analyzer as az
+
+    p = tmp_path / "pipe.py"
+    p.write_text(
+        "import tuplex_tpu as tuplex\n"
+        "c = tuplex.Context()\n"
+        "ds = c.parallelize(['1']).map(lambda s: int(s) * 2)\n")
+    assert az.lint_file(str(p)) == 0
+    out = capsys.readouterr().out
+    assert "statically typed: yes — i64" in out
+
+
+def test_explain_lint_shows_typed_and_tier(ctx, capsys):
+    ds = ctx.parallelize([(1, "a"), (2, "b")], columns=["n", "s"]) \
+        .map(lambda x: x["n"] * 2)
+    text = ds.explain(lint=True)
+    assert "statically typed: yes — i64" in text
+    assert "resolve tier:" in text
+
+
+# ---------------------------------------------------------------------------
+# metrics surface
+# ---------------------------------------------------------------------------
+
+def test_metrics_carry_inference_counters(ctx):
+    ds = ctx.parallelize([(i, f"s{i}") for i in range(20)],
+                         columns=["n", "s"]).map(lambda x: x["n"] + 1)
+    ds.collect()
+    m = ctx.metrics.as_dict()
+    assert m["analyzer_inferred_ops"] >= 1
+    assert m["sample_traces_skipped"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# LRU memo fix (utils/lru.py)
+# ---------------------------------------------------------------------------
+
+def test_lru_dict_evicts_one_not_all():
+    from tuplex_tpu.utils.lru import LruDict
+
+    d = LruDict(4)
+    for i in range(4):
+        d[f"k{i}"] = i
+    assert d.get("k0") == 0          # refresh k0's recency
+    d["k4"] = 4                      # one insert past the cap
+    assert len(d) == 4               # ONE eviction, not wholesale
+    assert "k1" not in d             # oldest unrefreshed entry left
+    assert d.get("k0") == 0 and d.get("k4") == 4
+
+
+def test_cross_job_schema_memo_survives_cap(ctx, tmp_path):
+    # regression for the wholesale .clear(): one insert past the cap must
+    # evict exactly one entry, keeping the warm schemas
+    memo = L._cross_job_schemas
+    memo.clear()
+    for i in range(memo.capacity):
+        memo[f"warm{i}"] = i
+    memo["one-more"] = 1
+    assert len(memo) == memo.capacity
+    assert sum(1 for i in range(memo.capacity)
+               if f"warm{i}" in memo) == memo.capacity - 1
+    memo.clear()
+
+
+def test_lru_rejects_bad_capacity():
+    from tuplex_tpu.utils.lru import LruDict
+
+    with pytest.raises(ValueError):
+        LruDict(0)

@@ -14,8 +14,10 @@ TuplexShell, launched by the `tuplex` console entry point). Subcommands:
 `lint` runs the compiler's static analyzer (compiler/analyzer.py) over every
 UDF the script hands to DataSet methods — purely syntactic, the script is
 never imported or executed — and prints per-UDF fallback, exception-site,
-and purity findings with file:line locations. `--strict` exits non-zero
-when any fallback finding exists.
+purity, and static-type findings with file:line locations, plus
+dead-resolver warnings (a resolve()/ignore() targeting an error the
+guarded UDF provably cannot raise). `--strict` exits non-zero when any
+fallback finding or dead resolver exists.
 
 `compilestats` imports the script with actions stubbed out (no stage
 executes, nothing compiles), plans each action, and prints per-stage op
@@ -40,7 +42,8 @@ def main(argv=None) -> int:
         "lint", help="static-analyze the UDFs of a pipeline script")
     lint.add_argument("script", help="path to a python pipeline script")
     lint.add_argument("--strict", action="store_true",
-                      help="exit non-zero on any fallback finding")
+                      help="exit non-zero on any fallback finding or "
+                           "dead resolver")
     cs = sub.add_parser(
         "compilestats",
         help="per-stage op counts, predicted compile seconds, dedup groups")

@@ -25,6 +25,14 @@ class Context:
                                             else None, **kwargs)
         if isinstance(conf, str):
             self.options_store.update(conf)
+        # sample-free specialization gate (compiler/typeinfer.py): like
+        # tracing, the flag is process-wide — planning code paths have no
+        # Context handle at schema-inference depth. TUPLEX_STATIC_TYPES
+        # env (checked inside typeinfer.enabled) overrides either way.
+        from ..compiler import typeinfer as _ti
+
+        _ti.set_enabled(self.options_store.get_bool(
+            "tuplex.tpu.staticTypes", True))
         if self.options_store.get_bool("tuplex.tpu.trace", False):
             # span tracing is process-wide (spans cross backend/compile-
             # pool threads); the option turns it on, never off — another

@@ -291,7 +291,9 @@ class DataSet:
             azd = _az.delta(azsnap)
             self._context.metrics.record_plan({
                 "analyzer_ms": azd["analyze_ms"],
-                "plan_fallback_ops": azd["plan_fallback_ops"]})
+                "plan_fallback_ops": azd["plan_fallback_ops"],
+                "analyzer_inferred_ops": azd["inferred_ops"],
+                "sample_traces_skipped": azd["sample_traces_skipped"]})
             backend = self._context.backend
             recorder = self._context.recorder
             recorder.job_started(

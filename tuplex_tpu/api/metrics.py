@@ -28,6 +28,18 @@ class Metrics:
         verdict (the emitter was never invoked for them)."""
         return sum(int(m.get("plan_fallback_ops", 0)) for m in self.plans)
 
+    def analyzerInferredOps(self) -> int:
+        """Operators whose output type the abstract interpreter
+        (compiler/typeinfer.py) decided EXACTLY from the UDF AST."""
+        return sum(int(m.get("analyzer_inferred_ops", 0))
+                   for m in self.plans)
+
+    def sampleTracesSkipped(self) -> int:
+        """CPython sample traces schema inference skipped because the
+        static verdict was exact (sample-free specialization)."""
+        return sum(int(m.get("sample_traces_skipped", 0))
+                   for m in self.plans)
+
     # -- totals (JobMetrics getters) ----------------------------------------
     @property
     def totalExceptionCount(self) -> int:
@@ -128,6 +140,8 @@ class Metrics:
             "exception_rows": self.totalExceptionCount,
             "analyzer_ms": self.analyzerTimeMs(),
             "plan_fallback_ops": self.planFallbackOps(),
+            "analyzer_inferred_ops": self.analyzerInferredOps(),
+            "sample_traces_skipped": self.sampleTracesSkipped(),
             "d2h_bytes": self.d2hBytes(),
             "h2d_bytes": self.h2dBytes(),
             # the process-wide tagged counter registry (runtime/xferstats):

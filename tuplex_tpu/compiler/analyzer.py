@@ -75,6 +75,14 @@ class UDFReport:
     findings: list = dataclasses.field(default_factory=list)
     deterministic: bool = True
     mutates_globals: bool = False
+    # sample-free specialization verdict (compiler/typeinfer.py): the
+    # statically inferred result type when the abstract interpreter decided
+    # it EXACTLY, else None with `inferred_why` explaining what aborted.
+    # Stamped per-operator (a per-op report COPY — two operators sharing a
+    # code object may see different input schemas) by op_static_verdict,
+    # and by lint_file in schema-free lint mode.
+    inferred_type: Any = None
+    inferred_why: str = ""
 
     # -- verdicts ----------------------------------------------------------
     @property
@@ -132,8 +140,25 @@ class UDFReport:
         return f"{self.name}({', '.join(self.params)}) " \
                f"[{self.filename}:{self.line_base}] — {path}; {purity}"
 
+    @property
+    def statically_typed(self) -> bool:
+        return self.inferred_type is not None
+
+    def typed_line(self) -> Optional[str]:
+        """"statically typed: yes/no + why not" — None when inference never
+        ran for this report (e.g. aggregate/join UDFs it does not cover)."""
+        if self.inferred_type is not None:
+            return f"statically typed: yes — {self.inferred_type.name} " \
+                   "(sample trace skipped)"
+        if self.inferred_why:
+            return f"statically typed: no — {self.inferred_why}"
+        return None
+
     def format(self, indent: str = "") -> list:
         out = [indent + self.verdict_line()]
+        tl = self.typed_line()
+        if tl is not None:
+            out.append(f"{indent}  typed     {tl}")
         for f in self.fallback_findings:
             cond = " [cold-arm: trace probe decides]" if f.conditional else ""
             out.append(f"{indent}  fallback  {self.loc(f)}: {f.reason}{cond}")
@@ -504,7 +529,11 @@ def analyze_tree(node: ast.AST, name: str = "<udf>",
 # runtime entry points (UDFSource / operators / plans)
 # ===========================================================================
 
-STATS = {"analyze_calls": 0, "analyze_ms": 0.0, "plan_fallback_ops": 0}
+STATS = {"analyze_calls": 0, "analyze_ms": 0.0, "plan_fallback_ops": 0,
+         # sample-free specialization (compiler/typeinfer.py): operators
+         # whose output type the abstract interpreter decided exactly, and
+         # how many CPython sample traces that verdict let planning skip
+         "inferred_ops": 0, "sample_traces_skipped": 0}
 
 
 def snapshot() -> dict:
@@ -515,7 +544,12 @@ def delta(snap: dict) -> dict:
     return {k: STATS[k] - snap.get(k, 0) for k in STATS}
 
 
-_udf_memo: dict = {}   # (code object, globals signature) -> UDFReport
+# (code object, globals signature) -> UDFReport. LRU: the old grow-then-
+# .clear() pattern dropped every warm report the moment one insert crossed
+# the cap (utils/lru.py — same fix as the plan/logical.py schema memos)
+from ..utils.lru import LruDict
+
+_udf_memo: LruDict = LruDict(4096)
 
 
 def _globals_sig(globs: dict) -> tuple:
@@ -561,8 +595,6 @@ def analyze_udf(udf) -> UDFReport:
     STATS["analyze_calls"] += 1
     STATS["analyze_ms"] += (time.perf_counter() - t0) * 1e3
     if key is not None:
-        if len(_udf_memo) > 4096:
-            _udf_memo.clear()
         _udf_memo[key] = rpt
     return rpt
 
@@ -618,6 +650,91 @@ def chain_deterministic(op) -> bool:
 
 
 # ===========================================================================
+# dead-resolver lint (ROADMAP "lint-driven authoring loop")
+# ===========================================================================
+
+# Codes whose raising constructs the analyzer inventories EXHAUSTIVELY
+# within the statically-typed subset: subscripts (KeyError/IndexError — one
+# group, since a variable-keyed dict subscript classifies as INDEXERROR),
+# division, assert/raise. ValueError & friends are deliberately absent:
+# known-total calls like str.index or math.sqrt raise them without an
+# inventory entry, so "not in the inventory" proves nothing there.
+_DEAD_RESOLVER_GROUPS = (
+    frozenset({ExceptionCode.KEYERROR, ExceptionCode.INDEXERROR}),
+    frozenset({ExceptionCode.ZERODIVISIONERROR}),
+    frozenset({ExceptionCode.ASSERTIONERROR}),
+)
+
+#: builtins the abstract interpreter treats as type-total — calls to these
+#: cannot raise the _DEAD_RESOLVER_GROUPS codes
+_KNOWN_TOTAL_CALLS = {"int", "float", "str", "bool", "len", "ord", "repr",
+                      "abs", "min", "max", "round", "sum", "chr", "sorted"}
+
+
+def dead_resolver_reason(rep: UDFReport, exc_class=None, code=None,
+                         exc_name: str = "",
+                         fully_typed: bool = False) -> Optional[str]:
+    """Reason string when a ``resolve(exc_class)`` / ``ignore(exc_class)``
+    guarding the operator described by `rep` is PROVABLY dead, else None.
+
+    The proof is deliberately narrow: the target class must map to a code
+    whose raisers the inventory covers exhaustively (_DEAD_RESOLVER_GROUPS),
+    the UDF must carry no fallback findings, and `fully_typed` must assert
+    that every call in the body is in the known-pure tables (the abstract
+    interpreter's exact verdict at plan time; a syntactic call whitelist in
+    schema-free lint mode) — otherwise an unknown callee could smuggle the
+    exception in and the warning would be wrong."""
+    if not fully_typed or rep.must_fallback:
+        return None
+    if code is None and exc_class is not None:
+        from ..core.errors import code_for_exception_class
+
+        code = code_for_exception_class(exc_class)
+        exc_name = exc_name or getattr(exc_class, "__name__", "?")
+    if code is None:
+        return None
+    group = next((g for g in _DEAD_RESOLVER_GROUPS if code in g), None)
+    if group is None:
+        return None
+    if rep.exception_codes() & group:
+        return None
+    return (f"dead resolver: targets {exc_name or code.name}, but "
+            f"{rep.name}'s exception inventory proves it can never "
+            f"raise it")
+
+
+def _calls_all_known(node: ast.AST, module_names: dict) -> bool:
+    """Schema-free stand-in for the abstract interpreter's exact verdict:
+    every call in the UDF body is a known-total builtin, a method name
+    from the interpreter's pure tables, or a pure-table module function.
+    Those callees can raise ValueError-family errors but none of the
+    _DEAD_RESOLVER_GROUPS codes."""
+    from .typeinfer import (_MODULE_FNS, _STR_TO_BOOL, _STR_TO_I64,
+                            _STR_TO_LIST, _STR_TO_STR)
+
+    known_methods = (_STR_TO_STR | _STR_TO_I64 | _STR_TO_BOOL
+                     | _STR_TO_LIST
+                     | {"partition", "rpartition", "get", "keys", "values",
+                        "index", "count", "format"})
+    for n in ast.walk(node):
+        if not isinstance(n, ast.Call):
+            continue
+        fn = n.func
+        if isinstance(fn, ast.Name) and fn.id in _KNOWN_TOTAL_CALLS:
+            continue
+        if isinstance(fn, ast.Attribute):
+            if isinstance(fn.value, ast.Name) \
+                    and fn.value.id in module_names:
+                if (module_names[fn.value.id], fn.attr) in _MODULE_FNS:
+                    continue
+                return False
+            if fn.attr in known_methods:
+                continue
+        return False
+    return True
+
+
+# ===========================================================================
 # `python -m tuplex_tpu lint` — static lint of a pipeline script
 # ===========================================================================
 
@@ -625,19 +742,26 @@ _UDF_METHODS = {"map", "filter", "withColumn", "mapColumn", "resolve",
                 "aggregate", "aggregateByKey"}
 
 
-def _collect_script_udfs(tree: ast.Module):
-    """(node, name) for every UDF passed to a DataSet-shaped method call:
-    inline lambdas plus module-level defs/lambda-assignments referenced by
-    name. Purely syntactic — the script is never imported or executed."""
+def _script_module_fns(tree: ast.Module) -> dict:
+    """{name -> Lambda/FunctionDef node} for every def / lambda-assignment
+    in the script (incl. defs nested inside functions — a UDF defined in
+    main() must not silently escape a --strict gate)."""
     module_fns: dict = {}
-    for s in ast.walk(tree):   # incl. defs nested inside functions — a UDF
-        # defined in main() must not silently escape a --strict gate
+    for s in ast.walk(tree):
         if isinstance(s, ast.FunctionDef):
             module_fns.setdefault(s.name, s)
         elif isinstance(s, ast.Assign) and isinstance(s.value, ast.Lambda):
             for t in s.targets:
                 if isinstance(t, ast.Name):
                     module_fns.setdefault(t.id, s.value)
+    return module_fns
+
+
+def _collect_script_udfs(tree: ast.Module):
+    """(node, name) for every UDF passed to a DataSet-shaped method call:
+    inline lambdas plus module-level defs/lambda-assignments referenced by
+    name. Purely syntactic — the script is never imported or executed."""
+    module_fns = _script_module_fns(tree)
     out, seen = [], set()
 
     def add(node, name):
@@ -655,6 +779,57 @@ def _collect_script_udfs(tree: ast.Module):
             elif isinstance(a, ast.Name) and a.id in module_fns:
                 add(module_fns[a.id], a.id)
     return sorted(out, key=lambda p: getattr(p[0], "lineno", 0))
+
+
+def _script_dead_resolvers(tree: ast.Module, module_names: dict,
+                           path: str) -> list:
+    """Syntactic dead-resolver findings: `X.resolve(Exc, fn)` /
+    `X.ignore(Exc)` chained directly after a UDF-carrying DataSet method
+    whose exception inventory provably cannot raise Exc. Returns
+    "file:line: reason" strings. Purely syntactic, same soundness bar as
+    dead_resolver_reason (the schema-free `fully_typed` proxy is the
+    known-call whitelist)."""
+    from ..core.errors import code_for_name
+
+    module_fns = _script_module_fns(tree)
+    out = []
+    for n in ast.walk(tree):
+        if not (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("resolve", "ignore")
+                and n.args and isinstance(n.args[0], ast.Name)):
+            continue
+        code = code_for_name(n.args[0].id)
+        # the guarded call: walk down through stacked resolve/ignore links
+        recv = n.func.value
+        while (isinstance(recv, ast.Call)
+               and isinstance(recv.func, ast.Attribute)
+               and recv.func.attr in ("resolve", "ignore")):
+            recv = recv.func.value
+        if not (isinstance(recv, ast.Call)
+                and isinstance(recv.func, ast.Attribute)
+                and recv.func.attr in (_UDF_METHODS - {"resolve"})):
+            continue
+        udf_node = udf_name = None
+        for a in recv.args:
+            if isinstance(a, ast.Lambda):
+                udf_node, udf_name = a, "<lambda>"
+                break
+            if isinstance(a, ast.Name) and a.id in module_fns:
+                udf_node, udf_name = module_fns[a.id], a.id
+                break
+        if udf_node is None:
+            continue
+        rep = analyze_tree(udf_node, name=udf_name,
+                           module_names=module_names, filename=path,
+                           line_base=getattr(udf_node, "lineno", 1),
+                           abs_lines=True)
+        reason = dead_resolver_reason(
+            rep, code=code, exc_name=n.args[0].id,
+            fully_typed=_calls_all_known(udf_node, module_names))
+        if reason:
+            out.append(f"{path}:{getattr(n, 'lineno', 1)}: {reason}")
+    return out
 
 
 def _script_module_names(tree: ast.Module) -> dict:
@@ -696,19 +871,37 @@ def lint_file(path: str, strict: bool = False, stream=None) -> int:
         emit(f"{path}: no UDFs found (no DataSet-style "
              f"map/filter/withColumn/... calls)")
         return 0
-    n_fallback = n_sites = 0
+    n_fallback = n_sites = n_typed = 0
     emit(f"lint report for {path} — {len(udfs)} UDF(s)")
     for node, name in udfs:
         rpt = analyze_tree(node, name=name, module_names=module_names,
                            filename=path,
                            line_base=getattr(node, "lineno", 1),
                            abs_lines=True)
+        # schema-free type verdict (compiler/typeinfer.infer_tree): only
+        # input-independent UDFs come out exact at lint time, but the WHY
+        # on the rest tells the author what blocks sample-free planning
+        try:
+            from .typeinfer import infer_tree
+
+            v = infer_tree(node, module_names)
+            rpt.inferred_type = v.type
+            rpt.inferred_why = "" if v.exact else (v.why or "undecidable")
+            n_typed += 1 if v.exact else 0
+        except Exception:   # pragma: no cover - lint stays best-effort
+            pass
         n_fallback += len(rpt.fallback_findings)
         n_sites += len(rpt.exception_findings)
         emit()
         for line in rpt.format():
             emit(line)
+    dead = _script_dead_resolvers(tree, module_names, path)
+    if dead:
+        emit()
+        for line in dead:
+            emit(line)
     emit()
     emit(f"{len(udfs)} UDF(s): {n_fallback} fallback finding(s), "
-         f"{n_sites} exception site(s)")
-    return 1 if (strict and n_fallback) else 0
+         f"{n_sites} exception site(s), {n_typed} statically typed, "
+         f"{len(dead)} dead resolver(s)")
+    return 1 if (strict and (n_fallback or dead)) else 0

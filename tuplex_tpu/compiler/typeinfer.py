@@ -1,0 +1,1180 @@
+"""Sample-free specialization: abstract type inference over UDF ASTs.
+
+Tuplex's data-driven compilation pays a per-plan tax we inherited: every
+operator's output schema comes from tracing the UDF over sample rows
+(plan/logical.py ``_infer_schema`` -> ``cached_sample()``), even when the
+result type is fully decidable from the AST alone. This module is an
+abstract interpreter over the UDF's AST on the ``core/typesys`` lattice:
+transfer functions for arithmetic / comparison / str-method chains,
+subscripts against the input ``RowType``, conditionals joining both arms,
+bounded loop fixpoints — and a top element ("undecidable") that cleanly
+aborts to the sample trace (reference contrast: the reference always
+executes the UDF over sample rows, TraceVisitor.h:25-80; SystemML-style
+fusion planning makes the same move from executed evidence to static facts,
+PAPERS.md).
+
+Soundness contract (the acceptance bar): an EXACT verdict must equal what
+the sample trace would have speculated — never a different concrete type.
+Anything data-dependent (None on *some* control path, mixed numeric arms,
+unknown calls, reflection) widens to undecidable and the planner falls back
+to the CPython sample trace. In particular:
+
+* joining two DIFFERENT concrete types (i64 vs f64, str vs i64) aborts —
+  the trace would majority-vote a type the static view can't know;
+* a join that introduces ``None`` from a control path (``return None`` on
+  one arm) reports the Option shape but stays INEXACT: whether the sample
+  actually contains Nones is data the AST doesn't have;
+* optionality that comes from the INPUT SCHEMA (an ``Option[str]`` column
+  passed through) stays exact — it was speculated from data already.
+
+Operator entry points (``static_op_schema`` / ``op_static_verdict``) mirror
+the calling conventions of ``plan/logical.py apply_udf_python`` exactly, so
+a static verdict binds parameters the same way the trace would have.
+
+Gate: ``tuplex.tpu.staticTypes`` (default on; Context applies it via
+``set_enabled``) with env escape hatch ``TUPLEX_STATIC_TYPES=0``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Any, Optional
+
+from ..core import typesys as T
+
+__all__ = ["Verdict", "Undecidable", "infer_udf", "infer_tree",
+           "static_op_schema", "op_static_verdict", "enabled",
+           "set_enabled"]
+
+
+# ---------------------------------------------------------------------------
+# gate
+# ---------------------------------------------------------------------------
+
+_flag = True      # set by Context from tuplex.tpu.staticTypes
+
+
+def set_enabled(on: bool) -> None:
+    global _flag
+    _flag = bool(on)
+
+
+def enabled() -> bool:
+    """Static inference gate: TUPLEX_STATIC_TYPES env wins (escape hatch /
+    A-B benchmarking), else whatever the last Context configured."""
+    env = os.environ.get("TUPLEX_STATIC_TYPES")
+    if env is not None:
+        return env.strip().lower() not in ("0", "false", "no", "off")
+    return _flag
+
+
+class Undecidable(Exception):
+    """Raised by a transfer function when the result type depends on data
+    (or on constructs outside the abstract domain). Caught at the verdict
+    boundary: the operator then falls back to the sample trace."""
+
+    def __init__(self, why: str):
+        super().__init__(why)
+        self.why = why
+
+
+class Verdict:
+    """Outcome of inferring one UDF's return type.
+
+    ``type`` is the exact result type when decidable, else None and ``why``
+    says what aborted. ``shape`` carries the best-known (sound but possibly
+    data-dependent) type for diagnostics even when inexact."""
+
+    __slots__ = ("type", "why", "shape")
+
+    def __init__(self, type_: Optional[T.Type], why: str = "",
+                 shape: Optional[T.Type] = None):
+        self.type = type_
+        self.why = why
+        self.shape = shape if shape is not None else type_
+
+    @property
+    def exact(self) -> bool:
+        return self.type is not None
+
+    def describe(self) -> str:
+        if self.exact:
+            return f"yes — {self.type.name}"
+        if self.shape is not None:
+            return f"no ({self.shape.name} shape) — {self.why}"
+        return f"no — {self.why}"
+
+    def __repr__(self):
+        return f"Verdict({self.describe()})"
+
+
+_NO_CONST = object()
+
+
+class AV:
+    """Abstract value: a lattice type plus (optionally) a known literal
+    constant and, for dict literals with constant str keys, the record
+    view (ordered names) a MapOperator needs for named output columns."""
+
+    __slots__ = ("t", "const", "record", "why")
+
+    def __init__(self, t: Optional[T.Type], const: Any = _NO_CONST,
+                 record=None, why: str = ""):
+        self.t = t                 # None == TOP (poisoned; use aborts)
+        self.const = const
+        self.record = record       # (names tuple, types tuple) | None
+        self.why = why             # reason when t is None
+
+    def use(self) -> T.Type:
+        """The type, for an operation that needs one — aborts on TOP."""
+        if self.t is None:
+            raise Undecidable(self.why or "value undecidable")
+        return self.t
+
+    def base(self) -> T.Type:
+        """Type with Option stripped — for operations that raise on None
+        (the raising rows are excluded from the traced schema the same
+        way, so unwrapping preserves trace equivalence)."""
+        t = self.use()
+        return t.without_option() if t.is_optional() else t
+
+
+def _av(t: T.Type, const: Any = _NO_CONST) -> AV:
+    return AV(t, const)
+
+
+TOP = AV(None, why="undecidable")
+
+
+# ---------------------------------------------------------------------------
+# known-pure call tables
+# ---------------------------------------------------------------------------
+
+# str methods returning str
+_STR_TO_STR = {"lower", "upper", "strip", "lstrip", "rstrip", "replace",
+               "title", "capitalize", "casefold", "swapcase", "center",
+               "ljust", "rjust", "zfill", "format", "join", "removeprefix",
+               "removesuffix", "expandtabs"}
+_STR_TO_I64 = {"find", "rfind", "index", "rindex", "count"}
+_STR_TO_BOOL = {"startswith", "endswith", "isdigit", "isalpha", "isalnum",
+                "isspace", "islower", "isupper", "isnumeric", "isdecimal",
+                "istitle", "isidentifier"}
+_STR_TO_LIST = {"split", "rsplit", "splitlines"}
+
+# (module, attr) -> result type for pure, type-total module calls
+_MODULE_FNS = {
+    ("math", "ceil"): T.I64, ("math", "floor"): T.I64,
+    ("math", "trunc"): T.I64,
+    ("math", "sqrt"): T.F64, ("math", "log"): T.F64,
+    ("math", "log2"): T.F64, ("math", "log10"): T.F64,
+    ("math", "exp"): T.F64, ("math", "pow"): T.F64,
+    ("math", "sin"): T.F64, ("math", "cos"): T.F64,
+    ("math", "tan"): T.F64, ("math", "atan"): T.F64,
+    ("math", "atan2"): T.F64, ("math", "hypot"): T.F64,
+    ("math", "fabs"): T.F64, ("math", "fmod"): T.F64,
+    ("math", "copysign"): T.F64,
+    ("math", "isnan"): T.BOOL, ("math", "isinf"): T.BOOL,
+    ("string", "capwords"): T.STR,
+}
+_MODULE_CONSTS = {("math", "pi"): T.F64, ("math", "e"): T.F64,
+                  ("math", "inf"): T.F64, ("math", "nan"): T.F64,
+                  ("math", "tau"): T.F64}
+
+
+# ---------------------------------------------------------------------------
+# the interpreter
+# ---------------------------------------------------------------------------
+
+class _Abs:
+    """One abstract run over a UDF body. Collects return-value AVs; joins
+    environments at control merges; bounded fixpoint over loops."""
+
+    _LOOP_ROUNDS = 4
+
+    def __init__(self, globals_map: dict, module_names: dict):
+        self.globals_map = globals_map or {}
+        self.module_names = module_names or {}
+        self.returns: list[AV] = []
+        # a join introduced optionality from a CONTROL PATH (not the input
+        # schema): the result shape is sound but whether Nones occur is
+        # data — the verdict must stay inexact (see module docstring)
+        self.null_join: Optional[str] = None
+
+    # -- joins --------------------------------------------------------------
+    def join_types(self, a: T.Type, b: T.Type) -> T.Type:
+        if a is b:
+            return a
+        if a is T.NULL:
+            self.null_join = self.null_join or \
+                f"None on some control path (joins {b.name})"
+            return T.option(b)
+        if b is T.NULL:
+            return self.join_types(b, a)
+        if a.is_optional() or b.is_optional():
+            ab, bb = a.without_option(), b.without_option()
+            if ab is bb:
+                # Option[T] vs T: all values conform to Option[T], but the
+                # trace may or may not have seen a None — data-dependent
+                if a.is_optional() != b.is_optional():
+                    self.null_join = self.null_join or \
+                        f"optionality differs across arms ({a.name} vs " \
+                        f"{b.name})"
+                return T.option(ab)
+            raise Undecidable(f"arms disagree: {a.name} vs {b.name}")
+        if isinstance(a, T.TupleType) and isinstance(b, T.TupleType) \
+                and len(a) == len(b):
+            return T.tuple_of(*(self.join_types(x, y)
+                                for x, y in zip(a.elements, b.elements)))
+        if isinstance(a, T.ListType) and isinstance(b, T.ListType):
+            return T.list_of(self.join_types(a.elt, b.elt))
+        if isinstance(a, T.RowType) and isinstance(b, T.RowType) \
+                and a.columns == b.columns:
+            return T.row_of(a.columns,
+                            [self.join_types(x, y)
+                             for x, y in zip(a.types, b.types)])
+        if isinstance(a, T.DictType) and isinstance(b, T.DictType):
+            # dict VALUE types mirror infer_type's super_type fold (that is
+            # what the trace would compute), not the strict join
+            return T.dict_of(T.super_type(a.key, b.key),
+                             T.super_type(a.val, b.val))
+        # different concrete types: the trace would majority-vote — abort
+        raise Undecidable(f"arms disagree: {a.name} vs {b.name}")
+
+    def join_avs(self, a: AV, b: AV) -> AV:
+        if a.t is None or b.t is None:
+            return AV(None, why=(a.why or b.why or "join of undecidable"))
+        try:
+            t = self.join_types(a.t, b.t)
+        except Undecidable as e:
+            return AV(None, why=e.why)
+        record = None
+        if a.record is not None and b.record is not None \
+                and a.record[0] == b.record[0]:
+            try:
+                record = (a.record[0],
+                          tuple(self.join_types(x, y)
+                                for x, y in zip(a.record[1], b.record[1])))
+            except Undecidable:
+                record = None
+        const = a.const if (a.const is not _NO_CONST
+                            and a.const == b.const) else _NO_CONST
+        return AV(t, const, record)
+
+    def join_envs(self, a: dict, b: dict) -> dict:
+        out = {}
+        for k in a:
+            if k in b:
+                out[k] = a[k] if a[k] is b[k] else self.join_avs(a[k], b[k])
+        # names bound on only one path are possibly-unbound: drop them
+        # (a later use aborts, which is the sound answer)
+        return out
+
+    # -- statements ---------------------------------------------------------
+    def exec_block(self, stmts, env: dict) -> bool:
+        """Run statements; returns True when control can FALL THROUGH the
+        end of the block (False: every path returned/raised)."""
+        for s in stmts:
+            if not self.exec_stmt(s, env):
+                return False
+        return True
+
+    def exec_stmt(self, s: ast.stmt, env: dict) -> bool:
+        if isinstance(s, ast.Return):
+            self.returns.append(self.eval(s.value, env)
+                                if s.value is not None else _av(T.NULL, None))
+            return False
+        if isinstance(s, ast.Raise):
+            # a raising path contributes nothing to the output schema: the
+            # row becomes an exception row, excluded from the trace too
+            return False
+        if isinstance(s, (ast.Pass, ast.Break, ast.Continue)):
+            # break/continue end the block conservatively: the loop
+            # fixpoint already joins every iteration's env
+            return not isinstance(s, (ast.Break, ast.Continue))
+        if isinstance(s, ast.Assign):
+            val = self.eval(s.value, env)
+            for tgt in s.targets:
+                self.assign(tgt, val, env)
+            return True
+        if isinstance(s, ast.AugAssign):
+            val = self._binop_av(self.eval(s.target, env), s.op,
+                                 self.eval(s.value, env))
+            self.assign(s.target, val, env)
+            return True
+        if isinstance(s, ast.AnnAssign):
+            if s.value is not None:
+                self.assign(s.target, self.eval(s.value, env), env)
+            return True
+        if isinstance(s, ast.If):
+            return self.exec_if(s, env)
+        if isinstance(s, (ast.While, ast.For)):
+            self.exec_loop(s, env)
+            return True
+        if isinstance(s, ast.Expr):
+            try:               # value discarded: a failed transfer on a
+                self.eval(s.value, env)   # bare expression poisons nothing
+            except Undecidable:
+                pass
+            return True
+        if isinstance(s, ast.Assert):
+            try:
+                self.eval(s.test, env)
+            except Undecidable:
+                pass
+            return True
+        raise Undecidable(
+            f"statement {type(s).__name__} outside the abstract domain")
+
+    def exec_if(self, s: ast.If, env: dict) -> bool:
+        try:
+            self.eval(s.test, env)
+        except Undecidable:
+            pass                     # a test we can't type still branches
+        env_t = dict(env)
+        env_f = dict(env)
+        self.narrow(s.test, env_t, env_f)
+        ft = self.exec_block(s.body, env_t)
+        ff = self.exec_block(s.orelse, env_f)
+        if ft and ff:
+            merged = self.join_envs(env_t, env_f)
+        elif ft:
+            merged = env_t
+        elif ff:
+            merged = env_f
+        else:
+            return False
+        env.clear()
+        env.update(merged)
+        return True
+
+    def exec_loop(self, s, env: dict) -> None:
+        """Bounded fixpoint: join the loop body's effect until stable (or
+        poison the unstable names). The post-loop env joins the zero-trip
+        path, so types only widen."""
+        if isinstance(s, ast.While):
+            try:
+                self.eval(s.test, env)
+            except Undecidable:
+                pass
+        body = list(s.body)
+        if isinstance(s, ast.For):
+            try:
+                self.assign(s.target, self._iter_elt(self.eval(s.iter, env)),
+                            env)
+            except Undecidable:
+                self._poison_target(s.target, env, "loop target undecidable")
+        entry = dict(env)
+        cur = dict(env)
+        for _ in range(self._LOOP_ROUNDS):
+            it = dict(cur)
+            self.exec_block(body, it)
+            if isinstance(s, ast.For):
+                try:
+                    self.assign(s.target,
+                                self._iter_elt(self.eval(s.iter, it)), it)
+                except Undecidable:
+                    self._poison_target(s.target, it, "loop target")
+            joined = self.join_envs(cur, it)
+            # keep entry-only names alive across the join (zero-trip path)
+            for k, v in cur.items():
+                joined.setdefault(k, v)
+            if all(k in cur and joined[k].t is cur[k].t
+                   and joined[k].record == cur[k].record
+                   for k in joined) and set(joined) == set(cur):
+                cur = joined
+                break
+            cur = joined
+        else:
+            # no fixpoint inside the budget: poison what the body binds
+            from .analyzer import _bound_names
+
+            for k in _bound_names(s):
+                if k in cur:
+                    cur[k] = AV(None, why=f"{k!r} unstable across loop")
+        # loop may run zero times: join with the entry env
+        merged = self.join_envs(entry, cur)
+        for k, v in cur.items():
+            merged.setdefault(k, v)
+        if s.orelse:
+            self.exec_block(list(s.orelse), merged)
+        env.clear()
+        env.update(merged)
+
+    def _iter_elt(self, it: AV) -> AV:
+        t = it.base()
+        if t is T.STR:
+            return _av(T.STR)
+        if isinstance(t, T.ListType):
+            return _av(t.elt)
+        if isinstance(t, T.TupleType):
+            elts = [_av(e) for e in t.elements]
+            out = elts[0]
+            for e in elts[1:]:
+                out = self.join_avs(out, e)
+            if out.t is None:
+                raise Undecidable(out.why)
+            return out
+        if isinstance(t, T.DictType):
+            return _av(t.key)
+        if isinstance(t, T.RowType):
+            out = _av(t.types[0])
+            for e in t.types[1:]:
+                out = self.join_avs(out, _av(e))
+            if out.t is None:
+                raise Undecidable(out.why)
+            return out
+        raise Undecidable(f"iteration over {t.name}")
+
+    def assign(self, tgt, val: AV, env: dict) -> None:
+        if isinstance(tgt, ast.Name):
+            env[tgt.id] = val
+            return
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            vt = val.use()
+            elts = None
+            if isinstance(vt, T.TupleType) and len(vt) == len(tgt.elts):
+                elts = [_av(e) for e in vt.elements]
+            elif isinstance(vt, T.ListType):
+                elts = [_av(vt.elt)] * len(tgt.elts)
+            if elts is None or any(isinstance(e, ast.Starred)
+                                   for e in tgt.elts):
+                raise Undecidable("unpacking outside the abstract domain")
+            for sub, sv in zip(tgt.elts, elts):
+                self.assign(sub, sv, env)
+            return
+        if isinstance(tgt, ast.Subscript):
+            # store into a local container: update a record's column when
+            # decidable, else poison the base name (sound)
+            base = tgt.value
+            if isinstance(base, ast.Name) and base.id in env:
+                bav = env[base.id]
+                key = tgt.slice
+                if bav.record is not None and isinstance(key, ast.Constant) \
+                        and isinstance(key.value, str):
+                    names, types = bav.record
+                    vt = val.use()
+                    if key.value in names:
+                        i = names.index(key.value)
+                        types = types[:i] + (vt,) + types[i + 1:]
+                    else:
+                        names = names + (key.value,)
+                        types = types + (vt,)
+                    env[base.id] = AV(
+                        T.dict_of(T.STR, _dict_val_super(types)),
+                        record=(names, types))
+                    return
+                env[base.id] = AV(None,
+                                  why=f"subscript store into {base.id!r}")
+            return
+        if isinstance(tgt, ast.Attribute):
+            # attribute stores never type a UDF result; analyzer flags
+            # global mutation separately
+            return
+        raise Undecidable(f"assignment target {type(tgt).__name__}")
+
+    def _poison_target(self, tgt, env: dict, why: str) -> None:
+        if isinstance(tgt, ast.Name):
+            env[tgt.id] = AV(None, why=why)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._poison_target(e, env, why)
+
+    # -- truthiness narrowing ----------------------------------------------
+    def narrow(self, test, env_true: dict, env_false: dict) -> None:
+        """Path-sensitive Option narrowing for the common guards:
+        ``if x: ...`` / ``if not x`` / ``if x is (not) None``. In the arm
+        where x is known non-None, Option[T] narrows to T — matching the
+        trace, which only ever observes the values that reach the arm."""
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self.narrow(test.operand, env_false, env_true)
+        name = None
+        none_test = False
+        if isinstance(test, ast.Name):
+            name = test.id
+        elif isinstance(test, ast.Compare) and len(test.ops) == 1 \
+                and isinstance(test.left, ast.Name) \
+                and isinstance(test.comparators[0], ast.Constant) \
+                and test.comparators[0].value is None:
+            name = test.left.id
+            none_test = True
+            if isinstance(test.ops[0], ast.Is):
+                env_true, env_false = env_false, env_true   # x is None
+            elif not isinstance(test.ops[0], ast.IsNot):
+                return
+        if name is None:
+            return
+        av = env_true.get(name)
+        if av is not None and av.t is not None and av.t.is_optional():
+            env_true[name] = AV(av.t.without_option())
+        if none_test:
+            avf = env_false.get(name)
+            if avf is not None and avf.t is not None \
+                    and avf.t.is_optional():
+                env_false[name] = _av(T.NULL, None)
+
+    # -- expressions --------------------------------------------------------
+    def eval(self, e: ast.expr, env: dict) -> AV:
+        if isinstance(e, ast.Constant):
+            v = e.value
+            t = T.infer_type(v)
+            if t is T.PYOBJECT:
+                raise Undecidable(f"constant {v!r} has no columnar type")
+            return AV(t, v if isinstance(v, (bool, int, float, str))
+                      or v is None else _NO_CONST)
+        if isinstance(e, ast.Name):
+            return self._load_name(e.id, env)
+        if isinstance(e, ast.BinOp):
+            return self._binop_av(self.eval(e.left, env), e.op,
+                                  self.eval(e.right, env))
+        if isinstance(e, ast.UnaryOp):
+            return self._unary(e, env)
+        if isinstance(e, ast.BoolOp):
+            out = self.eval(e.values[0], env)
+            for sub in e.values[1:]:
+                out = self.join_avs(out, self.eval(sub, env))
+            if out.t is None:
+                raise Undecidable(out.why)
+            return out
+        if isinstance(e, ast.Compare):
+            # comparisons are type-total for schema purposes: rows whose
+            # comparison raises are excluded from the trace anyway
+            for sub in (e.left, *e.comparators):
+                try:
+                    self.eval(sub, env)
+                except Undecidable:
+                    pass
+            return _av(T.BOOL)
+        if isinstance(e, ast.IfExp):
+            try:
+                self.eval(e.test, env)
+            except Undecidable:
+                pass
+            env_t, env_f = dict(env), dict(env)
+            self.narrow(e.test, env_t, env_f)
+            out = self.join_avs(self.eval(e.body, env_t),
+                                self.eval(e.orelse, env_f))
+            if out.t is None:
+                raise Undecidable(out.why)
+            return out
+        if isinstance(e, ast.Subscript):
+            return self._subscript(e, env)
+        if isinstance(e, ast.Call):
+            return self._call(e, env)
+        if isinstance(e, ast.Attribute):
+            return self._attribute(e, env)
+        if isinstance(e, ast.JoinedStr):
+            for v in e.values:
+                if isinstance(v, ast.FormattedValue):
+                    try:
+                        self.eval(v.value, env)
+                    except Undecidable:
+                        pass
+            return _av(T.STR)
+        if isinstance(e, ast.Tuple):
+            elts = [self.eval(x, env) for x in e.elts]
+            return AV(T.tuple_of(*(a.use() for a in elts)))
+        if isinstance(e, ast.List):
+            if not e.elts:
+                return _av(T.EMPTYLIST)
+            elts = [self.eval(x, env) for x in e.elts]
+            out = elts[0]
+            for a in elts[1:]:
+                out = self.join_avs(out, a)
+            if out.t is None:
+                raise Undecidable(out.why)
+            return AV(T.list_of(out.use()))
+        if isinstance(e, ast.Dict):
+            return self._dict_literal(e, env)
+        if isinstance(e, ast.NamedExpr):
+            val = self.eval(e.value, env)
+            self.assign(e.target, val, env)
+            return val
+        if isinstance(e, ast.Slice):
+            raise Undecidable("bare slice")
+        raise Undecidable(
+            f"expression {type(e).__name__} outside the abstract domain")
+
+    def _load_name(self, name: str, env: dict) -> AV:
+        if name in env:
+            av = env[name]
+            if av.t is None:
+                raise Undecidable(av.why or f"{name!r} undecidable")
+            return av
+        if name in self.module_names:
+            return AV(None, why=f"module {name!r} used as a value")
+        if name in self.globals_map:
+            v = self.globals_map[name]
+            if isinstance(v, (bool, int, float, str)) or v is None:
+                t = T.infer_type(v)
+                if t is not T.PYOBJECT:
+                    return AV(t, v)
+            if isinstance(v, (list, tuple, dict)):
+                t = T.infer_type(v)
+                if t is not T.PYOBJECT:
+                    return AV(t)      # container contents, no const
+            raise Undecidable(f"captured global {name!r} "
+                              f"({type(v).__name__}) undecidable")
+        if name in ("True", "False"):     # pragma: no cover - py>=3 keyword
+            return _av(T.BOOL, name == "True")
+        # unknown free name: builtins used as values, NameError at runtime
+        raise Undecidable(f"unbound name {name!r}")
+
+    # -- operators ----------------------------------------------------------
+    def _numeric(self, t: T.Type) -> T.Type:
+        """Arithmetic operand domain; bools arithmetically act as ints."""
+        if t is T.BOOL:
+            return T.I64
+        if t is T.I64 or t is T.F64:
+            return t
+        raise Undecidable(f"arithmetic on {t.name}")
+
+    def _binop_av(self, a: AV, op, b: AV) -> AV:
+        ta, tb = a.base(), b.base()
+        if isinstance(op, ast.Add):
+            if ta is T.STR and tb is T.STR:
+                return _av(T.STR)
+            if isinstance(ta, T.ListType) and isinstance(tb, T.ListType):
+                return AV(T.list_of(self.join_types(ta.elt, tb.elt)))
+            if isinstance(ta, T.TupleType) and isinstance(tb, T.TupleType):
+                return AV(T.tuple_of(*ta.elements, *tb.elements))
+            return self._arith(ta, tb)
+        if isinstance(op, ast.Mult):
+            if ta is T.STR and self._is_intlike(tb):
+                return _av(T.STR)
+            if self._is_intlike(ta) and tb is T.STR:
+                return _av(T.STR)
+            if isinstance(ta, T.ListType) and self._is_intlike(tb):
+                return AV(ta)
+            return self._arith(ta, tb)
+        if isinstance(op, (ast.Sub,)):
+            return self._arith(ta, tb)
+        if isinstance(op, ast.Div):
+            self._numeric(ta), self._numeric(tb)
+            return _av(T.F64)
+        if isinstance(op, ast.FloorDiv):
+            na, nb = self._numeric(ta), self._numeric(tb)
+            return _av(T.F64 if T.F64 in (na, nb) else T.I64)
+        if isinstance(op, ast.Mod):
+            if ta is T.STR:
+                return _av(T.STR)          # printf-style formatting
+            na, nb = self._numeric(ta), self._numeric(tb)
+            return _av(T.F64 if T.F64 in (na, nb) else T.I64)
+        if isinstance(op, ast.Pow):
+            na, nb = self._numeric(ta), self._numeric(tb)
+            if T.F64 in (na, nb):
+                return _av(T.F64)
+            if b.const is not _NO_CONST and isinstance(b.const, int) \
+                    and b.const >= 0:
+                return _av(T.I64)
+            raise Undecidable("int ** int with data-dependent exponent "
+                              "(may be float)")
+        if isinstance(op, (ast.BitAnd, ast.BitOr, ast.BitXor)):
+            if ta is T.BOOL and tb is T.BOOL:
+                return _av(T.BOOL)
+            self._numeric(ta), self._numeric(tb)
+            if T.F64 in (ta, tb):
+                raise Undecidable("bitwise op on float")
+            return _av(T.I64)
+        if isinstance(op, (ast.LShift, ast.RShift)):
+            self._numeric(ta), self._numeric(tb)
+            return _av(T.I64)
+        if isinstance(op, ast.MatMult):
+            raise Undecidable("matrix multiply in a UDF")
+        raise Undecidable(f"operator {type(op).__name__}")
+
+    def _arith(self, ta: T.Type, tb: T.Type) -> AV:
+        na, nb = self._numeric(ta), self._numeric(tb)
+        return _av(T.F64 if T.F64 in (na, nb) else T.I64)
+
+    @staticmethod
+    def _is_intlike(t: T.Type) -> bool:
+        return t is T.I64 or t is T.BOOL
+
+    def _unary(self, e: ast.UnaryOp, env: dict) -> AV:
+        if isinstance(e.op, ast.Not):
+            try:
+                self.eval(e.operand, env)
+            except Undecidable:
+                pass
+            return _av(T.BOOL)
+        v = self.eval(e.operand, env)
+        t = self._numeric(v.base())
+        if isinstance(e.op, ast.Invert):
+            if t is T.F64:
+                raise Undecidable("~ on float")
+            return _av(T.I64)
+        return _av(t)
+
+    # -- subscripts against the input RowType -------------------------------
+    def _subscript(self, e: ast.Subscript, env: dict) -> AV:
+        base = self.eval(e.value, env)
+        bt = base.base()
+        sl = e.slice
+        if isinstance(sl, ast.Slice):
+            for part in (sl.lower, sl.upper, sl.step):
+                if part is not None:
+                    self.eval(part, env)
+            if bt is T.STR:
+                return _av(T.STR)
+            if isinstance(bt, T.ListType):
+                return AV(bt)
+            if isinstance(bt, T.TupleType):
+                raise Undecidable("tuple slice")
+            raise Undecidable(f"slice of {bt.name}")
+        key = self.eval(sl, env)
+        if isinstance(bt, T.RowType):
+            if key.const is not _NO_CONST and isinstance(key.const, str):
+                if key.const not in bt.columns:
+                    raise Undecidable(f"unknown column {key.const!r}")
+                return _av(bt.col_type(key.const))
+            if key.const is not _NO_CONST and isinstance(key.const, int) \
+                    and not isinstance(key.const, bool):
+                i = key.const if key.const >= 0 else len(bt) + key.const
+                if 0 <= i < len(bt):
+                    return _av(bt.types[i])
+                raise Undecidable("row index out of range")
+            raise Undecidable("row subscript with data-dependent key")
+        if base.record is not None and key.const is not _NO_CONST \
+                and isinstance(key.const, str):
+            names, types = base.record
+            if key.const in names:
+                return _av(types[names.index(key.const)])
+            raise Undecidable(f"unknown dict key {key.const!r}")
+        if bt is T.STR:
+            return _av(T.STR)
+        if isinstance(bt, T.ListType):
+            return _av(bt.elt)
+        if isinstance(bt, T.TupleType):
+            if key.const is not _NO_CONST and isinstance(key.const, int) \
+                    and not isinstance(key.const, bool):
+                i = key.const if key.const >= 0 else len(bt) + key.const
+                if 0 <= i < len(bt):
+                    return _av(bt.elements[i])
+                raise Undecidable("tuple index out of range")
+            out = _av(bt.elements[0])
+            for t in bt.elements[1:]:
+                out = self.join_avs(out, _av(t))
+            if out.t is None:
+                raise Undecidable(out.why)
+            return out
+        if isinstance(bt, T.DictType):
+            return _av(bt.val)
+        raise Undecidable(f"subscript of {bt.name}")
+
+    # -- attributes / calls -------------------------------------------------
+    def _attribute(self, e: ast.Attribute, env: dict) -> AV:
+        if isinstance(e.value, ast.Name) \
+                and e.value.id not in env \
+                and e.value.id in self.module_names:
+            mod = self.module_names[e.value.id]
+            t = _MODULE_CONSTS.get((mod, e.attr))
+            if t is not None:
+                return _av(t)
+            raise Undecidable(f"module attribute {e.value.id}.{e.attr}")
+        raise Undecidable(f"attribute .{e.attr} outside the abstract domain")
+
+    def _call(self, e: ast.Call, env: dict) -> AV:
+        if e.keywords and any(k.arg is None for k in e.keywords):
+            raise Undecidable("**kwargs call")
+        fn = e.func
+        # str/list/dict method chains
+        if isinstance(fn, ast.Attribute):
+            if isinstance(fn.value, ast.Name) and fn.value.id not in env \
+                    and fn.value.id in self.module_names:
+                mod = self.module_names[fn.value.id]
+                res = _MODULE_FNS.get((mod, fn.attr))
+                if res is not None:
+                    for a in e.args:
+                        self.eval(a, env)
+                    return _av(res)
+                raise Undecidable(f"call {fn.value.id}.{fn.attr}() "
+                                  "not in the pure-call table")
+            recv = self.eval(fn.value, env)
+            return self._method(recv, fn.attr, e.args, env)
+        if isinstance(fn, ast.Name) and fn.id not in env:
+            return self._builtin(fn.id, e.args, env)
+        raise Undecidable("call to a computed function")
+
+    def _method(self, recv: AV, name: str, args, env: dict) -> AV:
+        rt = recv.base()
+        for a in args:
+            self.eval(a, env)
+        if rt is T.STR:
+            if name in _STR_TO_STR:
+                return _av(T.STR)
+            if name in _STR_TO_I64:
+                return _av(T.I64)
+            if name in _STR_TO_BOOL:
+                return _av(T.BOOL)
+            if name in _STR_TO_LIST:
+                return AV(T.list_of(T.STR))
+            if name == "partition" or name == "rpartition":
+                return AV(T.tuple_of(T.STR, T.STR, T.STR))
+            raise Undecidable(f"str method .{name}()")
+        if isinstance(rt, T.ListType):
+            if name in ("index", "count"):
+                return _av(T.I64)
+            raise Undecidable(f"list method .{name}()")
+        if isinstance(rt, T.DictType):
+            if name == "get":
+                if len(args) >= 2:
+                    return self.join_avs(_av(rt.val),
+                                         self.eval(args[1], env))
+                self.null_join = self.null_join or \
+                    ".get() may return None"
+                return AV(T.option(rt.val))
+            if name == "keys":
+                return AV(T.list_of(rt.key))
+            if name == "values":
+                return AV(T.list_of(rt.val))
+            raise Undecidable(f"dict method .{name}()")
+        raise Undecidable(f"method .{name}() on {rt.name}")
+
+    def _builtin(self, name: str, args, env: dict) -> AV:
+        shadowed = name in self.globals_map
+        if shadowed:
+            import builtins
+
+            if self.globals_map[name] is not getattr(builtins, name, object()):
+                raise Undecidable(f"{name!r} rebound in the UDF's globals")
+        # conversions and len() are type-TOTAL: rows where they raise are
+        # excluded from the traced schema, so the static result stands even
+        # over undecidable arguments
+        if name in ("int", "float", "str", "bool", "len", "ord", "repr"):
+            for a in args:
+                try:
+                    self.eval(a, env)
+                except Undecidable:
+                    pass
+            return _av({"int": T.I64, "float": T.F64, "str": T.STR,
+                        "bool": T.BOOL, "len": T.I64, "ord": T.I64,
+                        "repr": T.STR}[name])
+        avs = [self.eval(a, env) for a in args]
+        if name == "abs":
+            return _av(self._numeric(avs[0].base()))
+        if name in ("min", "max"):
+            if len(avs) == 1:
+                return self._iter_elt(avs[0])
+            out = avs[0]
+            for a in avs[1:]:
+                out = self.join_avs(out, a)
+            if out.t is None:
+                raise Undecidable(out.why)
+            return out
+        if name == "round":
+            if len(avs) >= 2:
+                return _av(self._numeric(avs[0].base()))
+            self._numeric(avs[0].base())
+            return _av(T.I64)
+        if name == "sum":
+            elt = self._iter_elt(avs[0])
+            base = self._numeric(elt.base())
+            if len(avs) >= 2:
+                base = self._arith(base, avs[1].base()).t
+            return _av(base)
+        if name == "chr":
+            return _av(T.STR)
+        if name == "sorted":
+            elt = self._iter_elt(avs[0])
+            return AV(T.list_of(elt.use()))
+        raise Undecidable(f"call to {name!r} not in the builtin table")
+
+    def _dict_literal(self, e: ast.Dict, env: dict) -> AV:
+        if not e.keys:
+            return _av(T.EMPTYDICT)
+        names: list = []
+        ktypes: list = []
+        vtypes: list = []
+        all_str = True
+        for k, v in zip(e.keys, e.values):
+            if k is None:
+                raise Undecidable("** splat inside dict literal")
+            kav = self.eval(k, env)
+            vav = self.eval(v, env)
+            ktypes.append(kav.use())
+            vtypes.append(vav.use())
+            if kav.const is not _NO_CONST and isinstance(kav.const, str):
+                names.append(kav.const)
+            else:
+                all_str = False
+        kt = ktypes[0]
+        for t in ktypes[1:]:
+            kt = T.super_type(kt, t)
+        record = (tuple(names), tuple(vtypes)) \
+            if all_str and len(set(names)) == len(names) else None
+        return AV(T.dict_of(kt, _dict_val_super(vtypes)), record=record)
+
+
+def _dict_val_super(vtypes) -> T.Type:
+    """Generic dict value type: super_type fold, mirroring what
+    ``infer_type`` (and therefore the trace) computes for dict values."""
+    vt = vtypes[0]
+    for t in vtypes[1:]:
+        vt = T.super_type(vt, t)
+    return vt
+
+
+# ---------------------------------------------------------------------------
+# UDF-level entry
+# ---------------------------------------------------------------------------
+
+def infer_udf(udf, param_avs: dict) -> Verdict:
+    """Infer the return type of a reflected UDFSource whose parameters are
+    pre-bound to abstract values (see the operator entries below for the
+    binding conventions)."""
+    tree = getattr(udf, "tree", None)
+    if tree is None or not getattr(udf, "source", ""):
+        return Verdict(None, "no retrievable UDF source")
+    module_names = {k: v.__name__.split(".")[0]
+                    for k, v in getattr(udf, "globals", {}).items()
+                    if _is_module(v)}
+    return _infer_node(tree, dict(param_avs), udf.globals, module_names)
+
+
+def infer_tree(node: ast.AST, module_names=None) -> Verdict:
+    """Lint-mode inference: no input schema, every parameter is TOP. Only
+    input-independent UDFs (constant shapes, conversions, formatting) come
+    out exact — honest for a purely syntactic surface."""
+    params = _node_params(node)
+    if module_names is None:
+        module_names = {}
+    elif not isinstance(module_names, dict):
+        module_names = {n: n for n in module_names}
+    binds = {p: AV(None, why="input row type unknown at lint time")
+             for p in params}
+    return _infer_node(node, binds, {}, module_names)
+
+
+def _is_module(v) -> bool:
+    import types
+
+    return isinstance(v, types.ModuleType)
+
+
+def _node_params(node) -> tuple:
+    a = getattr(node, "args", None)
+    if a is None:
+        return ()
+    return tuple(x.arg for x in
+                 list(getattr(a, "posonlyargs", [])) + a.args)
+
+
+def _infer_node(node: ast.AST, env: dict, globals_map: dict,
+                module_names: dict) -> Verdict:
+    # a yield/await anywhere makes the whole function a generator/coroutine
+    # — the return value is a generator object, NOT the joined yields. Must
+    # be checked up front: `yield x` in expression-statement position would
+    # otherwise be swallowed as a discarded value and the fall-through path
+    # would claim an (unsound) exact NULL. Nested lambdas can't contain
+    # yield (SyntaxError) and nested defs abort as statements, so a whole-
+    # tree walk is safe.
+    for n in ast.walk(node):
+        if isinstance(n, (ast.Yield, ast.YieldFrom, ast.Await)):
+            return Verdict(None, "generator/async construct")
+    a = getattr(node, "args", None)
+    if a is not None and (a.vararg or a.kwarg or a.kwonlyargs
+                          or getattr(a, "posonlyargs", [])):
+        return Verdict(None, "*args/**kwargs/keyword-only parameters")
+    interp = _Abs(globals_map, module_names)
+    try:
+        if isinstance(node, ast.Lambda):
+            ret = interp.eval(node.body, env)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if isinstance(node, ast.AsyncFunctionDef):
+                return Verdict(None, "async function")
+            falls = interp.exec_block(list(node.body), env)
+            if falls:
+                interp.returns.append(_av(T.NULL, None))
+            if not interp.returns:
+                return Verdict(None, "function never returns a value")
+            ret = interp.returns[0]
+            for r in interp.returns[1:]:
+                ret = interp.join_avs(ret, r)
+            if ret.t is None:
+                raise Undecidable(ret.why)
+        else:
+            return Verdict(None, f"unsupported UDF node "
+                                 f"{type(node).__name__}")
+        rt = ret.use()
+    except Undecidable as e:
+        return Verdict(None, e.why)
+    except RecursionError:       # pragma: no cover - pathological nesting
+        return Verdict(None, "AST too deep")
+    if interp.null_join:
+        return Verdict(None, interp.null_join, shape=rt)
+    if ret.record is not None:
+        rt = T.row_of(*ret.record)
+    if rt is T.PYOBJECT or rt is T.UNKNOWN:
+        return Verdict(None, f"inferred {rt.name}")
+    return Verdict(rt)
+
+
+# ---------------------------------------------------------------------------
+# operator-level entry (mirrors plan/logical.py apply_udf_python)
+# ---------------------------------------------------------------------------
+
+def _bind_params(udf, schema: T.RowType) -> Optional[dict]:
+    """Bind UDF parameters to abstract values the way apply_udf_python
+    binds concrete ones: multi-param UDFs spread the row, named rows pass
+    the Row itself, single unnamed columns pass the bare value, unnamed
+    multi-column rows pass a tuple."""
+    from ..runtime.columns import user_columns
+
+    params = _node_params(getattr(udf, "tree", None))
+    if getattr(udf, "tree", None) is None:
+        return None
+    nparams = len(params) if params else 1
+    if not params:
+        return {}
+    if nparams > 1:
+        if len(schema.types) == nparams:
+            return {p: _av(t) for p, t in zip(params, schema.types)}
+        return None
+    if user_columns(schema) is not None:
+        return {params[0]: AV(schema)}
+    if len(schema.types) == 1:
+        return {params[0]: _av(schema.types[0])}
+    return {params[0]: AV(T.tuple_of(*schema.types))}
+
+
+def op_static_verdict(op) -> Optional[Verdict]:
+    """Per-operator inference verdict against the PARENT schema, memoized
+    on the operator (operators are immutable once planned). None for
+    operator kinds static typing does not cover (filters pass their schema
+    through without sampling anyway; aggregates/joins stay traced)."""
+    memo = getattr(op, "_ti_verdict", False)
+    if memo is not False:
+        return memo
+    v = _op_static_verdict_uncached(op)
+    try:
+        op._ti_verdict = v
+    except (AttributeError, TypeError):      # pragma: no cover
+        pass
+    if v is not None:
+        _stamp_report(op, v)
+    return v
+
+
+def _op_static_verdict_uncached(op) -> Optional[Verdict]:
+    from ..plan import logical as L
+
+    if not isinstance(op, (L.MapOperator, L.WithColumnOperator,
+                           L.MapColumnOperator)):
+        return None
+    from ..compiler.analyzer import STATS
+    from ..runtime import tracing as _tr
+
+    with _tr.span("plan:infer-type", "plan") as _sp:
+        try:
+            ps = op.parent.schema()
+        except Exception as e:
+            return Verdict(None, f"parent schema unavailable "
+                                 f"({type(e).__name__})")
+        if isinstance(op, L.MapColumnOperator):
+            if op.column not in (ps.columns or ()):
+                v = Verdict(None, f"unknown column {op.column!r}")
+            else:
+                ci = ps.columns.index(op.column)
+                v = infer_udf(op.udf, _binds_or_none(op.udf,
+                                                     [ps.types[ci]]))
+        else:
+            binds = _bind_params(op.udf, ps)
+            if binds is None:
+                v = Verdict(None, "parameter/row arity mismatch")
+            else:
+                v = infer_udf(op.udf, binds)
+        if v.exact and isinstance(op, L.MapOperator):
+            # a map's TOP-LEVEL dict result without a record view (non-
+            # constant keys, duplicate keys, captured dicts) cannot be
+            # schema'd statically: the trace names output columns from the
+            # OBSERVED keys, which are data. A record-view dict already
+            # became a RowType in _infer_node; any Dict that survives here
+            # is record-less — widen, never guess (soundness contract)
+            base = v.type.without_option() if v.type.is_optional() \
+                else v.type
+            if isinstance(base, T.DictType) or base is T.EMPTYDICT:
+                v = Verdict(None, "dict result without a constant key "
+                                  "set (column names are data)",
+                            shape=v.type)
+        if v.exact:
+            STATS["inferred_ops"] += 1
+        if _sp is not _tr.NOOP:
+            _sp.set("op", type(op).__name__).set("exact", v.exact)
+            _sp.set("type", v.type.name if v.exact else (v.why or "?"))
+    return v
+
+
+def _binds_or_none(udf, types) -> dict:
+    """Single-value binding for mapColumn (the operator calls udf.func on
+    the bare cell, not through apply_udf_python)."""
+    params = _node_params(getattr(udf, "tree", None))
+    if len(params) != 1:
+        return {p: AV(None, why="mapColumn UDF must take one parameter")
+                for p in params}
+    return {params[0]: _av(types[0])}
+
+
+def _stamp_report(op, v: Verdict) -> None:
+    """Expose the verdict on the operator's memoized UDFReport (a per-op
+    COPY — reports are memoized per code object and two operators sharing
+    a UDF may see different input schemas). Best-effort: lint surfaces
+    read it, nothing depends on it."""
+    try:
+        import dataclasses
+
+        from . import analyzer as az
+
+        entries = az.op_reports(op)
+        for i, (attr, rep) in enumerate(entries):
+            if attr == "udf":
+                entries[i] = (attr, dataclasses.replace(
+                    rep,
+                    inferred_type=v.type,
+                    inferred_why="" if v.exact else (v.why or "undecidable")))
+                break
+    except Exception:       # pragma: no cover - advisory surface only
+        pass
+
+
+def static_op_schema(op):
+    """The operator's exact output RowType when statically decidable under
+    the current gate, else None (the caller then runs the sample trace).
+    Output shapes mirror the traced ``_infer_schema`` implementations."""
+    if not enabled():
+        return None
+    from ..plan import logical as L
+
+    v = op_static_verdict(op)
+    if v is None or not v.exact:
+        return None
+    t = v.type
+    if isinstance(op, L.MapColumnOperator):
+        ps = op.parent.schema()
+        types = list(ps.types)
+        types[ps.columns.index(op.column)] = t
+        return T.row_of(ps.columns, types)
+    if isinstance(op, L.WithColumnOperator):
+        from ..runtime.columns import user_columns
+
+        ps = op.parent.schema()
+        if user_columns(ps) is None:
+            return None          # the traced path raises; keep its message
+        if isinstance(t, T.RowType):
+            return None          # withColumn cells hold values, not records
+        cols = list(ps.columns)
+        types = list(ps.types)
+        if op.column in cols:
+            types[cols.index(op.column)] = t
+        else:
+            cols.append(op.column)
+            types.append(t)
+        return T.row_of(cols, types)
+    if isinstance(op, L.MapOperator):
+        if isinstance(t, T.RowType):       # dict-literal output: named cols
+            return t
+        if isinstance(t, T.TupleType):
+            return T.row_of([f"_{i}" for i in range(len(t))],
+                            list(t.elements))
+        return T.row_of(["_0"], [t])
+    return None

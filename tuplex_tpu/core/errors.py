@@ -81,6 +81,18 @@ def exception_name(code: int) -> str:
     return name if name is not None else f"code{code}"
 
 
+def code_for_exception_class(cls):
+    """ExceptionCode for an exception CLASS (mro-aware, like
+    code_for_exception but without a live instance), or None when no
+    compiled-path code maps exactly — base classes like Exception or
+    LookupError return None, which callers must treat as "covers
+    anything" (the dead-resolver lint skips them)."""
+    for c in getattr(cls, "__mro__", ()):
+        if c in _PY_TO_CODE:
+            return _PY_TO_CODE[c]
+    return None
+
+
 def code_for_name(name: str):
     """ExceptionCode for a Python exception-class NAME ('ValueError' →
     VALUEERROR), or None when no compiled-path code exists for it. Static

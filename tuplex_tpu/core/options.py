@@ -106,6 +106,15 @@ DEFAULTS: dict[str, str] = {
                                             # (exec/compilequeue.py);
                                             # TUPLEX_PARALLEL_COMPILE=0 also
                                             # disables
+    "tuplex.tpu.staticTypes": "true",       # sample-free specialization
+                                            # (compiler/typeinfer.py):
+                                            # abstract-interpret UDF ASTs
+                                            # and skip the CPython sample
+                                            # trace when the output type is
+                                            # exactly decidable. Default on;
+                                            # TUPLEX_STATIC_TYPES=0 is the
+                                            # env escape hatch (wins over
+                                            # the option, for A/B timing)
     "tuplex.tpu.trace": "false",            # structured span tracing
                                             # (runtime/tracing.py): nested
                                             # spans across plan/compile/

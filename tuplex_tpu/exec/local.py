@@ -992,6 +992,13 @@ class LocalBackend:
         compiled_ok = np.zeros(n, dtype=np.bool_)
         out_arrays: dict[str, np.ndarray] = {}
 
+        # plan-time resolve-tier decision + per-code row buffers shaped by
+        # the analyzer's exception inventory (plan/physical.ResolvePlan):
+        # which tiers run, and which bucket each error row lands in, are
+        # decided BEFORE the fetch instead of re-derived per row after D2H
+        rplan = stage.resolve_plan()
+        bufs = rplan.new_buffers() if pending_outs is not None else None
+
         # device error evidence per fallback row: idx -> (code, operator id).
         # General-tier codes overwrite fast-path ones (supertype decode is
         # the authoritative python-semantics run).
@@ -1088,6 +1095,7 @@ class LocalBackend:
             codes = err[err_idx]
             device_codes.update(
                 zip(err_idx.tolist(), unpack_device_codes(codes)))
+            bufs.add_many(err_idx, codes)
             compiled_ok = rowvalid & keep & (err == 0)
             fold_vals = []
             while f"#fold{len(fold_vals)}" in outs:
@@ -1101,14 +1109,18 @@ class LocalBackend:
             fallback_idx.update(range(n))
 
         # ---- compiled general-case tier (ResolveTask resolve_f analog) ----
+        # gated by the PLAN-time tier decision: when the inventory proves
+        # the general tier can't retire anything (no widened decode in the
+        # stage), the build attempt is skipped outright — it used to cost
+        # one doomed NotCompilable trace per (stage, schema) to learn this
         resolved: dict[int, Row] = {}
         if fallback_idx and pending_outs is not None \
-                and not self.interpret_only:
+                and rplan.use_general and not self.interpret_only:
             t0 = time.perf_counter()
             with TR.span("resolve:general", "exec") as _sp:
                 _sp.set("rows", len(fallback_idx))
                 self._general_case_pass(stage, part, fallback_idx, resolved,
-                                        device_codes)
+                                        device_codes, buffers=bufs)
                 _sp.set("resolved", len(resolved))
             metrics["general_path_s"] = time.perf_counter() - t0
 
@@ -1122,14 +1134,23 @@ class LocalBackend:
         exc_by_row: dict[int, ExceptionRecord] = {}
         if fallback_idx and not stage.has_resolvers \
                 and not self.interpret_only:
-            exact = []
-            for i in sorted(fallback_idx):
-                code_op = device_codes.get(i)
-                if code_op is None:
-                    continue
-                code, op_id = code_op
-                if exception_class_for_code(code) is not None:
-                    exact.append((i, op_id, exception_name(code)))
+            if bufs is not None and not rplan.use_general:
+                # the exact-class rows sit in their plan-time buckets
+                # already — no per-row dict probe + class lookup here
+                exact = [(i, op_id, exception_name(code))
+                         for i, code, op_id in bufs.exact_rows()
+                         if i in fallback_idx]
+            else:
+                # general tier ran: its verdicts superseded fast-path codes
+                # in device_codes, so classify from there
+                exact = []
+                for i in sorted(fallback_idx):
+                    code_op = device_codes.get(i)
+                    if code_op is None:
+                        continue
+                    code, op_id = code_op
+                    if exception_class_for_code(code) is not None:
+                        exact.append((i, op_id, exception_name(code)))
             # decode a handful of rows so history previews stay informative;
             # counts only need the class name
             sample = {}
@@ -1202,7 +1223,8 @@ class LocalBackend:
     def _general_case_pass(self, stage: TransformStage, part: C.Partition,
                            fallback_idx: set, resolved: dict,
                            device_codes: Optional[dict] = None,
-                           local_jit: bool = False) -> None:
+                           local_jit: bool = False,
+                           buffers=None) -> None:
         """Compiled middle tier: re-run normal-case-violating rows through
         the stage fn traced under the GENERAL-CASE schema (Option/supertype
         widened decode). Rows it completes fold back like resolved python
@@ -1220,11 +1242,17 @@ class LocalBackend:
         # fast-path code is already an exact Python exception class decoded
         # fine under the normal case — a supertype re-run reproduces the
         # same exception, so they skip straight past this tier
-        dc = device_codes or {}
-        cand = sorted(
-            i for i in fallback_idx
-            if i not in part.fallback
-            and exception_class_for_code(dc.get(i, (0, 0))[0]) is None)
+        if buffers is not None:
+            # plan-time buckets: the internal-coded candidate set was
+            # grouped at D2H unpack, no per-row re-classification
+            cand = sorted(i for i, _, _ in buffers.internal_rows()
+                          if i in fallback_idx and i not in part.fallback)
+        else:
+            dc = device_codes or {}
+            cand = sorted(
+                i for i in fallback_idx
+                if i not in part.fallback
+                and exception_class_for_code(dc.get(i, (0, 0))[0]) is None)
         if not cand:
             return
         # a small violation set on an accelerator backend resolves on the

@@ -284,7 +284,8 @@ class MultiHostBackend(LocalBackend):
         # code is already an exact Python exception class.
         resolved_local: dict = {}
         fb_set = set(local_fb)
-        if fb_set and not self.interpret_only:
+        if fb_set and not self.interpret_only \
+                and stage.resolve_plan().use_general:
             from ..core.errors import unpack_device_codes
 
             dc = {}

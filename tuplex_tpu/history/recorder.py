@@ -63,13 +63,25 @@ class JobRecorder:
         self._trace_mark = trace_mark if trace_mark is not None \
             else tracing.now_us()
         previews = []
-        for st in plan:
-            for op in getattr(st, "ops", []) or []:
-                for exc_name, row_repr in getattr(
-                        op, "sample_exceptions", [])[
+        if self.enabled:
+            from ..plan.logical import preview_sample_exceptions
+
+            for st in plan:
+                for op in getattr(st, "ops", []) or []:
+                    # on-demand preview pass for operators whose schema
+                    # came statically (sample-free specialization skipped
+                    # the trace the previews used to ride on); traced ops
+                    # return their recorded previews unchanged
+                    try:
+                        excs = preview_sample_exceptions(op)
+                    except Exception:   # pragma: no cover - advisory
+                        excs = list(getattr(op, "sample_exceptions", [])
+                                    or [])
+                    for exc_name, row_repr in excs[
                             : self.exception_display_limit]:
-                    previews.append({"op": type(op).__name__, "op_id": op.id,
-                                     "exc": exc_name, "row": row_repr})
+                        previews.append({"op": type(op).__name__,
+                                         "op_id": op.id,
+                                         "exc": exc_name, "row": row_repr})
         self._write({"event": "job_start", "action": action,
                      "stages": [type(s).__name__ for s in plan],
                      # sample-time exception previews (reference:
@@ -102,6 +114,13 @@ class JobRecorder:
                     pred = None
             if pred is not None:
                 rec["predicted_compile_s"] = round(float(pred), 3)
+            # plan-time resolve-tier pick (plan/physical.ResolvePlan):
+            # which resolve tiers this stage can reach, decided from the
+            # analyzer inventory before any row executes
+            try:
+                rec["resolve_tier"] = stage.resolve_plan().tier
+            except Exception:   # pragma: no cover - advisory surface
+                pass
         self._write(rec)
         self._last_progress = 0.0
 
@@ -188,6 +207,17 @@ def _plan_lint_findings(plan: list) -> list:
             continue
         try:
             for op, attr, rep in reports():
+                # "statically typed: yes/no + why not" per operator
+                # (sample-free specialization, compiler/typeinfer.py)
+                tl = rep.typed_line()
+                if tl is not None and len(out) < _LINT_CAP:
+                    out.append({
+                        "op": type(op).__name__, "op_id": op.id,
+                        "udf": f"{rep.name}.{attr}" if attr != "udf"
+                        else rep.name,
+                        "kind": "typed", "reason": tl,
+                        "loc": f"{rep.filename}:{rep.line_base}",
+                        "conditional": False})
                 for f in rep.findings:
                     if len(out) >= _LINT_CAP:
                         return out
@@ -198,6 +228,16 @@ def _plan_lint_findings(plan: list) -> list:
                         "kind": f.kind, "reason": f.reason,
                         "loc": rep.loc(f),
                         "conditional": bool(f.conditional)})
+            dead = getattr(st, "dead_resolver_findings", None)
+            if dead is not None:
+                for rop, gop, reason in dead():
+                    if len(out) >= _LINT_CAP:
+                        return out
+                    out.append({
+                        "op": type(rop).__name__, "op_id": rop.id,
+                        "udf": f"guards #{gop.id}",
+                        "kind": "dead-resolver", "reason": reason,
+                        "loc": "", "conditional": False})
         except Exception:   # pragma: no cover - lint is advisory
             continue
     return out

@@ -23,10 +23,14 @@ _op_ids = itertools.count(1)
 # cross-job memo: chain identity -> sample rows / inferred schema. Rebuilding
 # a content-identical pipeline over fingerprintable sources skips re-running
 # every UDF over the sample (the reference reuses per-UDF hint results the
-# same way via its source_vault + JIT cache keying).
-_cross_job_samples: dict[str, list] = {}
-_cross_job_branchprofs: dict[str, dict] = {}
-_cross_job_schemas: dict[str, Any] = {}
+# same way via its source_vault + JIT cache keying). LRU-bounded: the old
+# grow-then-.clear() pattern dropped every warm schema the moment one insert
+# crossed the cap (utils/lru.py).
+from ..utils.lru import LruDict
+
+_cross_job_samples: LruDict = LruDict(256)
+_cross_job_branchprofs: LruDict = LruDict(256)
+_cross_job_schemas: LruDict = LruDict(512)
 
 
 SAMPLE_EXC_CAP = 16   # recorder slices to tuplex.webui.exceptionDisplayLimit
@@ -151,8 +155,6 @@ class LogicalOperator:
             else:
                 memo = self.sample()
                 if ck is not None:
-                    if len(_cross_job_samples) > 256:
-                        _cross_job_samples.clear()
                     _cross_job_samples[ck] = (
                         memo, list(getattr(self, "sample_exceptions", [])))
             self._sample_memo = memo
@@ -222,8 +224,6 @@ class UDFOperator(LogicalOperator):
                 memo = {} if len(rows) < 32 else profile_branches(
                     self.udf, rows, self._profile_call)
                 if ck is not None:
-                    if len(_cross_job_branchprofs) > 256:
-                        _cross_job_branchprofs.clear()
                     _cross_job_branchprofs[ck] = memo
             self._branch_prof_memo = memo
         return memo
@@ -239,10 +239,27 @@ class UDFOperator(LogicalOperator):
                 if hit is not None:
                     self._schema_cache = hit
                     return hit
-            self._schema_cache = self._infer_schema()
+            # sample-free specialization (compiler/typeinfer.py): when the
+            # abstract interpreter decides the output type EXACTLY from the
+            # UDF's AST, skip the CPython sample trace entirely. The static
+            # verdict is sound w.r.t. the trace (mismatch ⇒ widened to
+            # undecidable ⇒ None here), so memo keys/values stay compatible
+            # with traced runs.
+            from ..compiler.typeinfer import static_op_schema
+
+            static = static_op_schema(self)
+            if static is not None:
+                from ..compiler.analyzer import STATS
+
+                STATS["sample_traces_skipped"] += 1
+                # the webui's sample exception previews were a side effect
+                # of the trace this skips; the recorder re-runs them on
+                # demand (preview_sample_exceptions) only when enabled
+                self._sample_trace_skipped = True
+                self._schema_cache = static
+            else:
+                self._schema_cache = self._infer_schema()
             if ck is not None:
-                if len(_cross_job_schemas) > 512:
-                    _cross_job_schemas.clear()
                 _cross_job_schemas[ck] = self._schema_cache
         return self._schema_cache
 
@@ -543,6 +560,41 @@ class DecodeOperator(LogicalOperator):
                     for v, t in zip(vin, self.declared.types)]
             out.append(Row(vals, cols))
         return out
+
+
+def preview_sample_exceptions(op) -> list:
+    """Sample exception previews for the webui, run ON DEMAND for operators
+    whose schema came from the static verdict (sample-free specialization
+    skips the trace whose side effect they were). Reference-faithful: the
+    SampleProcessor runs only when the history server is attached, so the
+    recorder — not schema inference — pays for previews. No-op for
+    operators the trace (or a memo hit) already populated."""
+    if not getattr(op, "_sample_trace_skipped", False) \
+            or getattr(op, "sample_exceptions", None) is not None:
+        return list(getattr(op, "sample_exceptions", []) or [])
+    try:
+        rows = op.parent.cached_sample()
+        if isinstance(op, MapColumnOperator):
+            ci = op.parent.schema().columns.index(op.column)
+            for r in rows:
+                try:
+                    op.udf.func(r.values[ci])
+                except Exception as e:
+                    record_sample_exc(op, e, r)
+        else:
+            for r in rows:
+                try:
+                    apply_udf_python(op.udf, r)
+                except Exception as e:
+                    record_sample_exc(op, e, r)
+    except Exception:   # pragma: no cover - previews are advisory
+        pass
+    if getattr(op, "sample_exceptions", None) is None:
+        # mark the pass done even when nothing raised — record_sample_exc
+        # only creates the list on an exception, and without the marker
+        # every later job would re-run the whole sample per clean UDF
+        op.sample_exceptions = []
+    return list(op.sample_exceptions)
 
 
 def decode_cell_python(cell, t: T.Type, null_values) -> Any:

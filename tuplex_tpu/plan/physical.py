@@ -14,13 +14,107 @@ from __future__ import annotations
 import hashlib
 from typing import Callable, Optional
 
+import dataclasses
+
 from ..compiler.emitter import EmitCtx, Emitter, Frame
 from ..compiler.stagefn import input_row_cv, result_arrays
 from ..compiler.values import CV, tuple_cv
 from ..core import typesys as T
-from ..core.errors import NotCompilable
+from ..core.errors import NotCompilable, exception_class_for_code
 from ..runtime.jaxcfg import jnp
 from . import logical as L
+
+
+@dataclasses.dataclass(frozen=True)
+class ResolvePlan:
+    """Plan-time resolve-tier decision for one TransformStage, derived
+    from the analyzer's exception-site inventory and the static type
+    verdicts (see TransformStage.resolve_plan). The backend consults it
+    instead of inspecting error codes after D2H:
+
+    * ``use_general`` — whether the compiled general-case tier is worth
+      dispatching at all (a widened decode exists AND a decode-speculation
+      code is in the inventory). False skips the build attempt entirely —
+      previously every stage paid one doomed NotCompilable trace to learn
+      this.
+    * ``interpreter_possible`` — whether any DEVICE-coded row can reach
+      the per-row interpreter (input-boxed fallback rows are a runtime
+      property and always interpret).
+    * ``new_buffers()`` — per-code row buckets shaped by the inventory,
+      instantiated per partition at D2H unpack time.
+    """
+
+    codes: tuple                 # sorted possible codes (ints)
+    exact_codes: frozenset       # codes that are exact Python classes
+    use_general: bool
+    interpreter_possible: bool
+    tier: str                    # none | general | interpreter | both
+
+    def new_buffers(self) -> "ResolveBuffers":
+        return ResolveBuffers(self.codes)
+
+
+class ResolveBuffers:
+    """Per-code resolve buckets: row index -> (code, operator id) grouped
+    by exception-class code, preallocated from the plan-time inventory.
+    Codes the inventory missed land in ``other`` — attribution degrades
+    to the catch-all, correctness (every row is still routed) does not."""
+
+    __slots__ = ("by_code", "other")
+
+    def __init__(self, codes):
+        self.by_code: dict[int, list] = {int(c): [] for c in codes}
+        self.other: list = []
+
+    def add(self, idx: int, code: int, op_id: int) -> None:
+        buf = self.by_code.get(code)
+        (buf if buf is not None else self.other).append((idx, code, op_id))
+
+    def add_many(self, idx, packed) -> None:
+        """Vectorized bucket fill from the device error lattice: `idx` are
+        row positions, `packed` the raw int32 lattice values (class code in
+        the low byte, operator id above — core/errors pack_device_code)."""
+        import numpy as np
+
+        idx = np.asarray(idx)
+        packed = np.asarray(packed)
+        codes = packed & 0xFF
+        opids = packed >> 8
+        known = np.zeros(len(idx), dtype=bool)
+        for c, buf in self.by_code.items():
+            m = codes == c
+            if m.any():
+                known |= m
+                buf.extend(zip(idx[m].tolist(), codes[m].tolist(),
+                               opids[m].tolist()))
+        m = ~known
+        if m.any():
+            self.other.extend(zip(idx[m].tolist(), codes[m].tolist(),
+                                  opids[m].tolist()))
+
+    def internal_rows(self) -> list:
+        """(idx, code, op_id) for rows whose code is NOT an exact Python
+        exception class — the compiled general tier's candidate set."""
+        out = [t
+               for c, buf in self.by_code.items()
+               if exception_class_for_code(c) is None
+               for t in buf]
+        out.extend(t for t in self.other
+                   if exception_class_for_code(t[1]) is None)
+        out.sort()
+        return out
+
+    def exact_rows(self) -> list:
+        """(idx, code, op_id) for rows whose code IS an exact Python
+        exception class (the no-resolver fast exit's candidate set)."""
+        out = [t
+               for c, buf in self.by_code.items()
+               if exception_class_for_code(c) is not None
+               for t in buf]
+        out.extend(t for t in self.other
+                   if exception_class_for_code(t[1]) is not None)
+        out.sort()
+        return out
 
 
 class TransformStage:
@@ -85,8 +179,10 @@ class TransformStage:
     def possible_exception_codes(self) -> list:
         """Every ExceptionCode rows of this stage can carry, known at PLAN
         time from the analyzer's exception-site inventory (no sampling):
-        per-UDF sites, decode codes for fused decodes, PYTHON_FALLBACK when
-        any part of the stage routes to the interpreter."""
+        per-UDF sites, decode codes for fused decodes, NORMALCASEVIOLATION
+        when branch speculation may prune a cold arm (rows entering one
+        raise it), PYTHON_FALLBACK when any part of the stage routes to
+        the interpreter."""
         from ..core.errors import ExceptionCode as EC
 
         codes: set = set()
@@ -96,6 +192,8 @@ class TransformStage:
             if isinstance(op, L.DecodeOperator):
                 codes |= {EC.NULLERROR, EC.BADPARSE_STRING_INPUT,
                           EC.NORMALCASEVIOLATION}
+        if self.speculation_pruned():
+            codes.add(EC.NORMALCASEVIOLATION)
         for op, attr, rep in self.udf_reports():
             if isinstance(op, (L.ResolveOperator, L.IgnoreOperator)):
                 continue   # slow-path-only UDFs never emit device codes
@@ -103,6 +201,143 @@ class TransformStage:
             if rep.must_fallback:
                 codes.add(EC.PYTHON_FALLBACK)
         return sorted(codes)
+
+    def speculation_pruned(self) -> bool:
+        """Whether branch speculation may have pruned a cold arm in this
+        stage (some fused UDF's sample profile never took an arm). Over-
+        approximates the emitter's arm-weight gate — sound for the resolve
+        plan: the general tier stays available wherever pruned-arm rows
+        could need the non-speculating vectorized re-run."""
+        if not self.speculate_branches:
+            return False
+        for op in self.ops:
+            if isinstance(op, (L.ResolveOperator, L.IgnoreOperator)):
+                continue
+            bp = getattr(op, "branch_profile", None)
+            if bp is None:
+                continue
+            try:
+                prof = bp()
+            except Exception:
+                continue
+            if any(False in v for v in prof.values()):
+                return True
+        return False
+
+    def resolve_plan(self) -> "ResolvePlan":
+        """Plan-time resolve-tier decision (ROADMAP "per-code resolve
+        preallocation"): the analyzer's exception inventory + the static
+        type verdicts bound which error codes this stage can emit, so the
+        backend picks its resolve tiers and preallocates per-code row
+        buffers BEFORE any D2H — instead of discovering after the fetch
+        that (say) the stage has no general-case decode to re-run, or
+        scanning every error row twice to classify it. Memoized: the plan
+        is a pure function of the stage."""
+        memo = getattr(self, "_resolve_plan_memo", None)
+        if memo is None:
+            from ..core.errors import ExceptionCode as EC
+
+            codes = self.possible_exception_codes()
+            # the compiled general tier retires exactly two speculation
+            # failure kinds, both decidable at plan time: normal-case
+            # DECODE violations (needs a widened decode to re-run under)
+            # and pruned-BRANCH violations (needs the non-speculating
+            # re-compile, no decode required)
+            has_general_decode = any(
+                isinstance(op, L.DecodeOperator) and op.general is not None
+                for op in self.ops)
+            retirable = {EC.NORMALCASEVIOLATION, EC.BADPARSE_STRING_INPUT,
+                         EC.NULLERROR}
+            spec_pruned = self.speculation_pruned()
+            use_general = (not self.force_interpret
+                           and (spec_pruned
+                                or (has_general_decode
+                                    and any(c in retirable
+                                            for c in codes))))
+            exact_codes = frozenset(
+                int(c) for c in codes
+                if exception_class_for_code(int(c)) is not None)
+            internal = [c for c in codes if int(c) not in exact_codes]
+            # the per-row interpreter is reachable when the stage is routed
+            # there outright, a resolver/ignore must run, or an internal
+            # code can survive the general tier (input-boxed fallback rows
+            # are a runtime property and always interpret — `statically`
+            # here bounds the DEVICE-code paths only)
+            interpreter_possible = bool(
+                self.force_interpret or self.has_resolvers or internal)
+            # fully statically typed + empty inventory: the inference
+            # verdict says no device code fires at all ("none" tier)
+            if not codes and not self.force_interpret:
+                tier = "none"
+            elif use_general and interpreter_possible:
+                tier = "general+interpreter"
+            elif use_general:
+                tier = "general"
+            elif interpreter_possible:
+                tier = "interpreter"
+            else:
+                # only exact Python-class codes and no resolver: error rows
+                # take the no-resolver exact exit, nothing ever re-runs
+                tier = "exact-exit"
+            memo = self._resolve_plan_memo = ResolvePlan(
+                codes=tuple(int(c) for c in codes),
+                exact_codes=exact_codes,
+                use_general=use_general,
+                interpreter_possible=interpreter_possible,
+                tier=tier)
+        return memo
+
+    def dead_resolver_findings(self) -> list:
+        """Plan-time dead-resolver lint (ROADMAP "lint-driven authoring
+        loop"): [(resolver op, guarded op, reason)] for every resolver or
+        ignore whose target exception code the guarded operator's
+        exception inventory proves it can never raise. Advisory — dead
+        resolvers cost a per-row class check on the slow path and usually
+        indicate the author guards the wrong operator."""
+        memo = getattr(self, "_dead_resolvers_memo", None)
+        if memo is None:
+            from ..compiler.analyzer import dead_resolver_reason, op_analysis
+
+            memo = []
+            for i, op in enumerate(self.ops):
+                if not isinstance(op, (L.ResolveOperator, L.IgnoreOperator)):
+                    continue
+                # the guarded operator: nearest preceding non-resolver
+                guarded = None
+                for prev in reversed(self.ops[:i]):
+                    if not isinstance(prev, (L.ResolveOperator,
+                                             L.IgnoreOperator)):
+                        guarded = prev
+                        break
+                if guarded is None or not isinstance(guarded, L.UDFOperator):
+                    continue
+                rep = op_analysis(guarded)
+                if rep is None:
+                    continue
+                # the "no unknown callee" proof must be the call-whitelist
+                # walk, NOT the type verdict's exactness: the abstract
+                # interpreter swallows Undecidable in type-total contexts
+                # (int()/len() args, comparisons, bare expressions), so an
+                # exact verdict can coexist with an unknown call that DOES
+                # raise the resolver's target
+                import types as _types
+
+                from ..compiler.analyzer import _calls_all_known
+
+                udf = guarded.udf
+                module_names = {
+                    k: m.__name__.split(".")[0]
+                    for k, m in getattr(udf, "globals", {}).items()
+                    if isinstance(m, _types.ModuleType)}
+                tree = getattr(udf, "tree", None)
+                reason = dead_resolver_reason(
+                    rep, op.exc_class,
+                    fully_typed=tree is not None
+                    and _calls_all_known(tree, module_names))
+                if reason:
+                    memo.append((op, guarded, reason))
+            self._dead_resolvers_memo = memo
+        return memo
 
     def python_pipeline(self, input_names: Optional[tuple] = None):
         """Cached per-stage compiled Python fallback pipeline (reference:
@@ -188,7 +423,9 @@ class TransformStage:
                           speculate=self.speculate_branches and not general)
         if general and not any(
                 isinstance(op, L.DecodeOperator) and op.general is not None
-                for op in ops):
+                for op in ops) and not self.speculation_pruned():
+            # nothing for a general re-run to widen: no supertype decode
+            # AND no speculation-pruned arm to re-compile without pruning
             raise NotCompilable("stage has no general-case decode")
 
         plan = _compaction_plan(ops) if (compaction and not general) else {}
@@ -856,7 +1093,11 @@ def _apply_projection(stage: TransformStage, output_required=None) -> None:
         pass    # schema inference unchanged on failure (pre-existing state)
 
 
-_op_compiles_cache: dict = {}
+# compile-probe verdict memo — LRU-bounded like the plan/logical.py memos
+# (grow-then-.clear() dropped every warm probe verdict at the cap)
+from ..utils.lru import LruDict
+
+_op_compiles_cache: LruDict = LruDict(4096)
 import itertools as _it
 _uid_counter = _it.count()
 
@@ -885,8 +1126,6 @@ def op_compiles(op: L.LogicalOperator, input_schema: T.RowType,
     if hit is not None:
         return hit
     result = _op_compiles_uncached(op, input_schema, speculate)
-    if len(_op_compiles_cache) > 4096:
-        _op_compiles_cache.clear()
     _op_compiles_cache[ck] = result
     return result
 

@@ -1,0 +1,62 @@
+"""Bounded LRU dict for plan-time memo tables.
+
+Several cross-job memos (sample rows, inferred schemas, branch profiles,
+UDF analysis reports, compile-probe verdicts) used the same eviction
+anti-pattern: grow to a cap, then ``.clear()`` wholesale — one insert past
+the cap dropped EVERY warm entry, so a steady-state workload re-ran its
+whole sample/analysis corpus every few hundred plans. ``LruDict`` keeps
+the hot set: reads refresh recency, inserts evict only the single oldest
+entry (reference analog: the JITCompiler executable cache is an LRU for
+exactly this reason, JitCache in exec/local.py)."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+_MISSING = object()
+
+
+class LruDict:
+    """Minimal LRU mapping. Not thread-safe by itself; the plan-time memos
+    it backs are only touched under the GIL from planning code."""
+
+    __slots__ = ("_store", "capacity")
+
+    def __init__(self, capacity: int = 256):
+        if capacity <= 0:
+            raise ValueError("LruDict capacity must be positive")
+        self._store: OrderedDict = OrderedDict()
+        self.capacity = capacity
+
+    def get(self, key, default=None):
+        v = self._store.get(key, _MISSING)
+        if v is _MISSING:
+            return default
+        self._store.move_to_end(key)
+        return v
+
+    def __getitem__(self, key):
+        v = self._store[key]
+        self._store.move_to_end(key)
+        return v
+
+    def __setitem__(self, key, value) -> None:
+        self._store[key] = value
+        self._store.move_to_end(key)
+        while len(self._store) > self.capacity:
+            self._store.popitem(last=False)
+
+    def __contains__(self, key) -> bool:
+        return key in self._store
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+    def pop(self, key, default=None):
+        return self._store.pop(key, default)
+
+    def clear(self) -> None:
+        self._store.clear()
+
+    def keys(self):
+        return self._store.keys()

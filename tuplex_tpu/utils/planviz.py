@@ -89,10 +89,20 @@ def explain(sink, options=None, lint: bool = False) -> str:
                     if attr != "udf":
                         lines[0] = f"{lines[0]} [{attr}]"
                     out.extend(lines)
+            dead = getattr(st, "dead_resolver_findings", None)
+            if dead is not None:
+                for rop, gop, reason in dead():
+                    out.append(f"  lint: #{rop.id} {reason} "
+                               f"(guards #{gop.id})")
             codes = st.possible_exception_codes()
             if codes:
                 out.append("  possible row error codes: "
                            + ", ".join(c.name for c in codes))
+            rp = getattr(st, "resolve_plan", None)
+            if rp is not None:
+                # the plan-time tier verdict (plan/physical.ResolvePlan):
+                # which resolve machinery this stage can ever need
+                out.append(f"  resolve tier: {rp().tier}")
     return "\n".join(out)
 
 

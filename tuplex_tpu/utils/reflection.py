@@ -260,13 +260,15 @@ def get_udf_source(func: Callable) -> UDFSource:
         # UDF, but keep real param names so schema hinting still works
         source = ""
         tree_node = _dummy(func.__code__.co_varnames[: func.__code__.co_argcount])
-    if len(_source_memo) > 4096:
-        _source_memo.clear()
     _source_memo[code] = source
     return UDFSource(func, source, tree_node, globs, func.__name__)
 
 
-_source_memo: dict = {}   # code object -> normalized source ("" = no source)
+# code object -> normalized source ("" = no source). LRU-bounded: the old
+# grow-then-.clear() pattern dropped every warm entry at the cap (utils/lru)
+from .lru import LruDict
+
+_source_memo: LruDict = LruDict(4096)
 
 
 def _reparse(source: str) -> ast.AST | None:
