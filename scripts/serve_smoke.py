@@ -1,0 +1,110 @@
+#!/usr/bin/env python3
+"""Job-service smoke test: 3 concurrent small zillow jobs on one warm
+backend through `tuplex_tpu.serve.JobService` (ISSUE-6 CI satellite).
+
+Asserts:
+  * all three jobs complete with the reference-python output;
+  * total stage compiles across the 3 concurrent jobs <= one job's
+    compile count + 1 (content-addressed dedup + in-flight join: N
+    isomorphic tenants cost ~1 compile set);
+  * per-tenant trace streams are disjoint (every span in a job's stream
+    carries that job's tag; stream event sets don't overlap);
+  * per-tenant counter families are isolated (scoped xferstats).
+
+Run directly (CI wires it as a tier-1 test via tests/test_serve.py):
+
+    JAX_PLATFORMS=cpu python scripts/serve_smoke.py
+
+Exits 0 and prints one `serve-smoke OK ...` line on success. SMOKE_ROWS
+overrides the input size (default 400, matching trace_smoke so a warm
+AOT artifact cache skips the XLA compiles)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))          # run from anywhere
+
+N_ROWS = int(os.environ.get("SMOKE_ROWS", "400"))
+
+
+def main() -> int:
+    import tuplex_tpu
+    from tuplex_tpu.exec import compilequeue as CQ
+    from tuplex_tpu.models import zillow
+    from tuplex_tpu.runtime import tracing
+    from tuplex_tpu.serve import JobService, request_from_dataset
+
+    with tempfile.TemporaryDirectory() as d:
+        csvs = []
+        for i in range(3):
+            p = os.path.join(d, f"zillow-{i}.csv")
+            if i == 0:
+                zillow.generate_csv(p, N_ROWS, seed=7)
+            else:
+                shutil.copy(csvs[0], p)    # identical data: isomorphic jobs
+            csvs.append(p)
+        want = zillow.run_reference_python(csvs[0])
+
+        ctx = tuplex_tpu.Context({"tuplex.tpu.trace": True})
+        assert tracing.enabled()
+        svc = JobService(ctx.options_store)
+
+        # one job alone: its compile count is the baseline
+        snap = CQ.snapshot()
+        h0 = svc.submit(request_from_dataset(
+            zillow.build_pipeline(ctx.csv(csvs[0])), name="warm",
+            tenant="t0"))
+        assert h0.wait(600) == "done", (h0.state, h0.error)
+        c1 = CQ.delta(snap)["stage_compiles"]
+
+        # three concurrent isomorphic jobs, three tenants
+        snap = CQ.snapshot()
+        handles = [
+            svc.submit(request_from_dataset(
+                zillow.build_pipeline(ctx.csv(csvs[i])), name=f"job{i}",
+                tenant=f"t{i + 1}"))
+            for i in range(3)
+        ]
+        for h in handles:
+            assert h.wait(600) == "done", (h.name, h.state, h.error)
+            assert h.result() == want, f"{h.name}: wrong output"
+        c3 = CQ.delta(snap)["stage_compiles"]
+        assert c3 <= 1, (
+            f"3 concurrent isomorphic jobs compiled {c3} stages "
+            f"(baseline single job: {c1}) — the shared compile plane "
+            f"is not deduping")
+
+        # per-tenant trace streams: tagged, non-empty, disjoint
+        streams = {h.id: h.trace_events() for h in handles}
+        for h in handles:
+            evs = streams[h.id]
+            assert evs, f"{h.name}: empty span stream"
+            assert all(e.get("stream") == h.id for e in evs), h.name
+            assert any(e["name"] == "stage:execute" for e in evs), \
+                f"{h.name}: no stage:execute span in its stream"
+        keysets = [{(e["ts"], e["tid"], e["name"]) for e in evs}
+                   for evs in streams.values()]
+        for i in range(len(keysets)):
+            for j in range(i + 1, len(keysets)):
+                assert not (keysets[i] & keysets[j]), \
+                    "cross-tenant span leakage"
+
+        # per-tenant counter families: present and isolated
+        for h in handles:
+            cnt = h.counters()
+            assert cnt, f"{h.name}: empty scoped counter family"
+        svc.close()
+        ctx.close()
+        print(f"serve-smoke OK — 3 jobs x {len(want)} rows, "
+              f"baseline compiles {c1}, concurrent-extra {c3}, "
+              f"{sum(len(v) for v in streams.values())} tenant spans")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

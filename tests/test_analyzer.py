@@ -442,3 +442,73 @@ def test_main_subcommands(tmp_path, capsys):
     assert main(["lint", str(p)]) == 0
     assert main(["lint", str(p), "--strict"]) == 1
     assert "fallback" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# positive resolve() suggestions (ISSUE-6 satellite: lint-loop remainder)
+# ---------------------------------------------------------------------------
+
+def test_lint_suggests_resolver_for_exact_only_inventory(tmp_path, capsys):
+    # unguarded UDF whose inventory holds ONLY exact Python classes
+    # (division -> ZeroDivisionError) over whitelisted-total calls
+    p = tmp_path / "sug.py"
+    p.write_text(
+        "import tuplex_tpu\n"
+        "c = tuplex_tpu.Context()\n"
+        "ds = c.parallelize([1, 2, 0]).map(lambda x: 10 // x)\n"
+        "ds.collect()\n")
+    rc = az.lint_file(str(p))
+    out = capsys.readouterr().out
+    assert rc == 0                      # advisory, never a failure
+    assert "suggestion:" in out
+    assert "can only raise ZERODIVISIONERROR" in out
+    assert ".resolve() or .ignore()" in out
+    assert "1 suggestion(s)" in out
+    # suggestions never trip --strict
+    assert az.lint_file(str(p), strict=True) == 0
+
+
+def test_lint_no_suggestion_when_guarded_or_unknown_calls(tmp_path,
+                                                          capsys):
+    p = tmp_path / "nosug.py"
+    p.write_text(
+        "import tuplex_tpu\n"
+        "import mylib\n"
+        "c = tuplex_tpu.Context()\n"
+        # guarded by a chained resolve -> no suggestion
+        "a = (c.parallelize([1, 0]).map(lambda x: 10 // x)\n"
+        "     .resolve(ZeroDivisionError, lambda x: -1))\n"
+        # unknown callee -> no 'can only raise' claim is sound
+        "b = c.parallelize([1]).map(lambda x: mylib.f(x))\n"
+        "a.collect(); b.collect()\n")
+    assert az.lint_file(str(p)) == 0
+    out = capsys.readouterr().out
+    assert "suggestion:" not in out
+    assert "0 suggestion(s)" in out
+
+
+def test_explain_lint_shows_stage_suggestion(ctx, capsys):
+    ds = ctx.parallelize([{"k": 1}, {"k": 0}]).map(lambda x: 7 // x["k"])
+    text = ds.explain(lint=True)
+    assert "suggestion: this stage can only raise" in text
+    assert ".resolve() or .ignore()" in text
+    # attaching the resolver silences the suggestion
+    ds2 = (ctx.parallelize([{"k": 1}, {"k": 0}])
+           .map(lambda x: 7 // x["k"])
+           .resolve(ZeroDivisionError, lambda x: -1))
+    text2 = ds2.explain(lint=True)
+    assert "suggestion: this stage can only raise" not in text2
+
+
+def test_no_suggestion_for_variable_attached_resolver(tmp_path, capsys):
+    # the resolver attaches through a variable, not a chained call —
+    # claiming the map is unguarded would be wrong
+    p = tmp_path / "varsug.py"
+    p.write_text(
+        "import tuplex_tpu\n"
+        "c = tuplex_tpu.Context()\n"
+        "ds = c.parallelize([1, 0]).map(lambda x: 10 // x)\n"
+        "ds2 = ds.resolve(ZeroDivisionError, lambda x: -1)\n"
+        "ds2.collect()\n")
+    assert az.lint_file(str(p)) == 0
+    assert "suggestion:" not in capsys.readouterr().out

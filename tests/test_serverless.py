@@ -420,6 +420,98 @@ def test_worker_task_events_stream_to_dashboard(tmp_path):
     assert "task 0" in html_doc
 
 
+def test_retry_ladder_logs_every_attempt_then_degrades(tmp_path,
+                                                       monkeypatch):
+    """ISSUE-6 satellite: a task failing `tuplex.aws.retryCount` times
+    must degrade to in-process driver execution with EVERY attempt in the
+    failure log (attempt 0, 1, ..., retryCount), not just the last."""
+    import subprocess
+    import sys
+
+    retries = 2
+    c = _ctx(tmp_path, **{"tuplex.aws.retryCount": retries,
+                          "tuplex.aws.maxConcurrency": 1,
+                          "tuplex.aws.reuseWorkers": "false"})
+
+    def always_dead(self, run_dir, data_dir, task, tspec, req_base):
+        os.makedirs(os.path.join(run_dir, f"task-{task:04d}"),
+                    exist_ok=True)
+        return subprocess.Popen([sys.executable, "-c",
+                                 "raise SystemExit(3)"])
+
+    monkeypatch.setattr(ServerlessBackend, "_launch", always_dead)
+    got = c.parallelize(list(range(800))).map(lambda x: x * 2).collect()
+    assert got == [x * 2 for x in range(800)]   # driver degrade succeeded
+    entries = [e for e in c.backend.failure_log
+               if e.get("stage") == "serverless" and e.get("task") == 0]
+    # one log entry per attempt, in order: 0 .. retryCount
+    assert [e["attempt"] for e in entries] == list(range(retries + 1)), \
+        entries
+    assert all(e.get("rc") == 3 for e in entries), entries
+
+
+def test_warm_worker_backend_cache_keeps_interleaved_tenants(
+        tmp_path, monkeypatch):
+    """ISSUE-6 satellite: run_task's backend cache is LRU-bounded, not
+    one-live-set — interleaved tenants with different option fingerprints
+    must NOT rebuild backends (and lose their traced executables) on
+    every alternation."""
+    import pickle
+
+    import tuplex_tpu
+    from tuplex_tpu.exec import local as XL
+    from tuplex_tpu.exec import worker as W
+    from tuplex_tpu.exec.serverless import serialize_stage
+    from tuplex_tpu.io.tuplexfmt import write_partitions_tuplex
+    from tuplex_tpu.plan.physical import plan_stages
+    from tuplex_tpu.utils.lru import LruDict
+
+    c0 = tuplex_tpu.Context()
+    ds = c0.parallelize([(i, i * 2) for i in range(200)],
+                        columns=["a", "b"]).map(lambda x: x["a"] + x["b"])
+    stage = plan_stages(ds._op, c0.options_store)[0]
+    spec = serialize_stage(stage)
+    from tuplex_tpu.api.dataset import _source_partitions
+
+    parts = _source_partitions(c0, stage, lazy=False)
+    indir = str(tmp_path / "staged")
+    write_partitions_tuplex(indir, list(parts), backend=c0.backend)
+
+    def make_req(path, opts_extra):
+        opts = c0.options_store.to_dict()
+        opts.update(opts_extra)
+        req = {"stage": spec, "options": opts, "sink": None, "task": 0,
+               "files": None, "indir": indir,
+               "outdir": str(tmp_path / "out" / os.path.basename(path))}
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as fp:
+            pickle.dump(req, fp)
+        return path
+
+    builds = {"n": 0}
+    orig_init = XL.LocalBackend.__init__
+
+    def counting_init(self, options):
+        builds["n"] += 1
+        orig_init(self, options)
+
+    monkeypatch.setattr(XL.LocalBackend, "__init__", counting_init)
+    backends = LruDict(4)
+    # two tenants (distinct option fingerprints), interleaved twice
+    reqs = {
+        "a": make_req(str(tmp_path / "ta" / "request.pkl"),
+                      {"tuplex.normalcaseThreshold": "0.9"}),
+        "b": make_req(str(tmp_path / "tb" / "request.pkl"),
+                      {"tuplex.normalcaseThreshold": "0.85"}),
+    }
+    for tenant in ("a", "b", "a", "b", "a"):
+        resp = W.run_task(reqs[tenant], backends)
+        assert resp["ok"] and resp["rows"] == 200, resp
+    # one backend per tenant fingerprint — NOT one per alternation
+    assert builds["n"] == 2, builds
+    assert len(backends) == 2
+
+
 # -- warm worker pool (reference: Lambda container reuse) -------------------
 
 def test_warm_pool_reuses_workers(tmp_path):

@@ -9,6 +9,7 @@ TuplexShell, launched by the `tuplex` console entry point). Subcommands:
     python -m tuplex_tpu lint script.py   # plan-time UDF static analysis
     python -m tuplex_tpu compilestats script.py   # compile forecast
     python -m tuplex_tpu trace out.json   # history -> Chrome trace JSON
+    python -m tuplex_tpu serve <root>     # multi-tenant job service
     python -m tuplex_tpu version          # print the package version
 
 `lint` runs the compiler's static analyzer (compiler/analyzer.py) over every
@@ -58,6 +59,19 @@ def main(argv=None) -> int:
     tr.add_argument("--log-dir", default=".",
                     help="directory holding tuplex_history.jsonl "
                          "(tuplex.logDir; default .)")
+    sv = sub.add_parser(
+        "serve",
+        help="run the multi-tenant job service on this process's warm "
+             "device (scratch-dir submit/poll/fetch protocol; stop by "
+             "touching <root>/STOP)")
+    sv.add_argument("root", help="service root directory (clients drop "
+                                 "requests under <root>/inbox/)")
+    sv.add_argument("--conf", default=None,
+                    help="options file (YAML/JSON) merged over defaults")
+    sv.add_argument("--slots", type=int, default=None,
+                    help="scheduler slots (tuplex.serve.slots)")
+    sv.add_argument("--queue-depth", type=int, default=None,
+                    help="admission queue depth (tuplex.serve.queueDepth)")
     sub.add_parser("version", help="print the package version")
     args = parser.parse_args(argv)
 
@@ -82,6 +96,24 @@ def main(argv=None) -> int:
         except OSError as e:
             print(f"compilestats: {e}", file=sys.stderr)
             return 2
+    if args.cmd == "serve":
+        from .core.options import ContextOptions
+        from .serve.client import service_loop
+
+        opts = ContextOptions()
+        if args.conf:
+            opts.update(args.conf)
+        if args.slots is not None:
+            opts.set("tuplex.serve.slots", args.slots)
+        if args.queue_depth is not None:
+            opts.set("tuplex.serve.queueDepth", args.queue_depth)
+        try:
+            n = service_loop(args.root, opts)
+        except KeyboardInterrupt:
+            print("serve: interrupted", file=sys.stderr)
+            return 130
+        print(f"serve: {n} job(s) served")
+        return 0
     if args.cmd == "trace":
         from .history.recorder import history_to_chrome
 

@@ -203,6 +203,36 @@ class Context:
 
         VirtualFileSystem.rm(pattern)
 
+    # ------------------------------------------------------------------
+    def job_service(self):
+        """The lazily-created in-process JobService (serve/) sharing this
+        context's options and warm device. One per Context; closed with
+        it."""
+        svc = getattr(self, "_job_service", None)
+        if svc is None:
+            from ..serve import JobService
+
+            svc = self._job_service = JobService(
+                self.options_store, recorder=self.recorder)
+        return svc
+
+    def submit(self, dataset, name: str = "job", tenant: str = "default",
+               memory_budget=None, weight=None):
+        """Submit a DataSet pipeline to the job service instead of running
+        it inline: returns a JobHandle immediately; the service fair-shares
+        stage dispatches across all submitted jobs on the warm device
+        (serve/service.py). ``memory_budget`` (bytes or a "128MB" string)
+        bounds the job's resident partitions — past it the job spills via
+        the LRU evictor rather than pressuring other tenants."""
+        from ..core.options import _size_to_bytes
+        from ..serve import request_from_dataset
+
+        budget = None if memory_budget is None \
+            else _size_to_bytes(memory_budget)
+        req = request_from_dataset(dataset, name=name, tenant=tenant,
+                                   memory_budget=budget, weight=weight)
+        return self.job_service().submit(req)
+
     def uiWebURL(self) -> str:
         if self._webui_url is not None:
             return self._webui_url   # "" when autostart failed: not serving
@@ -213,6 +243,13 @@ class Context:
     def close(self) -> None:
         """Release context resources (the autostarted webui server's socket
         and thread; warm serverless workers). Safe to call repeatedly."""
+        svc = getattr(self, "_job_service", None)
+        if svc is not None:
+            try:
+                svc.close()
+            except Exception:
+                pass
+            self._job_service = None
         be = getattr(self, "backend", None)
         if be is not None and hasattr(be, "close"):
             try:

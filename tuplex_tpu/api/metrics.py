@@ -6,6 +6,12 @@ from __future__ import annotations
 
 
 class Metrics:
+    #: when set (a callable returning a dict), ``as_dict()['counters']``
+    #: uses it instead of the process-global registry — the job service
+    #: installs each job's scoped family here so a tenant's metrics never
+    #: embed other tenants' transfer accounting
+    counters_source = None
+
     def __init__(self):
         self.stages: list[dict] = []
         self.plans: list[dict] = []
@@ -144,10 +150,12 @@ class Metrics:
             "sample_traces_skipped": self.sampleTracesSkipped(),
             "d2h_bytes": self.d2hBytes(),
             "h2d_bytes": self.h2dBytes(),
-            # the process-wide tagged counter registry (runtime/xferstats):
-            # cumulative since process start — transfer bytes by call-site
-            # tag, spill volume, compile-cache hit/miss counts
-            "counters": xferstats.as_dict(),
+            # the tagged counter registry (runtime/xferstats): process-
+            # cumulative by default; a job-service Metrics reports its
+            # job's scoped family instead (counters_source)
+            "counters": (self.counters_source()
+                         if self.counters_source is not None
+                         else xferstats.as_dict()),
         }
 
     def as_json(self) -> str:

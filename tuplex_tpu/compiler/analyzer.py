@@ -832,6 +832,78 @@ def _script_dead_resolvers(tree: ast.Module, module_names: dict,
     return out
 
 
+def _script_resolver_suggestions(tree: ast.Module, module_names: dict,
+                                 path: str) -> list:
+    """Positive suggestions (the dead-resolver lint's twin): a UDF call
+    whose exception inventory contains ONLY exact Python exception classes
+    and that no chained ``resolve``/``ignore`` guards gets a "consider a
+    resolver or ignore" line. Same syntactic soundness bar as
+    ``_script_dead_resolvers`` — suggested only when every call in the
+    body is whitelisted-total (an unknown callee could raise anything, so
+    no "can only raise" claim is made)."""
+    from ..core.errors import exception_class_for_code
+
+    module_fns = _script_module_fns(tree)
+    guarded: set = set()
+    guarded_names: set = set()
+    for n in ast.walk(tree):
+        if not (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("resolve", "ignore")):
+            continue
+        recv = n.func.value
+        while (isinstance(recv, ast.Call)
+               and isinstance(recv.func, ast.Attribute)
+               and recv.func.attr in ("resolve", "ignore")):
+            recv = recv.func.value
+        guarded.add(id(recv))
+        if isinstance(recv, ast.Name):
+            # `ds2 = ds.resolve(...)`: the guard attaches through a
+            # variable, not a chained call — any UDF call assigned to
+            # that name counts as guarded (claiming "no resolver" on it
+            # would be wrong)
+            guarded_names.add(recv.id)
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+            for t in n.targets:
+                if isinstance(t, ast.Name) and t.id in guarded_names:
+                    guarded.add(id(n.value))
+    out = []
+    for n in ast.walk(tree):
+        if not (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in (_UDF_METHODS - {"resolve"})
+                and id(n) not in guarded):
+            continue
+        udf_node = udf_name = None
+        for a in n.args:
+            if isinstance(a, ast.Lambda):
+                udf_node, udf_name = a, "<lambda>"
+                break
+            if isinstance(a, ast.Name) and a.id in module_fns:
+                udf_node, udf_name = module_fns[a.id], a.id
+                break
+        if udf_node is None:
+            continue
+        rep = analyze_tree(udf_node, name=udf_name,
+                           module_names=module_names, filename=path,
+                           line_base=getattr(udf_node, "lineno", 1),
+                           abs_lines=True)
+        if rep.must_fallback \
+                or not _calls_all_known(udf_node, module_names):
+            continue
+        codes = sorted(rep.exception_codes())
+        if not codes or any(exception_class_for_code(int(c)) is None
+                            for c in codes):
+            continue
+        names = "/".join(c.name for c in codes)
+        out.append(
+            f"{path}:{getattr(n, 'lineno', 1)}: suggestion: "
+            f"{udf_name} can only raise {names} — consider a "
+            f".resolve() or .ignore() after .{n.func.attr}()")
+    return out
+
+
 def _script_module_names(tree: ast.Module) -> dict:
     """{local binding -> real top-level module name} from the script's
     imports, so `import random as rnd` still classifies as nondeterministic."""
@@ -900,8 +972,15 @@ def lint_file(path: str, strict: bool = False, stream=None) -> int:
         emit()
         for line in dead:
             emit(line)
+    suggestions = _script_resolver_suggestions(tree, module_names, path)
+    if suggestions:
+        emit()
+        for line in suggestions:
+            emit(line)
     emit()
     emit(f"{len(udfs)} UDF(s): {n_fallback} fallback finding(s), "
          f"{n_sites} exception site(s), {n_typed} statically typed, "
-         f"{len(dead)} dead resolver(s)")
+         f"{len(dead)} dead resolver(s), "
+         f"{len(suggestions)} suggestion(s)")
+    # suggestions are positive/advisory: never a --strict failure
     return 1 if (strict and (n_fallback or dead)) else 0

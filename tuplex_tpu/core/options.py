@@ -78,6 +78,42 @@ DEFAULTS: dict[str, str] = {
                                             # ("" = inherit; one local chip
                                             # cannot be shared by N procs)
     "tuplex.aws.reuseWorkers": "true",      # warm container reuse analog
+    # --- job-service keys (serve/: multi-tenant pipelines, one warm device)
+    "tuplex.serve.queueDepth": "64",        # max queued+running jobs; a
+                                            # submit past this blocks for
+                                            # admissionTimeoutS, then is
+                                            # REJECTED (backpressure, never
+                                            # an unbounded backlog)
+    "tuplex.serve.admissionTimeoutS": "30", # seconds a submit may wait on a
+                                            # full queue before rejection
+    "tuplex.serve.slots": "1",              # scheduler worker slots = max
+                                            # concurrent in-flight device
+                                            # dispatches (1 on a single
+                                            # chip: no job can monopolize
+                                            # it, nothing oversubscribes it)
+    "tuplex.serve.jobMemory": "256MB",      # default per-job memory budget:
+                                            # each job's private
+                                            # MemoryManager budget — beyond
+                                            # it the job's partitions SPILL
+                                            # (runtime/spill.py LRU) instead
+                                            # of OOM-ing the shared process
+    "tuplex.serve.maxJobMemory": "0",       # cap on a request's memory
+                                            # budget; a request asking more
+                                            # is rejected at admission with
+                                            # a clear error (0 = uncapped)
+    "tuplex.serve.retainJobs": "256",       # completed/failed job records
+                                            # (incl. materialized result
+                                            # rows) the service keeps for
+                                            # late fetches; older terminal
+                                            # records are dropped so a
+                                            # long-lived service stays
+                                            # bounded (held JobHandles keep
+                                            # their own record alive)
+    "tuplex.serve.tenantWeights": "",       # "tenantA:2,tenantB:1" —
+                                            # deficit-weighted round-robin:
+                                            # weight w = w consecutive stage
+                                            # dispatches per scheduler cycle
+                                            # (unlisted tenants weigh 1)
     # --- TPU-native keys ---------------------------------------------------
     "tuplex.tpu.deviceBatchSize": "1048576",    # rows per device dispatch
     "tuplex.tpu.padBucketing": "q8",            # q8 | pow2 | exact

@@ -337,17 +337,28 @@ class LocalBackend:
                 out = jax.eval_shape(raw, avals)
             except Exception:
                 break
-            if not (packed and type(self) is LocalBackend
-                    and packing_enabled()):
-                # the packed variant traces a different (wire-layout) fn
-                # whose spec depends on content — skip its compile but keep
-                # chaining shapes through the raw fn
+            deadline = self.options.get_float(
+                "tuplex.tpu.compileDeadlineS", 0.0)
+            if packed and type(self) is LocalBackend and packing_enabled():
+                # packed-wire stage: the dispatched fn is the wire-layout
+                # closure, not `raw` — predict its buffer spec from the
+                # leaf avals (PackedStageFn.warm) so the packed executable
+                # prewarms in the AOT cache instead of compiling at first
+                # dispatch (ROADMAP compile-hardening item d)
+                try:
+                    pfn = PackedStageFn(raw, donate, tag=stage.key(),
+                                        n_ops=len(stage.ops),
+                                        deadline=deadline)
+                    f = pfn.warm(avals)
+                    if f is not None:
+                        futs.append(f)
+                except Exception:   # prewarm is speculative by contract
+                    pass
+            else:
                 futs.append(CQ.submit_compile(
                     raw, (avals,), donate_argnums=(0,) if donate else (),
                     salt=self.fn_cache_salt(), tag=stage.key(),
-                    n_ops=len(stage.ops),
-                    deadline_s=self.options.get_float(
-                        "tuplex.tpu.compileDeadlineS", 0.0)))
+                    n_ops=len(stage.ops), deadline_s=deadline))
             if stage.limit >= 0 or any(
                     isinstance(op, L.FilterOperator) for op in stage.ops):
                 break        # output row count is data-dependent

@@ -290,12 +290,21 @@ def rebuild_stage(spec: dict, options, files: Optional[list] = None):
     ops: list[L.LogicalOperator] = []
     parent = root
     schemas = pickle.loads(spec["schemas"])
-    for ospec, schema in zip(spec["ops"], schemas):
+    for i, (ospec, schema) in enumerate(zip(spec["ops"], schemas)):
         op = _op_rebuild(ospec, parent)
         # authoritative schemas travel with the spec: workers must never
         # re-speculate (different file subsets could sniff differently)
         op._schema_cache = schema          # UDFOperator slot
         op._schema = schema                # structural-op convention
+        # DETERMINISTIC stage-local ids: the emitter bakes `code |
+        # op_id << 8` literals into the kernel lattice, so ids from the
+        # session-global counter would give every rebuilt job a unique
+        # jaxpr and defeat the content-addressed executable dedup the
+        # job service depends on (N isomorphic tenants ~ 1 compile set).
+        # Ids only need to be unique WITHIN the stage: resolver matching
+        # and the python pipeline are positional, and nothing maps ids
+        # globally back to operators on the rebuild side.
+        op.id = i + 1
         ops.append(op)
         parent = op
 

@@ -45,11 +45,13 @@ def _set_platform() -> None:
         jax.config.update("jax_platforms", plat)
 
 
-def run_task(req_path: str, backends: dict | None = None) -> dict:
+def run_task(req_path: str, backends=None) -> dict:
     """Process one request pickle; returns the response dict (also written
     atomically to response.pkl next to the request). `backends` is a
-    per-process cache {options-fingerprint: LocalBackend} so warm workers
-    reuse traced stage executables across tasks."""
+    per-process cache {options-fingerprint: LocalBackend} — an
+    LRU-bounded mapping (utils/lru.LruDict in serve()) so warm workers
+    reuse traced stage executables across tasks AND across interleaved
+    tenants with different option sets."""
     import json
     import time
 
@@ -90,7 +92,9 @@ def run_task(req_path: str, backends: dict | None = None) -> dict:
         options = ContextOptions(opts_dict)
         backend = LocalBackend(options)
         if backends is not None:
-            backends.clear()        # one live option set per worker
+            # bounded LRU, NOT one-live-set: interleaved tenants with
+            # different option fingerprints used to rebuild backends (and
+            # lose every traced stage executable) on each alternation
             backends[fing] = backend
     options = backend.options
     fl_snap = len(backend.failure_log)
@@ -154,7 +158,16 @@ def serve() -> int:
     its log file and never reads it, so the 'READY'/'OK' lines below are
     log breadcrumbs, not a protocol (ADVICE r5)."""
     _set_platform()
-    backends: dict = {}
+    from ..utils.lru import LruDict
+
+    # one backend per option fingerprint, LRU-bounded: a multi-tenant
+    # driver interleaving option sets keeps each tenant's warm backend
+    # (and its traced executables) instead of thrashing on every task
+    try:
+        cap = max(1, int(os.environ.get("TUPLEX_WORKER_BACKENDS", "4")))
+    except ValueError:
+        cap = 4
+    backends = LruDict(cap)
     print("READY", flush=True)
     for line in sys.stdin:
         req_path = line.strip()

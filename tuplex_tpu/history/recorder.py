@@ -36,7 +36,10 @@ class JobRecorder:
     def _write(self, rec: dict) -> None:
         if not self.enabled:
             return
-        rec["job"] = self.job_id
+        # an explicit job id wins: the job SERVICE (serve/) interleaves
+        # many concurrent jobs through one recorder, so its events carry
+        # their own id instead of riding the single-job cursor
+        rec.setdefault("job", self.job_id)
         rec["ts"] = round(time.time(), 3)
         try:
             with open(self.path, "a") as fp:
@@ -160,6 +163,14 @@ class JobRecorder:
                      "wall_s": round(wall_s, 4),
                      "exception_counts": exc_counts})
 
+    def serve_job_event(self, job_id: str, event: str, **fields) -> None:
+        """Dashboard row for a JOB-SERVICE job (serve/): same event shapes
+        as the single-job path (`job_start`/`stage`/`job_done`) but keyed
+        by the service job's own id, so N concurrent tenants render as N
+        independent job rows instead of colliding on the recorder's
+        cursor."""
+        self._write({**fields, "event": event, "job": str(job_id)})
+
     def _write_job_spans(self) -> None:
         """Embed this job's span slice (runtime/tracing, when enabled) into
         the history file — the dashboard waterfall and the `trace` CLI
@@ -238,6 +249,19 @@ def _plan_lint_findings(plan: list) -> list:
                         "udf": f"guards #{gop.id}",
                         "kind": "dead-resolver", "reason": reason,
                         "loc": "", "conditional": False})
+            sug = getattr(st, "resolver_suggestions", None)
+            if sug is not None:
+                # positive twin of the dead-resolver row: the inventory
+                # proves only exact Python classes can fire, yet no
+                # resolver is attached
+                for reason in sug():
+                    if len(out) >= _LINT_CAP:
+                        return out
+                    out.append({
+                        "op": type(st).__name__, "op_id": "-",
+                        "udf": "", "kind": "suggestion",
+                        "reason": reason, "loc": "",
+                        "conditional": False})
         except Exception:   # pragma: no cover - lint is advisory
             continue
     return out

@@ -287,6 +287,32 @@ class TransformStage:
                 tier=tier)
         return memo
 
+    def resolver_suggestions(self) -> list:
+        """Positive lint twin of the dead-resolver warning (ROADMAP
+        lint-loop remainder): when the exception inventory proves this
+        stage can ONLY raise exact Python exception classes and the
+        author attached no resolver/ignore, suggest one — those rows take
+        the no-resolver exact exit today and surface as unresolved
+        exceptions the author may not know are recoverable. Suggested
+        only when every inventoried code maps to a Python class: a stage
+        that can also raise internal codes (NORMALCASEVIOLATION,
+        PYTHON_FALLBACK...) gets no "can only raise" claim."""
+        memo = getattr(self, "_resolver_suggestions_memo", None)
+        if memo is None:
+            memo = []
+            if not self.has_resolvers and not self.force_interpret:
+                codes = self.possible_exception_codes()
+                if codes and all(
+                        exception_class_for_code(int(c)) is not None
+                        for c in codes):
+                    names = "/".join(c.name for c in codes)
+                    memo.append(
+                        f"this stage can only raise {names} — consider a "
+                        f".resolve() or .ignore() so those rows recover "
+                        f"instead of surfacing as exceptions")
+            self._resolver_suggestions_memo = memo
+        return memo
+
     def dead_resolver_findings(self) -> list:
         """Plan-time dead-resolver lint (ROADMAP "lint-driven authoring
         loop"): [(resolver op, guarded op, reason)] for every resolver or

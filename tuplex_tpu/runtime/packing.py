@@ -134,18 +134,22 @@ def _wire_dtype(k: str, dtype, arrays, check_values: bool = False) -> str:
     return dt.str
 
 
-def _host_spec(arrays: dict):
+def _host_spec(arrays: dict, check_values: bool = True):
     """Deterministic layout: (key, shape, dtype_str, offset, wire_nbytes,
-    wire_dtype_str)."""
+    wire_dtype_str). ``check_values=False`` computes the layout from
+    shapes/dtypes alone (ShapeDtypeStruct avals work) — the PREDICTED spec
+    the AOT prewarm compiles against; it matches the dispatch-time spec
+    whenever the '#len' narrowing invariant holds (the normal case — a
+    violating partition just compiles its own wide-layout variant)."""
     spec = []
     off = 0
     for k in sorted(arrays):
         a = arrays[k]
         if not _packable(a.dtype):
             continue
-        wd = _wire_dtype(k, a.dtype, arrays, check_values=True)
+        wd = _wire_dtype(k, a.dtype, arrays, check_values=check_values)
         nb = _wire_nbytes(a.shape, wd)
-        spec.append((k, tuple(a.shape), a.dtype.str, off, nb, wd))
+        spec.append((k, tuple(a.shape), np.dtype(a.dtype).str, off, nb, wd))
         off += _pad(nb)
     return tuple(spec), off
 
@@ -587,47 +591,86 @@ class PackedStageFn:
         self._n_ops = n_ops      # feeds the stage-split tuner curve
         self._deadline = deadline   # compile deadline (CompileTimeout)
 
+    def _make_entry(self, spec, ekey):
+        """Build (and cache) the per-layout compiled entry: the traced
+        closure that unpacks `spec`, runs the stage, and re-packs —
+        shared verbatim by dispatch (__call__) and the AOT prewarm
+        (``warm``), so both produce the SAME jaxpr and therefore the same
+        content address in exec/compilequeue."""
+        cell: dict = {}
+
+        def traced(buf, extras):
+            args = _device_unpack(buf, spec)
+            args.update(extras)
+            outs = self._raw(args)
+            pack_outs = {k: v for k, v in outs.items()
+                         if _packable(jnp.asarray(v).dtype)}
+            extra_outs = {k: v for k, v in outs.items()
+                          if k not in pack_outs}
+            entries, vskip, lo32 = (
+                _build_varlen(args, outs, pack_outs)
+                if self._varlen else ([], (), {}))
+            obuf, ospec = _device_pack(pack_outs, skip=vskip,
+                                       lo32=lo32)
+            vbuf, vspec = (_device_pack_varlen(entries) if entries
+                           else (jnp.zeros(0, jnp.uint8), ()))
+            cell["ospec"] = ospec
+            cell["vspec"] = vspec
+            return obuf, vbuf, extra_outs
+
+        # content-addressed AOT route (exec/compilequeue): the trace —
+        # which records ospec/vspec into `cell` as a side effect — runs
+        # on every path (fingerprinting always traces); only the XLA
+        # compile is skipped on a fingerprint or disk-artifact hit
+        from ..exec.compilequeue import aot_jit
+
+        fn = aot_jit(traced, donate=self._donate, salt="pack",
+                     tag=self._tag, n_ops=self._n_ops,
+                     deadline=self._deadline)
+        entry = (fn, cell, traced)
+        self._fns[(spec, ekey)] = entry
+        return entry
+
+    def warm(self, avals: dict):
+        """Ahead-of-time compile against PREDICTED avals (the precompile
+        driver's chained shape walk): derive the wire-buffer layout from
+        the leaf avals alone and queue the packed executable's compile on
+        the pool, so a varlen-wire stage finds its executable already
+        built (or on disk) at first dispatch instead of compiling inline.
+        Returns the pool Future, or None when the layout has no packable
+        leaves. Speculative by construction: a value-dependent '#len'
+        narrowing miss only wastes one background compile."""
+        from ..exec import compilequeue as CQ
+
+        spec, total = _host_spec(avals, check_values=False)
+        if not spec:
+            return None
+        extras = {k: v for k, v in avals.items()
+                  if not _packable(np.dtype(v.dtype))}
+        ekey = tuple(sorted((k, tuple(v.shape), np.dtype(v.dtype).str)
+                            for k, v in extras.items()))
+        entry = self._fns.get((spec, ekey))
+        if entry is None:
+            entry = self._make_entry(spec, ekey)
+        buf_aval = jax.ShapeDtypeStruct((total,), np.uint8)
+        ex_avals = {k: jax.ShapeDtypeStruct(tuple(v.shape),
+                                            np.dtype(v.dtype))
+                    for k, v in extras.items()}
+        return CQ.submit_compile(
+            entry[2], (buf_aval, ex_avals),
+            donate_argnums=(0,) if self._donate else (), salt="pack",
+            tag=self._tag, n_ops=self._n_ops, deadline_s=self._deadline)
+
     def __call__(self, arrays: dict):
         spec, total = _host_spec(arrays)
         extras_in = {k: v for k, v in arrays.items()
                      if not _packable(v.dtype)}
-        ekey = tuple(sorted((k, v.shape, v.dtype.str)
+        ekey = tuple(sorted((k, tuple(v.shape), v.dtype.str)
                             for k, v in extras_in.items()))
         entry = self._fns.get((spec, ekey))
         if entry is None:
-            cell = {}
-
-            def traced(buf, extras):
-                args = _device_unpack(buf, spec)
-                args.update(extras)
-                outs = self._raw(args)
-                pack_outs = {k: v for k, v in outs.items()
-                             if _packable(jnp.asarray(v).dtype)}
-                extra_outs = {k: v for k, v in outs.items()
-                              if k not in pack_outs}
-                entries, vskip, lo32 = (
-                    _build_varlen(args, outs, pack_outs)
-                    if self._varlen else ([], (), {}))
-                obuf, ospec = _device_pack(pack_outs, skip=vskip,
-                                           lo32=lo32)
-                vbuf, vspec = (_device_pack_varlen(entries) if entries
-                               else (jnp.zeros(0, jnp.uint8), ()))
-                cell["ospec"] = ospec
-                cell["vspec"] = vspec
-                return obuf, vbuf, extra_outs
-
-            # content-addressed AOT route (exec/compilequeue): the trace —
-            # which records ospec/vspec into `cell` as a side effect — runs
-            # on every path (fingerprinting always traces); only the XLA
-            # compile is skipped on a fingerprint or disk-artifact hit
-            from ..exec.compilequeue import aot_jit
-
-            fn = aot_jit(traced, donate=self._donate, salt="pack",
-                         tag=self._tag, n_ops=self._n_ops,
-                         deadline=self._deadline)
-            entry = (fn, cell)
-            self._fns[(spec, ekey)] = entry
-        fn, cell = entry
+            entry = self._make_entry(spec, ekey)
+        fn, cell = entry[0], entry[1]
         import os
 
         if os.environ.get("TUPLEX_PACK_DEBUG"):

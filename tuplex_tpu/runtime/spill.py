@@ -230,6 +230,15 @@ class MemoryManager:
         self.touch(part)
         return part
 
+    def resident_bytes(self) -> int:
+        """Bytes currently resident across registered partitions — the
+        quantity the LRU evictor holds under ``budget``. The job service
+        reports it per tenant (each job's runner owns its own manager,
+        so this IS the job's resident footprint)."""
+        with self._lock:
+            self._reap_locked()
+            return self._inmem
+
     def metrics(self) -> dict:
         return {"swap_out": self.swap_out_count, "swap_in": self.swap_in_count,
                 "swapped_bytes": self.swapped_bytes}

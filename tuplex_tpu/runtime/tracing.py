@@ -88,6 +88,24 @@ def set_host(idx: int) -> None:
     _host_pid = int(idx)
 
 
+def set_stream(tag: Optional[str]) -> None:
+    """Tag every span recorded by THIS thread with a stream id. The job
+    service (serve/) sets the running job's id around each scheduler step,
+    so concurrent tenants sharing one process separate into per-job span
+    streams without per-tenant ring buffers. None clears the tag."""
+    _tls.stream = None if tag is None else str(tag)
+
+
+def current_stream() -> Optional[str]:
+    return getattr(_tls, "stream", None)
+
+
+def events_for_stream(tag: str) -> list:
+    """Spans recorded under ``set_stream(tag)`` — one tenant's slice of
+    the shared ring buffer (serve/: per-job Metrics/trace isolation)."""
+    return [e for e in events() if e.get("stream") == tag]
+
+
 def now_us() -> float:
     """Microseconds since the trace epoch (module import)."""
     return (time.perf_counter() - _t0) * 1e6
@@ -154,12 +172,16 @@ class _Span:
         tid = threading.get_ident()
         if tid not in _tid_names:
             _tid_names[tid] = threading.current_thread().name
-        _events.append({
+        rec = {
             "name": self.name, "cat": self.cat,
             "ts": self._ts, "dur": dur,
             "tid": tid, "depth": self._depth,
             "args": self.args,
-        })
+        }
+        st = current_stream()
+        if st is not None:
+            rec["stream"] = st
+        _events.append(rec)
         return False
 
 
@@ -198,9 +220,13 @@ def instant(name: str, cat: str = "exec",
     tid = threading.get_ident()
     if tid not in _tid_names:
         _tid_names[tid] = threading.current_thread().name
-    _events.append({"name": name, "cat": cat, "ts": now_us(), "dur": None,
-                    "tid": tid,
-                    "depth": len(getattr(_tls, "stack", ())), "args": args})
+    ev = {"name": name, "cat": cat, "ts": now_us(), "dur": None,
+          "tid": tid,
+          "depth": len(getattr(_tls, "stack", ())), "args": args}
+    st = current_stream()
+    if st is not None:
+        ev["stream"] = st
+    _events.append(ev)
 
 
 def complete(name: str, cat: str, ts_us: float, dur_us: float,
@@ -214,9 +240,13 @@ def complete(name: str, cat: str, ts_us: float, dur_us: float,
     tid = threading.get_ident()
     if tid not in _tid_names:
         _tid_names[tid] = threading.current_thread().name
-    _events.append({"name": name, "cat": cat, "ts": float(ts_us),
-                    "dur": float(dur_us), "tid": tid,
-                    "depth": len(getattr(_tls, "stack", ())), "args": args})
+    ev = {"name": name, "cat": cat, "ts": float(ts_us),
+          "dur": float(dur_us), "tid": tid,
+          "depth": len(getattr(_tls, "stack", ())), "args": args}
+    st = current_stream()
+    if st is not None:
+        ev["stream"] = st
+    _events.append(ev)
 
 
 _NULL_CM = contextlib.nullcontext()   # shared, stateless
@@ -272,6 +302,10 @@ def _chrome_event(e: dict, pid: int) -> dict:
         out["s"] = "t"                      # instant scope: thread
     if e.get("args"):
         out["args"] = e["args"]
+    if e.get("stream") is not None:
+        # per-tenant stream tag (serve/): copy-on-write so the recorded
+        # event's args dict is never mutated by the export
+        out["args"] = dict(out.get("args") or {}, stream=e["stream"])
     return out
 
 

@@ -19,6 +19,14 @@ a call-site TAG (`note_d2h(n, tag="packed_fetch")`) — per-tag totals
 accumulate under "<name>:<tag>" and surface via ``tags()`` so a regression
 points at the site, not just the family. Thread safety: bumps happen under
 a lock — transfers are milliseconds, the lock is noise.
+
+Multi-tenant scoping (serve/): ``set_scope(job_id)`` makes every bump on
+the calling thread ALSO accumulate into a per-scope counter family
+(``scoped(job_id)``), so concurrent jobs sharing the process get isolated
+accounting on top of the global totals. The scope is THREAD-local: bumps
+from the job's executing thread (d2h/h2d/spill, inline-dispatch compile
+counters) land in its family; bumps from shared background threads (the
+compile pool's ahead-of-time compiles) attribute globally only.
 """
 
 from __future__ import annotations
@@ -28,6 +36,49 @@ import threading
 _lock = threading.Lock()
 _counters: dict[str, int] = {}
 _tags: dict[str, int] = {}        # "name:tag" -> value
+_tls = threading.local()          # per-thread counter SCOPE (tenant/job id)
+_scoped: dict[str, dict[str, int]] = {}   # scope -> {name: value}
+
+
+def set_scope(name: str | None) -> None:
+    """Attribute every bump made by THIS thread to a named scope (the job
+    service sets the running job's id around each scheduler step). Scoped
+    totals accumulate in parallel with the process-wide counters so
+    concurrent tenants sharing one device get isolated counter families.
+    None clears the scope."""
+    _tls.scope = None if name is None else str(name)
+
+
+def current_scope() -> str | None:
+    return getattr(_tls, "scope", None)
+
+
+def scoped(name: str) -> dict:
+    """Copy of one scope's counter family ({counter: value}; empty when
+    the scope never recorded anything)."""
+    with _lock:
+        return dict(_scoped.get(name, ()))
+
+
+def scopes() -> list:
+    with _lock:
+        return list(_scoped)
+
+
+def drop_scope(name: str) -> dict:
+    """Remove (and return) one scope's family — the job service snapshots
+    a finished job's counters onto its record and releases the registry
+    entry, so a long-lived process doesn't accumulate one dict per job
+    ever served. Global counters are untouched."""
+    with _lock:
+        return _scoped.pop(name, {})
+
+
+def _bump_scope_locked(name: str, n: int) -> None:
+    sc = getattr(_tls, "scope", None)
+    if sc is not None:
+        d = _scoped.setdefault(sc, {})
+        d[name] = d.get(name, 0) + int(n)
 
 
 def bump(name: str, n: int = 1, tag: str | None = None) -> None:
@@ -38,6 +89,7 @@ def bump(name: str, n: int = 1, tag: str | None = None) -> None:
         return
     with _lock:
         _counters[name] = _counters.get(name, 0) + int(n)
+        _bump_scope_locked(name, n)
         if tag:
             key = f"{name}:{tag}"
             _tags[key] = _tags.get(key, 0) + int(n)
@@ -92,6 +144,7 @@ def reset() -> None:
     with _lock:
         _counters.clear()
         _tags.clear()
+        _scoped.clear()
 
 
 # -- transfer conveniences (the original xferstats API) ---------------------
@@ -103,6 +156,8 @@ def note_d2h(nbytes: int, tag: str | None = None) -> None:
     with _lock:
         _counters["d2h_bytes"] = _counters.get("d2h_bytes", 0) + int(nbytes)
         _counters["d2h_calls"] = _counters.get("d2h_calls", 0) + 1
+        _bump_scope_locked("d2h_bytes", nbytes)
+        _bump_scope_locked("d2h_calls", 1)
         if tag:
             key = f"d2h_bytes:{tag}"
             _tags[key] = _tags.get(key, 0) + int(nbytes)
@@ -115,6 +170,8 @@ def note_h2d(nbytes: int, tag: str | None = None) -> None:
     with _lock:
         _counters["h2d_bytes"] = _counters.get("h2d_bytes", 0) + int(nbytes)
         _counters["h2d_calls"] = _counters.get("h2d_calls", 0) + 1
+        _bump_scope_locked("h2d_bytes", nbytes)
+        _bump_scope_locked("h2d_calls", 1)
         if tag:
             key = f"h2d_bytes:{tag}"
             _tags[key] = _tags.get(key, 0) + int(nbytes)

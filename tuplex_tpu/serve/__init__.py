@@ -1,0 +1,23 @@
+"""Job-service runtime: concurrent multi-tenant pipelines on one warm TPU.
+
+Public surface:
+
+* ``JobService`` — the long-lived scheduler (serve/service.py).
+* ``JobRequest`` / ``request_from_dataset`` — submissions built from the
+  serverless stage-spec serialization (serve/jobs.py).
+* ``JobHandle`` — caller-side state/result/metrics view.
+* ``client`` — the scratch-dir wire protocol + the
+  ``python -m tuplex_tpu serve`` loop (serve/client.py).
+* ``Context.submit(ds)`` (api/context.py) is the one-liner entry point.
+"""
+
+from .jobs import (CANCELLED, DONE, FAILED, QUEUED, REJECTED, RUNNING,
+                   JobFailed, JobHandle, JobRejected, JobRequest,
+                   QueueFull, request_from_dataset)
+from .service import JobService
+
+__all__ = [
+    "JobService", "JobRequest", "JobHandle", "JobRejected", "JobFailed",
+    "QueueFull", "request_from_dataset", "QUEUED", "RUNNING", "DONE",
+    "FAILED", "REJECTED", "CANCELLED",
+]

@@ -1,0 +1,248 @@
+"""Scratch-dir wire protocol for the job service (submit / poll / fetch).
+
+The same filesystem handshake ``exec/worker.py --serve`` established for
+warm workers, lifted to whole pipelines: a client drops an atomic
+``request.pkl`` under the service root's ``inbox/``, the service loop
+(``python -m tuplex_tpu serve <root>``) admits it into a ``JobService``,
+streams state into ``status.json``, and writes the terminal
+``response.pkl`` atomically — completion is signalled solely by that
+rename, never by process liveness. No sockets: the root can live on any
+shared filesystem, and a crashed client leaves nothing wedged.
+
+Layout under the service root:
+
+    inbox/<job>/request.pkl      client -> service (atomic rename)
+    inbox/<job>/status.json      service -> client (overwritten per poll)
+    inbox/<job>/response.pkl     service -> client (atomic, terminal)
+    STOP                         touch to shut the service loop down
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+import uuid
+from typing import Optional
+
+from ..utils.logging import get_logger
+from .jobs import (DONE, FAILED, JobRejected, JobRequest, QueueFull,
+                   cleanup_request_scratch)
+from .service import JobService
+
+log = get_logger("tuplex_tpu.serve")
+
+_TERMINAL = (DONE, FAILED, "rejected", "cancelled")
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as fp:
+        fp.write(data)
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# client side
+# ---------------------------------------------------------------------------
+
+def submit(root: str, request: JobRequest) -> str:
+    """Drop a request into the service inbox; returns the job dir name.
+    Only wire-safe requests travel (every stage by spec — live stage
+    objects are an in-process construct)."""
+    if not request.wire_safe():
+        # the request dies here: its staged input parts must die with it
+        cleanup_request_scratch(request.stages)
+        raise JobRejected(
+            "request carries live stage objects (join/aggregate tier); "
+            "only spec-serialized pipelines can travel the wire protocol")
+    jid = uuid.uuid4().hex[:12]
+    jdir = os.path.join(root, "inbox", jid)
+    os.makedirs(jdir, exist_ok=True)
+    _atomic_write(os.path.join(jdir, "request.pkl"),
+                  pickle.dumps(request))
+    return jid
+
+
+def poll(root: str, jid: str) -> dict:
+    """Latest status record for a submitted job ({} before the service
+    first sees it)."""
+    path = os.path.join(root, "inbox", jid, "status.json")
+    try:
+        with open(path) as fp:
+            return json.load(fp)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def fetch(root: str, jid: str, timeout: float = 600.0,
+          poll_s: float = 0.1) -> dict:
+    """Block until the job's terminal response lands; returns the response
+    dict ({"ok": bool, "rows": [...], "metrics": {...}} or
+    {"ok": False, "error": ...}). TimeoutError past `timeout`."""
+    resp = os.path.join(root, "inbox", jid, "response.pkl")
+    deadline = time.monotonic() + timeout
+    while not os.path.exists(resp):
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"no response for job {jid} "
+                               f"after {timeout:.0f}s")
+        time.sleep(poll_s)
+    with open(resp, "rb") as fp:
+        return pickle.load(fp)
+
+
+# ---------------------------------------------------------------------------
+# service side (the `python -m tuplex_tpu serve` loop)
+# ---------------------------------------------------------------------------
+
+def _write_status(jdir: str, handle_or_state,
+                  extra: Optional[dict] = None,
+                  cache: Optional[dict] = None):
+    if isinstance(handle_or_state, str):
+        rec = {"state": handle_or_state}
+    else:
+        h = handle_or_state
+        # plain record reads only — JobHandle.stats would lock (and reap)
+        # the running job's MemoryManager 10x/second per job just to
+        # report a turn counter
+        rec = {"state": h.state, "job": h.id, "tenant": h.tenant,
+               "turns": h._rec.stats.get("turns", 0)}
+    if extra:
+        rec.update(extra)
+    payload = json.dumps(rec)
+    # the poll loop calls this every iteration; only CHANGES hit the
+    # filesystem (the protocol targets shared/network filesystems where
+    # a rename per 0.1s poll per job is real churn)
+    if cache is not None and cache.get(jdir) == payload:
+        return
+    try:
+        _atomic_write(os.path.join(jdir, "status.json"), payload.encode())
+        if cache is not None:
+            cache[jdir] = payload
+    except OSError:
+        pass
+
+
+def _finish(jdir: str, handle) -> None:
+    if handle.state == DONE:
+        resp = {"ok": True, "rows": handle._rec.result_rows,
+                "metrics": handle.metrics.as_dict(),
+                "counters": handle.counters(),
+                "stats": handle.stats,
+                "exception_counts": {}}
+        for e in handle.exceptions():
+            resp["exception_counts"][e.exc_name] = \
+                resp["exception_counts"].get(e.exc_name, 0) + 1
+    else:
+        resp = {"ok": False, "state": handle.state,
+                "error": handle.error or handle.state}
+    _atomic_write(os.path.join(jdir, "response.pkl"), pickle.dumps(resp))
+
+
+def service_loop(root: str, options=None, *, poll_s: float = 0.1,
+                 service: Optional[JobService] = None,
+                 max_idle_s: float = 0.0) -> int:
+    """Run the file-protocol front end over a JobService until
+    ``<root>/STOP`` appears (or `max_idle_s` of quiet, when positive —
+    tests use it). Returns the number of jobs served."""
+    svc = service if service is not None else JobService(options)
+    inbox = os.path.join(root, "inbox")
+    os.makedirs(inbox, exist_ok=True)
+    stop_file = os.path.join(root, "STOP")
+    tracked: dict = {}          # jid dir -> (jdir, handle)
+    waiting: dict = {}          # jid dir -> first queue-full timestamp
+    finished: set = set()
+    status_cache: dict = {}     # jdir -> last status json written
+    served = 0
+    last_activity = time.monotonic()
+    log.info("job service listening on %s (slots=%d, depth=%d)",
+             root, svc.slots, svc.queue_depth)
+
+    def _reject_dir(d, jdir, msg, stages=None):
+        if stages is not None:
+            cleanup_request_scratch(stages)
+        _atomic_write(os.path.join(jdir, "response.pkl"),
+                      pickle.dumps({"ok": False, "state": "rejected",
+                                    "error": msg}))
+        _write_status(jdir, "rejected", {"error": msg})
+        status_cache.pop(jdir, None)
+        waiting.pop(d, None)
+        finished.add(d)
+
+    try:
+        while not os.path.exists(stop_file):
+            progressed = False
+            names = sorted(os.listdir(inbox))
+            # a client that removed its job dir releases our memory of it
+            # (bounds `finished`/`waiting` over a long-lived service, and
+            # keeps a vanished waiting dir from pinning max_idle_s open)
+            name_set = set(names)
+            finished &= name_set
+            for d in list(waiting):
+                if d not in name_set:
+                    waiting.pop(d, None)
+            for d in names:
+                jdir = os.path.join(inbox, d)
+                if d in tracked or d in finished:
+                    continue
+                req_path = os.path.join(jdir, "request.pkl")
+                if not os.path.exists(req_path):
+                    continue
+                try:
+                    with open(req_path, "rb") as fp:
+                        req = pickle.load(fp)
+                    # zero-wait admission: the poll thread must never
+                    # block on a full queue (frozen statuses, deferred
+                    # STOP). Queue-full retries ride the poll loop until
+                    # the service's admission timeout, THEN reject.
+                    handle = svc.submit(req, timeout=0,
+                                        cleanup_on_reject=False)
+                except QueueFull:
+                    first = waiting.setdefault(d, time.monotonic())
+                    if time.monotonic() - first \
+                            >= svc.admission_timeout_s:
+                        progressed = True
+                        # the probe submits used timeout=0; report the
+                        # wait the client ACTUALLY got
+                        _reject_dir(
+                            d, jdir,
+                            f"admission queue full — timed out after "
+                            f"{svc.admission_timeout_s:.0f}s "
+                            f"(tuplex.serve.admissionTimeoutS)",
+                            stages=req.stages)
+                    else:
+                        _write_status(jdir, "waiting", cache=status_cache)
+                    continue
+                except JobRejected as e:
+                    progressed = True
+                    _reject_dir(d, jdir, str(e), stages=req.stages)
+                    continue
+                except Exception as e:   # unreadable request
+                    progressed = True
+                    _reject_dir(d, jdir, f"bad request: {e}")
+                    continue
+                progressed = True
+                waiting.pop(d, None)
+                tracked[d] = (jdir, handle)
+                _write_status(jdir, handle, cache=status_cache)
+            for d in list(tracked):
+                jdir, handle = tracked[d]
+                _write_status(jdir, handle, cache=status_cache)
+                if handle.state in _TERMINAL:
+                    _finish(jdir, handle)
+                    del tracked[d]
+                    status_cache.pop(jdir, None)
+                    finished.add(d)
+                    served += 1
+                    progressed = True
+            if progressed or tracked or waiting:
+                last_activity = time.monotonic()
+            elif max_idle_s > 0 and \
+                    time.monotonic() - last_activity > max_idle_s:
+                break
+            time.sleep(poll_s)
+    finally:
+        if service is None:
+            svc.close()
+    return served

@@ -1,0 +1,352 @@
+"""The long-lived job service: admission, fair scheduling, warm device.
+
+One ``JobService`` owns the process's warm accelerator and runs forever,
+absorbing pipeline submissions from many tenants (ROADMAP "Job-service
+runtime"; the architectural successor of one-shot ``Context`` execution).
+Three mechanisms carry the multi-tenant contract:
+
+* **bounded admission with backpressure** — at most ``tuplex.serve.
+  queueDepth`` jobs may be queued+running; a submit past that blocks up to
+  ``tuplex.serve.admissionTimeoutS`` seconds, then rejects with a clear
+  ``JobRejected`` (the caller can retry/shed; the service never builds an
+  unbounded backlog). A memory budget above ``tuplex.serve.maxJobMemory``
+  rejects immediately.
+* **deficit-weighted round-robin scheduling** — the unit of dispatch is
+  ONE STAGE of one job (``_JobRunner.step``). Each scheduler slot
+  (``tuplex.serve.slots``, default 1 — one in-flight device dispatch per
+  slot) pops the next ready job, runs one stage, and requeues it; a
+  tenant with weight w gets w consecutive stage dispatches per cycle
+  (``tuplex.serve.tenantWeights`` = "tenantA:2,tenantB:1"). A short job
+  queued behind a long one therefore completes after O(its own stages)
+  turns, never after the long job's full stage list.
+* **shared compile plane, isolated everything else** — all jobs share the
+  process-wide compile queue + content-addressed AOT artifact cache
+  (exec/compilequeue): N isomorphic jobs cost ~1 compile set, joined
+  in-flight when concurrent. Each job keeps its OWN LocalBackend whose
+  MemoryManager budget is the job's memory budget (spill-degrade under
+  pressure), its own api.Metrics, a tagged span stream
+  (runtime/tracing.set_stream) and a scoped counter family
+  (runtime/xferstats.set_scope) — nothing of one tenant's telemetry
+  bleeds into another's.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from ..core.options import ContextOptions
+from ..utils.logging import get_logger
+from .jobs import (CANCELLED, DONE, FAILED, QUEUED, RUNNING, JobHandle,
+                   JobRecord, JobRejected, JobRequest, QueueFull,
+                   _JobRunner)
+
+log = get_logger("tuplex_tpu.serve")
+
+
+def _parse_weights(s: str) -> dict:
+    """"a:2,b:1" -> {"a": 2, "b": 1}; malformed entries are skipped."""
+    out: dict = {}
+    for part in (s or "").split(","):
+        part = part.strip()
+        if not part or ":" not in part:
+            continue
+        k, _, v = part.partition(":")
+        try:
+            out[k.strip()] = max(1, int(v))
+        except ValueError:
+            continue
+    return out
+
+
+class JobService:
+    """See module docstring. ``autostart=False`` lets tests (and the CLI
+    loop) admit a batch of jobs before the first scheduler turn — the
+    fairness order is then deterministic from turn 0."""
+
+    def __init__(self, options: Optional[ContextOptions] = None, *,
+                 autostart: bool = True, recorder=None):
+        self.options = options if options is not None else ContextOptions()
+        o = self.options
+        self.queue_depth = max(1, o.get_int("tuplex.serve.queueDepth", 64))
+        self.admission_timeout_s = o.get_float(
+            "tuplex.serve.admissionTimeoutS", 30.0)
+        self.slots = max(1, o.get_int("tuplex.serve.slots", 1))
+        self.default_budget = o.get_size("tuplex.serve.jobMemory", 256 << 20)
+        self.max_job_memory = o.get_size("tuplex.serve.maxJobMemory", 0)
+        self.tenant_weights = _parse_weights(
+            o.get_str("tuplex.serve.tenantWeights", ""))
+        self.retain_jobs = max(1, o.get_int("tuplex.serve.retainJobs", 256))
+        self.recorder = recorder          # history.JobRecorder (optional)
+        self._cond = threading.Condition()
+        self._ready: deque = deque()      # runnable JobRecords (DRR order)
+        self._records: dict = {}          # id -> JobRecord (bounded: the
+                                          # newest retain_jobs TERMINAL
+                                          # records; live jobs always kept)
+        self._terminal: deque = deque()   # terminal ids, oldest first
+        self._open = 0                    # queued + running jobs
+        self._turn = 0                    # global stage-dispatch counter
+        self._stop = False
+        self._threads: list = []
+        self._started = False
+        if autostart:
+            self.start()
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        with self._cond:
+            if self._started or self._stop:
+                return
+            self._started = True
+            for i in range(self.slots):
+                t = threading.Thread(target=self._worker, daemon=True,
+                                     name=f"tpx-serve-{i}")
+                t.start()
+                self._threads.append(t)
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the scheduler. Unfinished jobs flip to CANCELLED so no
+        waiter blocks forever."""
+        with self._cond:
+            self._stop = True
+            cancelled = []
+            for rec in self._records.values():
+                if rec.state in (QUEUED, RUNNING):
+                    rec.state = CANCELLED
+                    rec.error = "service closed"
+                    cancelled.append(rec)
+            self._ready.clear()
+            self._open = 0
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=timeout)
+        # a worker outliving its join timeout may still be mid-step: in
+        # that case only QUEUED jobs' scratch is safe to sweep — a
+        # running job's staged input must not be rmtree'd under its
+        # final step (the dangling daemon thread dies with the process)
+        workers_alive = any(t.is_alive() for t in self._threads)
+        self._threads = []
+        from ..runtime import xferstats
+
+        for rec in cancelled:
+            if not workers_alive or rec.t_start is None:
+                try:
+                    rec.runner.cleanup()
+                except Exception:
+                    pass
+            # cancelled jobs never reach the terminal turn: release their
+            # scoped counter families here
+            if rec.final_counters is None:
+                rec.final_counters = xferstats.drop_scope(rec.id)
+
+    # ------------------------------------------------------------------
+    def submit(self, request: JobRequest, *,
+               timeout: Optional[float] = None,
+               cleanup_on_reject: bool = True) -> JobHandle:
+        """Admit one job. Blocks while the queue is at depth (up to the
+        admission timeout), then rejects — backpressure, not backlog.
+        `timeout` overrides tuplex.serve.admissionTimeoutS (the wire loop
+        passes 0 and retries so its poll thread never blocks);
+        `cleanup_on_reject=False` leaves the request's staged scratch for
+        the caller to release once it gives up retrying."""
+        from .jobs import cleanup_request_scratch
+
+        def _reject(exc):
+            if cleanup_on_reject:
+                cleanup_request_scratch(request.stages)
+            raise exc
+
+        if self.max_job_memory > 0 and request.memory_budget \
+                and request.memory_budget > self.max_job_memory:
+            _reject(JobRejected(
+                f"job memory budget {request.memory_budget} exceeds "
+                f"tuplex.serve.maxJobMemory={self.max_job_memory}; "
+                f"lower the budget or raise the service cap"))
+        weight = request.weight if request.weight \
+            else self.tenant_weights.get(request.tenant, 1)
+        rec = JobRecord(request, weight)
+        wait_s = self.admission_timeout_s if timeout is None else timeout
+        deadline = time.monotonic() + max(0.0, wait_s)
+        # shed load BEFORE paying for the job: wait for a queue slot
+        # first, build the runner (outside the lock — spec rebuild is
+        # pure, and a bad request must fail the submitter, not the
+        # scheduler), then take the slot — looping if it was snatched
+        # while we built. Overload rejections therefore cost nothing but
+        # the wait; a rejected job never reaches _run_turn, so its staged
+        # scratch is released here.
+        while True:
+            with self._cond:
+                while not self._stop \
+                        and self._open >= self.queue_depth:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        _reject(QueueFull(
+                            f"admission queue full ({self._open}/"
+                            f"{self.queue_depth} jobs) — timed out "
+                            f"after {wait_s:.0f}s "
+                            f"(tuplex.serve.admissionTimeoutS)"))
+                    self._cond.wait(min(0.1, left))
+                if self._stop:
+                    _reject(JobRejected("service is closed"))
+                if rec.runner is not None:
+                    self._open += 1
+                    self._records[rec.id] = rec
+                    self._ready.append(rec)
+                    self._cond.notify_all()
+                    break
+            try:
+                rec.runner = _JobRunner(rec, self.options,
+                                        self.default_budget)
+            except Exception as e:
+                if cleanup_on_reject:
+                    cleanup_request_scratch(request.stages)
+                raise JobRejected(
+                    f"job rejected at admission: "
+                    f"{type(e).__name__}: {e}") from e
+        self._record_event(rec, "job_start",
+                           action=f"serve:{request.name}",
+                           tenant=request.tenant,
+                           stages=[type(s).__name__
+                                   for s in rec.runner.stages])
+        log.info("admitted job %s (%s/%s): %d stage(s), weight %d",
+                 rec.id, request.tenant, request.name,
+                 len(rec.runner.stages), rec.weight)
+        return JobHandle(rec, self)
+
+    # convenience: plan + submit a DataSet in one call
+    def submit_dataset(self, dataset, **kw) -> JobHandle:
+        from .jobs import request_from_dataset
+
+        return self.submit(request_from_dataset(dataset, **kw))
+
+    # ------------------------------------------------------------------
+    def jobs(self) -> list:
+        with self._cond:
+            return [JobHandle(r, self) for r in self._records.values()]
+
+    def stats(self) -> dict:
+        with self._cond:
+            states: dict = {}
+            for r in self._records.values():
+                states[r.state] = states.get(r.state, 0) + 1
+            return {"jobs": len(self._records), "open": self._open,
+                    "turns": self._turn, "states": states,
+                    "queue_depth": self.queue_depth, "slots": self.slots}
+
+    # ------------------------------------------------------------------
+    def _record_event(self, rec: JobRecord, event: str, **fields) -> None:
+        r = self.recorder
+        if r is None or not getattr(r, "enabled", False):
+            return
+        try:
+            r.serve_job_event(rec.id, event, **fields)
+        except Exception:   # dashboard rows are advisory
+            pass
+
+    # ------------------------------------------------------------------
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stop and not self._ready:
+                    self._cond.wait(0.2)
+                if self._stop:
+                    return
+                rec = self._ready.popleft()
+                if rec.state == QUEUED:
+                    rec.state = RUNNING
+                    rec.t_start = time.perf_counter()
+                    rec.stats["queued_s"] = rec.t_start - rec.t_submit
+            self._run_turn(rec)
+
+    def _run_turn(self, rec: JobRecord) -> None:
+        """One scheduler turn: one stage dispatch of `rec`, telemetry
+        scoped to the job, then DRR requeue / completion under the lock."""
+        from ..runtime import tracing, xferstats
+
+        done = False
+        err: Optional[BaseException] = None
+        tracing.set_stream(rec.id)
+        xferstats.set_scope(rec.id)
+        try:
+            done = rec.runner.step()
+            if done:
+                rec.runner.finalize()
+        except BaseException as e:   # noqa: BLE001 - job dies, service lives
+            err = e
+        finally:
+            tracing.set_stream(None)
+            xferstats.set_scope(None)
+        wall = time.perf_counter() - (rec.t_start or rec.t_submit)
+        if err is not None or done:
+            try:
+                rec.runner.cleanup()
+            except Exception:
+                pass
+            # snapshot the job's scoped counter family onto the record and
+            # release the registry entry (a service that lives for
+            # thousands of jobs must not keep one family per job)
+            rec.final_counters = xferstats.drop_scope(rec.id)
+        # history rows land BEFORE the state flip wakes any waiter: a
+        # client that sees DONE must find the job_done row already written
+        if err is not None:
+            rec.error = f"{type(err).__name__}: {err}"
+            self._record_event(rec, "job_done", rows=0,
+                               wall_s=round(wall, 4),
+                               tenant=rec.request.tenant,
+                               exception_counts={},
+                               error=rec.error)
+            log.warning("job %s failed: %s", rec.id, rec.error)
+        elif done:
+            counts: dict = {}
+            for e in rec.exceptions:
+                counts[e.exc_name] = counts.get(e.exc_name, 0) + 1
+            self._record_event(
+                rec, "stage", no=len(rec.metrics.stages),
+                kind="serve", metrics={
+                    k: v for k, v in rec.metrics.as_dict().items()
+                    if isinstance(v, (int, float))})
+            self._record_event(rec, "job_done",
+                               rows=len(rec.result_rows or []),
+                               wall_s=round(wall, 4),
+                               tenant=rec.request.tenant,
+                               exception_counts=counts)
+            log.info("job %s done: %d rows, %d turn(s), %.3fs",
+                     rec.id, len(rec.result_rows or []),
+                     rec.stats["turns"] + 1, wall)
+        with self._cond:
+            self._turn += 1
+            rec.stats["turns"] += 1
+            if rec.state == CANCELLED or self._stop:
+                # close() raced this turn: the job was already flipped to
+                # CANCELLED (and _open zeroed) — a waiter may have seen
+                # that state, so never overwrite it or touch the
+                # admission counters; just release the job's scope
+                if rec.final_counters is None:
+                    rec.final_counters = xferstats.drop_scope(rec.id)
+                self._cond.notify_all()
+                return
+            if err is not None or done:
+                rec.state = FAILED if err is not None else DONE
+                rec.stats["finished_turn"] = self._turn
+                rec.stats["wall_s"] = wall
+                self._open -= 1
+                # bounded retention: the service index keeps only the
+                # newest retain_jobs terminal records (and their
+                # materialized result rows) — a caller-held JobHandle
+                # keeps its own record alive regardless; only the
+                # service-wide pin is released
+                self._terminal.append(rec.id)
+                while len(self._terminal) > self.retain_jobs:
+                    self._records.pop(self._terminal.popleft(), None)
+            else:
+                # deficit-weighted RR: a tenant with weight w keeps the
+                # slot for w consecutive stage dispatches, then yields
+                rec.burst += 1
+                if rec.burst < rec.weight:
+                    self._ready.appendleft(rec)
+                else:
+                    rec.burst = 0
+                    self._ready.append(rec)
+            self._cond.notify_all()

@@ -98,6 +98,10 @@ def explain(sink, options=None, lint: bool = False) -> str:
             if codes:
                 out.append("  possible row error codes: "
                            + ", ".join(c.name for c in codes))
+            sug = getattr(st, "resolver_suggestions", None)
+            if sug is not None:
+                for s in sug():
+                    out.append(f"  suggestion: {s}")
             rp = getattr(st, "resolve_plan", None)
             if rp is not None:
                 # the plan-time tier verdict (plan/physical.ResolvePlan):
