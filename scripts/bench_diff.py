@@ -1,0 +1,175 @@
+#!/usr/bin/env python3
+"""Compare two bench JSON files and fail on regressions.
+
+The bench trajectory (BENCH_r01..r05, serve_bench output) has so far been
+checked by eyeball; this makes it a gate:
+
+    python scripts/bench_diff.py BENCH_r04.json BENCH_r05.json
+    python scripts/bench_diff.py old.json new.json --threshold 0.05
+    python scripts/bench_diff.py a.json b.json --keys value compile_s
+
+Accepts either shape per file:
+  * a driver wrapper ``{"parsed": {...}, ...}`` (the committed BENCH_r*
+    files) — the ``parsed`` dict is compared;
+  * a raw result line ``{"metric": ..., "value": ..., ...}`` (bench.py /
+    scripts/serve_bench.py stdout).
+
+Every numeric key present in BOTH files is compared with a per-key
+direction (rows/s and speedups must not fall; compile seconds, transfer
+bytes and latency percentiles must not rise). A move past ``--threshold``
+(relative, default 10%) in the bad direction is a REGRESSION: it is
+printed, and the exit code is 1 so CI and the driver can gate on it.
+Improvements and within-threshold noise exit 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+#: direction per key: True = higher is better. Keys absent here are
+#: compared informationally (printed, never a regression) because their
+#: good direction is ambiguous. "value" is NOT here on purpose — the
+#: primary metric's direction depends on its unit (rows/s throughput
+#: rises, a latency-seconds p99 falls); see value_direction().
+HIGHER_BETTER = {
+    "vs_baseline": True,
+    "vs_llvm": True,
+    "jobs_per_s": True,
+    "speedup_wall": True,
+    "analyzer_inferred_ops": None,   # informational
+    "compile_s": False,
+    "stage_compiles": False,
+    "d2h_bytes": False,
+    "h2d_bytes": False,
+    "analyzer_ms": False,
+    "spread": False,
+    "wall_s": False,
+    "p50": False, "p95": False, "p99": False, "max": False, "mean": False,
+}
+
+
+def load_result(path: str) -> tuple[dict, dict]:
+    """(flat, meta) from one bench file (wrapper or raw). Nested dicts
+    (serve_bench's per-mode percentile blocks) flatten to dotted keys:
+    ``concurrent.p99``. `meta` keeps the string fields ("metric",
+    "unit") that decide the primary value's direction."""
+    with open(path) as fp:
+        data = json.load(fp)
+    if isinstance(data, dict) and isinstance(data.get("parsed"), dict):
+        data = data["parsed"]
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: not a bench result object")
+    flat: dict = {}
+
+    def walk(d: dict, prefix: str) -> None:
+        for k, v in d.items():
+            key = f"{prefix}{k}"
+            if isinstance(v, dict):
+                walk(v, key + ".")
+            elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                flat[key] = float(v)
+
+    walk(data, "")
+    meta = {k: v for k, v in data.items() if isinstance(v, str)}
+    return flat, meta
+
+
+def value_direction(meta: dict):
+    """Direction of the primary "value" from its declared unit: rates
+    (rows/s, jobs/s, ops/s) must not fall; latency/seconds metrics must
+    not rise; anything else is informational."""
+    unit = str(meta.get("unit", "")).lower()
+    metric = str(meta.get("metric", "")).lower()
+    if "/s" in unit or "per_sec" in metric:
+        return True
+    if unit in ("s", "ms", "us", "seconds") or "latency" in metric:
+        return False
+    return None
+
+
+def direction(key: str, meta: dict):
+    """Direction for a (possibly dotted) key: the leaf name decides, so
+    ``concurrent.p99`` compares like ``p99``; "value" defers to the
+    file's unit/metric."""
+    leaf = key.rsplit(".", 1)[-1]
+    if leaf == "value":
+        return value_direction(meta)
+    return HIGHER_BETTER.get(leaf, HIGHER_BETTER.get(key))
+
+
+def compare(old: dict, new: dict, threshold: float,
+            keys=None, meta=None) -> tuple[list, list]:
+    """(rows, regressions). Each row: (key, old, new, delta_frac, verdict)."""
+    rows, regressions = [], []
+    meta = meta or {}
+    shared = sorted(set(old) & set(new))
+    if keys:
+        shared = [k for k in shared if k in keys
+                  or k.rsplit(".", 1)[-1] in keys]
+    for k in shared:
+        ov, nv = old[k], new[k]
+        delta = (nv - ov) / abs(ov) if ov else (0.0 if nv == ov else
+                                               float("inf") if nv > ov
+                                               else float("-inf"))
+        better = direction(k, meta)
+        if better is None:
+            verdict = "info"
+        elif ov == 0 and nv == 0:
+            verdict = "ok"
+        else:
+            worse = delta < -threshold if better else delta > threshold
+            improved = delta > threshold if better else delta < -threshold
+            verdict = ("REGRESSION" if worse
+                       else "improved" if improved else "ok")
+        rows.append((k, ov, nv, delta, verdict))
+        if verdict == "REGRESSION":
+            regressions.append(k)
+    return rows, regressions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two bench JSON files; exit 1 on regression")
+    ap.add_argument("old", help="baseline bench JSON")
+    ap.add_argument("new", help="candidate bench JSON")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative move counting as a regression "
+                         "(default 0.10 = 10%%)")
+    ap.add_argument("--keys", nargs="*", default=None,
+                    help="restrict the comparison to these keys "
+                         "(leaf names match dotted keys)")
+    args = ap.parse_args(argv)
+    try:
+        old, old_meta = load_result(args.old)
+        new, new_meta = load_result(args.new)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"bench_diff: {e}", file=sys.stderr)
+        return 2
+    if old_meta.get("metric") != new_meta.get("metric"):
+        print(f"bench_diff: warning — comparing different metrics "
+              f"({old_meta.get('metric')} vs {new_meta.get('metric')})",
+              file=sys.stderr)
+    rows, regressions = compare(old, new, args.threshold, args.keys,
+                                meta=new_meta)
+    if not rows:
+        print("bench_diff: no shared numeric keys to compare",
+              file=sys.stderr)
+        return 2
+    width = max(len(r[0]) for r in rows)
+    for k, ov, nv, delta, verdict in rows:
+        print(f"{k:<{width}}  {ov:>14.4g}  ->  {nv:>14.4g}  "
+              f"{delta:>+8.1%}  {verdict}")
+    if regressions:
+        print(f"\nbench_diff: {len(regressions)} regression(s) past "
+              f"{args.threshold:.0%}: {', '.join(regressions)}",
+              file=sys.stderr)
+        return 1
+    print(f"\nbench_diff: OK ({len(rows)} key(s) within "
+          f"{args.threshold:.0%})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

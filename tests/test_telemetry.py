@@ -1,0 +1,580 @@
+"""Serve-layer telemetry (runtime/telemetry): streaming histogram
+correctness (record/merge/percentiles, cross-thread, edge cases),
+Prometheus exposition schema lint, health state transitions under
+synthetic saturation, the serve metrics endpoints + metrics.prom drop,
+the zero-overhead disabled path, span-embed truncation accounting, and
+the serve_bench p99 harness smoke."""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import tuplex_tpu
+from tuplex_tpu.runtime import telemetry as T
+from tuplex_tpu.runtime.telemetry import Histogram
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    """Each test sees an empty registry and an enabled gate; services the
+    test opens register into (and are dropped from) this state."""
+    T.registry().clear()
+    T.enable(True)
+    yield
+    T.registry().clear()
+    T.enable(True)
+
+
+def _svc_ctx(tmp_path, **extra):
+    conf = {"tuplex.scratchDir": str(tmp_path / "scratch"),
+            "tuplex.partitionSize": "64KB"}
+    conf.update(extra)
+    return tuplex_tpu.Context(conf)
+
+
+# ---------------------------------------------------------------------------
+# histogram: record / percentiles / merge
+# ---------------------------------------------------------------------------
+
+def test_histogram_empty_and_single_sample():
+    h = Histogram()
+    assert h.percentile(0.5) == 0.0
+    p = h.percentiles()
+    assert p["count"] == 0 and p["p99"] == 0.0 and p["max"] == 0.0
+    h.record(0.125)
+    # one sample: every percentile clamps to the exact value
+    for q in (0.0, 0.5, 0.95, 0.99, 1.0):
+        assert h.percentile(q) == 0.125
+    p = h.percentiles()
+    assert p["count"] == 1 and p["mean"] == 0.125 and p["max"] == 0.125
+
+
+def test_histogram_exact_moments_and_edges():
+    h = Histogram()
+    h.record(0.0)          # underflow bucket
+    h.record(-3.0)         # negative: underflow, min stays exact
+    h.record(1e9)          # overflow bucket, max stays exact
+    h.record(float("nan"))  # dropped entirely
+    h.record(float("inf"))  # dropped too (a sentinel must not crash or
+    h.record(float("-inf"))  # poison the exact moments)
+    h.record(2.5)
+    assert h.count == 4
+    assert h.min == -3.0 and h.max == 1e9
+    assert h.sum == pytest.approx(0.0 - 3.0 + 1e9 + 2.5)
+    # percentiles stay inside the exact [min, max] envelope
+    assert -3.0 <= h.percentile(0.5) <= 1e9
+
+
+def test_histogram_percentile_accuracy_log_buckets():
+    # log-uniform samples over 3 decades: estimates must land within the
+    # bucket-width error bound (10/decade -> ~±12.2%) of the exact value
+    vals = [10 ** (-3 + 3 * i / 9999) for i in range(10000)]
+    h = Histogram()
+    for v in vals:
+        h.record(v)
+    svals = sorted(vals)
+    for q in (0.50, 0.95, 0.99):
+        exact = svals[max(0, math.ceil(q * len(svals)) - 1)]
+        est = h.percentile(q)
+        assert abs(est - exact) / exact < 0.13, (q, est, exact)
+    assert h.percentile(1.0) == max(vals)
+
+
+def test_histogram_merge_matches_single_recorder():
+    a, b, one = Histogram(), Histogram(), Histogram()
+    for i in range(1, 500):
+        v = i / 100.0
+        (a if i % 2 else b).record(v)
+        one.record(v)
+    a.merge(b)
+    assert a.count == one.count and a.sum == pytest.approx(one.sum)
+    assert a.counts == one.counts
+    assert a.min == one.min and a.max == one.max
+    for q in (0.5, 0.95, 0.99):
+        assert a.percentile(q) == one.percentile(q)
+    # merging an empty histogram is the identity
+    before = a.snapshot()
+    a.merge(Histogram())
+    assert a.snapshot() == before
+
+
+def test_histogram_cross_thread_record_and_merge():
+    shared = Histogram()
+    per_thread = [Histogram() for _ in range(8)]
+
+    def work(i):
+        for k in range(2000):
+            v = (i * 2000 + k + 1) * 1e-4
+            shared.record(v)
+            per_thread[i].record(v)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert shared.count == 16000          # no lost updates under the lock
+    merged = Histogram()
+    for h in per_thread:
+        merged.merge(h)
+    assert merged.counts == shared.counts
+    assert merged.sum == pytest.approx(shared.sum)
+
+
+# ---------------------------------------------------------------------------
+# registry + zero-overhead disabled path
+# ---------------------------------------------------------------------------
+
+def test_registry_labels_and_merged_readout():
+    T.observe("serve_job_latency_seconds", 0.1, tenant="a")
+    T.observe("serve_job_latency_seconds", 0.2, tenant="a")
+    T.observe("serve_job_latency_seconds", 10.0, tenant="b")
+    m = T.registry().merged("serve_job_latency_seconds")
+    assert m.count == 3 and m.max == 10.0
+    rep = T.latency_report()
+    assert rep["count"] == 3 and rep["max"] == 10.0
+
+
+def test_disabled_records_nothing_and_allocates_nothing():
+    T.enable(False)
+    T.observe("nope_seconds", 1.0, tenant="x")
+    T.set_gauge("nope_gauge", 1)
+    assert T.registry().histograms() == {}
+    assert T.registry().gauge_samples() == []
+    import tracemalloc
+
+    for _ in range(64):               # warm lazy caches
+        T.observe("hot_seconds", 0.5)
+    tracemalloc.start()
+    before = tracemalloc.take_snapshot()
+    for _ in range(10000):
+        T.observe("hot_seconds", 0.5)
+    after = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    grown = sum(s.size_diff for s in after.compare_to(before, "lineno")
+                if s.size_diff > 0 and any(
+                    (f.filename or "").replace(os.sep, "/")
+                    .endswith("runtime/telemetry.py")
+                    for f in s.traceback))
+    assert grown < 512, \
+        f"disabled observe() allocated {grown} bytes/10k calls"
+
+
+def test_env_kill_switch_wins(monkeypatch):
+    monkeypatch.setenv("TUPLEX_TELEMETRY", "0")
+    T.enable(True)                     # option says on; env must win
+    assert not T.enabled()
+    monkeypatch.delenv("TUPLEX_TELEMETRY")
+    T.enable(True)
+    assert T.enabled()
+
+
+# ---------------------------------------------------------------------------
+# prometheus exposition: schema lint
+# ---------------------------------------------------------------------------
+
+_NAME_RE = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+
+
+def _lint_exposition(text: str) -> dict:
+    """Parse the text format strictly; returns {metric_name: [(labels,
+    value)]} and asserts: TYPE declared before any sample of its family,
+    sample lines well-formed, label values quoted."""
+    import re
+
+    typed: dict = {}
+    samples: dict = {}
+    sample_re = re.compile(
+        rf"^({_NAME_RE})(\{{[^{{}}]*\}})? (-?[0-9.e+-]+|[+-]Inf|NaN)$")
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            assert re.fullmatch(_NAME_RE, name), name
+            assert kind in ("counter", "gauge", "histogram", "summary"), line
+            typed[name] = kind
+            continue
+        assert not line.startswith("#"), f"unexpected comment: {line}"
+        m = sample_re.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        name, labels, val = m.group(1), m.group(2) or "", m.group(3)
+        if labels:
+            for part in labels[1:-1].split(","):
+                lm = re.fullmatch(rf'({_NAME_RE})="((?:[^"\\]|\\.)*)"',
+                                  part)
+                assert lm, f"malformed label in {line!r}: {part!r}"
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in typed:
+                base = name[: -len(suffix)]
+        assert base in typed, f"sample {name} has no preceding # TYPE"
+        samples.setdefault(name, []).append((labels, val))
+    return {"typed": typed, "samples": samples}
+
+
+def test_prometheus_exposition_schema():
+    T.observe("serve_job_latency_seconds", 0.05, tenant="a")
+    T.observe("serve_job_latency_seconds", 0.5, tenant="a")
+    T.observe("serve_job_latency_seconds", 5.0, tenant='we"ird\\t')
+    T.set_gauge("serve_queue_ready_jobs", lambda: 3)
+    T.set_gauge("serve_broken_gauge", lambda: 1 / 0)   # must export nothing
+    from tuplex_tpu.runtime import xferstats
+
+    xferstats.bump("d2h_bytes", 1024, tag="packed_fetch")
+    text = T.render_prometheus()
+    parsed = _lint_exposition(text)
+    assert parsed["typed"]["tuplex_serve_job_latency_seconds"] == "histogram"
+    assert parsed["typed"]["tuplex_health_state"] == "gauge"
+    assert parsed["typed"]["tuplex_d2h_bytes_total"] == "counter"
+    assert "tuplex_serve_broken_gauge" not in parsed["samples"]
+    assert "tuplex_compile_seconds_total" in parsed["samples"]
+    # histogram contract: per-series cumulative buckets end at +Inf ==
+    # _count, and _sum/_count exist per label set
+    buckets: dict = {}
+    for labels, val in parsed["samples"]["tuplex_serve_job_latency_seconds_bucket"]:
+        key = tuple(p for p in labels[1:-1].split(",")
+                    if not p.startswith("le="))
+        le = [p for p in labels[1:-1].split(",") if p.startswith("le=")][0]
+        buckets.setdefault(key, []).append((le, int(val)))
+    assert len(buckets) == 2           # two tenants
+    counts = dict(parsed["samples"]["tuplex_serve_job_latency_seconds_count"])
+    for key, bs in buckets.items():
+        cums = [c for _, c in bs]
+        assert cums == sorted(cums), "buckets must be cumulative"
+        assert bs[-1][0] == 'le="+Inf"'
+    # the tenant="a" series saw exactly 2 samples
+    a_series = [v for lbl, v in
+                parsed["samples"]["tuplex_serve_job_latency_seconds_count"]
+                if 'tenant="a"' in lbl]
+    assert a_series == ["2"]
+
+
+def test_metrics_export_prometheus_entry_point():
+    from tuplex_tpu.api.metrics import Metrics
+
+    T.observe("serve_dispatch_seconds", 0.01, tenant="t")
+    text = Metrics().export_prometheus()
+    assert "tuplex_serve_dispatch_seconds_bucket" in text
+    assert "tuplex_health_state" in text
+
+
+# ---------------------------------------------------------------------------
+# health state machine
+# ---------------------------------------------------------------------------
+
+def test_health_ok_degraded_unhealthy_ordering():
+    T.register_health_check("a", lambda: (T.OK, None))
+    assert T.health()["state"] == "ok"
+    T.register_health_check("b", lambda: (T.DEGRADED, "meh"))
+    assert T.health()["state"] == "degraded"
+    T.register_health_check("c", lambda: (T.UNHEALTHY, "dead"))
+    h = T.health()
+    assert h["state"] == "unhealthy"
+    assert h["checks"]["b"]["detail"] == "meh"
+    # a raising probe degrades, never crashes the scrape
+    T.registry().clear()
+    T.register_health_check("boom", lambda: 1 / 0)
+    assert T.health()["state"] == "degraded"
+
+
+def test_health_degrades_under_admission_saturation(tmp_path):
+    from tuplex_tpu.serve import JobService, QueueFull, request_from_dataset
+
+    c = _svc_ctx(tmp_path, **{"tuplex.serve.queueDepth": 1,
+                              "tuplex.serve.admissionTimeoutS": "0.1"})
+    svc = JobService(c.options_store, autostart=False)
+    assert T.health()["state"] == "ok"
+    ds = c.parallelize(list(range(10)), columns=["v"]).map(lambda x: x["v"])
+    svc.submit(request_from_dataset(ds, name="fill"))
+    # queue at 1/1 with no scheduler running: saturated -> degraded
+    h = T.health()
+    assert h["state"] == "degraded", h
+    assert h["checks"]["serve_admission"]["state"] == "degraded"
+    # a zero-wait PROBE rejection (the wire loop's poll pattern) is not a
+    # client-visible rejection: health stays degraded, counter untouched
+    from tuplex_tpu.runtime import xferstats
+
+    before = xferstats.counter("serve_rejected_jobs")
+    with pytest.raises(QueueFull):
+        svc.submit(request_from_dataset(ds, name="probe"), timeout=0)
+    assert xferstats.counter("serve_rejected_jobs") == before
+    assert T.health()["state"] == "degraded"
+    # an actual blocking rejection while full escalates to unhealthy
+    with pytest.raises(QueueFull):
+        svc.submit(request_from_dataset(ds, name="overflow"))
+    assert xferstats.counter("serve_rejected_jobs") == before + 1
+    h = T.health()
+    assert h["state"] == "unhealthy", h
+    exposition = T.render_prometheus()
+    assert "tuplex_health_state 2" in exposition
+    svc.close()
+    # close() drops the service's checks: health is ok again
+    assert T.health()["state"] == "ok"
+    assert T.health()["checks"] == {}
+    c.close()
+
+
+def test_health_wedged_compile_watchdog(tmp_path, monkeypatch):
+    from tuplex_tpu.exec import compilequeue as CQ
+    from tuplex_tpu.serve import JobService
+
+    c = _svc_ctx(tmp_path,
+                 **{"tuplex.serve.healthWedgedCompileS": "5"})
+    svc = JobService(c.options_store, autostart=False)
+    assert T.health()["checks"]["compile_watchdog"]["state"] == "ok"
+    # synthetic wedge: an in-flight fingerprint 60s old (> 3x threshold)
+    monkeypatch.setitem(CQ._PENDING_T, "deadbeef",
+                        time.monotonic() - 60.0)
+    info = CQ.pending_info()
+    assert info["inflight_oldest_age_seconds"] > 50
+    h = T.health()
+    assert h["checks"]["compile_watchdog"]["state"] == "unhealthy", h
+    svc.close()
+    c.close()
+
+
+def test_serve_gauges_registered_and_dropped(tmp_path):
+    from tuplex_tpu.serve import JobService
+
+    c = _svc_ctx(tmp_path)
+    svc = JobService(c.options_store, autostart=False)
+    names = {n for n, _lk, _v in T.registry().gauge_samples()}
+    assert {"serve_queue_ready_jobs", "serve_slots_busy",
+            "serve_admission_saturation",
+            "serve_resident_bytes"} <= names, names
+    svc.close()
+    assert T.registry().gauge_samples() == []
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# serve-path latency histograms, end to end
+# ---------------------------------------------------------------------------
+
+def test_serve_job_records_latency_histograms(tmp_path):
+    c = _svc_ctx(tmp_path)
+    ds = c.parallelize([(i,) for i in range(500)], columns=["v"]) \
+        .map(lambda x: x["v"] * 2)
+    h = c.submit(ds, name="lat", tenant="alice")
+    assert h.result(timeout=300) == [i * 2 for i in range(500)]
+    hists = T.registry().histograms()
+    by_name = {}
+    for (name, lk), hist in hists.items():
+        by_name.setdefault(name, []).append((dict(lk), hist))
+    for metric in ("serve_admission_wait_seconds",
+                   "serve_stage_queue_wait_seconds",
+                   "serve_dispatch_seconds",
+                   "serve_job_latency_seconds"):
+        assert metric in by_name, sorted(by_name)
+        labels, hist = by_name[metric][0]
+        assert labels.get("tenant") == "alice"
+        assert hist.count >= 1
+    lat = T.registry().merged("serve_job_latency_seconds")
+    assert lat.percentiles()["p99"] > 0
+    # the exposition carries the job-latency histogram with percentile-
+    # derivable buckets (the acceptance criterion's machine-readable form)
+    text = c.metrics.export_prometheus()
+    assert 'tuplex_serve_job_latency_seconds_bucket{tenant="alice",le=' \
+        in text
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# wire protocol: /metrics + /healthz + metrics.prom + metrics.port
+# ---------------------------------------------------------------------------
+
+def test_serve_loop_metrics_endpoints(tmp_path):
+    from tuplex_tpu.serve import JobService
+    from tuplex_tpu.serve import client as sc
+
+    c = _svc_ctx(tmp_path, **{"tuplex.serve.metricsPort": 0,
+                              "tuplex.serve.metricsPromS": "0.2"})
+    root = str(tmp_path / "svcroot")
+    svc = JobService(c.options_store)
+    t = threading.Thread(target=sc.service_loop, args=(root,),
+                         kwargs={"service": svc, "max_idle_s": 60},
+                         daemon=True)
+    t.start()
+    port_file = os.path.join(root, "metrics.port")
+    deadline = time.monotonic() + 30
+    while not os.path.exists(port_file) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert os.path.exists(port_file), "metrics.port never appeared"
+    port = int(open(port_file).read().strip())
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+        body = r.read().decode()
+        assert r.status == 200
+        assert "text/plain" in r.headers["Content-Type"]
+    _lint_exposition(body)
+    assert "tuplex_serve_open_jobs" in body
+    assert "tuplex_health_state" in body
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+        health = json.loads(r.read().decode())
+        assert r.status == 200
+    assert health["state"] == "ok"
+    assert "serve_admission" in health["checks"]
+    # the periodic text drop for portless clients
+    prom = os.path.join(root, "metrics.prom")
+    deadline = time.monotonic() + 30
+    while not os.path.exists(prom) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert os.path.exists(prom), "metrics.prom never dropped"
+    _lint_exposition(open(prom).read())
+    open(os.path.join(root, "STOP"), "w").close()
+    t.join(20)
+    assert not t.is_alive()
+    svc.close()
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite: span-embed truncation is accounted, never silent
+# ---------------------------------------------------------------------------
+
+def test_span_embed_cap_annotated_and_counted(tmp_path, monkeypatch):
+    from tuplex_tpu.history.recorder import (JobRecorder, _waterfall_html)
+    from tuplex_tpu.runtime import tracing, xferstats
+
+    was = tracing.enabled()
+    tracing.enable(True)
+    tracing.clear()
+    try:
+        rec = JobRecorder(str(tmp_path), enabled=True)
+        monkeypatch.setattr(JobRecorder, "SPAN_EVENT_CAP", 10)
+        rec.job_started("capped", [])
+        for i in range(25):
+            with tracing.span(f"s{i}"):
+                pass
+        before = xferstats.counter("trace_spans_dropped")
+        rec.job_done(1, 0.1, {})
+        assert xferstats.counter("trace_spans_dropped") == before + 15
+        lines = [json.loads(ln)
+                 for ln in open(tmp_path / "tuplex_history.jsonl")]
+        sp = next(e for e in lines if e["event"] == "spans")
+        assert sp["n_total"] == 25 and sp["n_dropped"] == 15
+        assert len(sp["spans"]) == 10
+        html = _waterfall_html(sp)
+        assert "10 of 25 span(s) shown" in html
+        assert "15 shortest span(s) dropped" in html
+    finally:
+        tracing.enable(was)
+        tracing.clear()
+
+
+def test_serve_job_spans_reach_trace_replay(tmp_path):
+    from tuplex_tpu.history.recorder import history_to_chrome
+    from tuplex_tpu.runtime import tracing
+
+    was = tracing.enabled()
+    tracing.enable(True)
+    try:
+        c = _svc_ctx(tmp_path, **{"tuplex.webui.enable": True,
+                                  "tuplex.logDir": str(tmp_path)})
+        ds = c.parallelize(list(range(200)), columns=["v"]) \
+            .map(lambda x: x["v"] + 1)
+        h = c.submit(ds, name="traced", tenant="acme")
+        assert h.wait(300) == "done"
+        out = history_to_chrome(str(tmp_path),
+                                str(tmp_path / "trace.json"))
+        doc = json.load(open(out))
+        lanes = {e["args"]["name"]: e["pid"] for e in doc["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        lane_name = f"job {h.id} (acme)"
+        assert lane_name in lanes, lanes
+        spans = [e for e in doc["traceEvents"]
+                 if e.get("pid") == lanes[lane_name] and e.get("ph") == "X"]
+        assert any(e["name"] == "stage:execute" for e in spans), \
+            [e["name"] for e in spans][:20]
+        c.close()
+    finally:
+        tracing.enable(was)
+
+
+# ---------------------------------------------------------------------------
+# satellite: bench_diff regression gate
+# ---------------------------------------------------------------------------
+
+def _bench_diff(*argv):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO, "scripts", "bench_diff.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main(list(argv))
+
+
+def test_bench_diff_flags_regressions(tmp_path, capsys):
+    old = {"metric": "zillow", "value": 100000.0, "unit": "rows/s",
+           "compile_s": 10.0, "d2h_bytes": 1000, "h2d_bytes": 500}
+    ok = {"metric": "zillow", "value": 98000.0, "unit": "rows/s",
+          "compile_s": 10.5, "d2h_bytes": 1000, "h2d_bytes": 500}
+    bad = {"metric": "zillow", "value": 80000.0, "unit": "rows/s",
+           "compile_s": 30.0, "d2h_bytes": 1000, "h2d_bytes": 500}
+    for name, d in (("old", old), ("ok", ok), ("bad", bad)):
+        with open(tmp_path / f"{name}.json", "w") as fp:
+            json.dump(d, fp)
+    # the committed BENCH wrapper shape ({"parsed": ...}) loads too
+    with open(tmp_path / "wrapped.json", "w") as fp:
+        json.dump({"n": 5, "rc": 0, "parsed": old}, fp)
+    assert _bench_diff(str(tmp_path / "old.json"),
+                       str(tmp_path / "ok.json")) == 0
+    rc = _bench_diff(str(tmp_path / "old.json"), str(tmp_path / "bad.json"))
+    assert rc == 1
+    out = capsys.readouterr()
+    assert "REGRESSION" in out.out and "value" in out.err
+    assert _bench_diff(str(tmp_path / "wrapped.json"),
+                       str(tmp_path / "ok.json")) == 0
+    # restricting to keys that did not regress passes
+    assert _bench_diff(str(tmp_path / "old.json"),
+                       str(tmp_path / "bad.json"),
+                       "--keys", "d2h_bytes") == 0
+    # "value" direction follows the unit: for a latency metric (unit
+    # "s") a FALLING value is an improvement and a rising one regresses
+    lat_old = {"metric": "serve_zillow_p99_latency_s", "value": 10.0,
+               "unit": "s", "concurrent": {"p99": 10.0},
+               "serial": {"p99": 4.0}}
+    lat_fast = {"metric": "serve_zillow_p99_latency_s", "value": 5.0,
+                "unit": "s", "concurrent": {"p99": 5.0},
+                "serial": {"p99": 4.0}}
+    for name, d in (("lat_old", lat_old), ("lat_fast", lat_fast)):
+        with open(tmp_path / f"{name}.json", "w") as fp:
+            json.dump(d, fp)
+    assert _bench_diff(str(tmp_path / "lat_old.json"),
+                       str(tmp_path / "lat_fast.json")) == 0
+    assert _bench_diff(str(tmp_path / "lat_fast.json"),
+                       str(tmp_path / "lat_old.json")) == 1
+
+
+# ---------------------------------------------------------------------------
+# tier-1 wiring of the p99 harness smoke (like scripts/serve_smoke.py)
+# ---------------------------------------------------------------------------
+
+def test_serve_bench_smoke():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "serve_bench.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=580,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    assert "serve-bench OK" in out.stderr
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["metric"] == "serve_zillow_p99_latency_s"
+    assert result["value"] > 0
+    for mode in ("concurrent", "serial"):
+        for k in ("p50", "p95", "p99", "max", "mean", "wall_s"):
+            assert result[mode][k] >= 0, (mode, k, result)
+    assert result["telemetry_count"] >= 7    # warm + 2x3 jobs

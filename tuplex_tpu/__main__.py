@@ -72,6 +72,12 @@ def main(argv=None) -> int:
                     help="scheduler slots (tuplex.serve.slots)")
     sv.add_argument("--queue-depth", type=int, default=None,
                     help="admission queue depth (tuplex.serve.queueDepth)")
+    sv.add_argument("--metrics-port", type=int, default=None,
+                    help="serve Prometheus /metrics + /healthz on this "
+                         "loopback port (0 = pick a free one, announced "
+                         "in <root>/metrics.port; default off — the "
+                         "periodic <root>/metrics.prom drop happens "
+                         "regardless; tuplex.serve.metricsPort)")
     sub.add_parser("version", help="print the package version")
     args = parser.parse_args(argv)
 
@@ -107,6 +113,8 @@ def main(argv=None) -> int:
             opts.set("tuplex.serve.slots", args.slots)
         if args.queue_depth is not None:
             opts.set("tuplex.serve.queueDepth", args.queue_depth)
+        if args.metrics_port is not None:
+            opts.set("tuplex.serve.metricsPort", args.metrics_port)
         try:
             n = service_loop(args.root, opts)
         except KeyboardInterrupt:

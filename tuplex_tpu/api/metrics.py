@@ -163,6 +163,18 @@ class Metrics:
 
         return json.dumps(self.as_dict())
 
+    def export_prometheus(self) -> str:
+        """Prometheus text exposition of the PROCESS-WIDE telemetry
+        registry (runtime/telemetry): serve-path latency histograms,
+        scheduler/memory gauges, the bridged tagged-counter families
+        (runtime/xferstats) and compile-plane stats, plus the health
+        state. The same text `python -m tuplex_tpu serve --metrics-port`
+        serves at /metrics and the wire protocol drops as
+        `<root>/metrics.prom` — this is the library entry point."""
+        from ..runtime import telemetry
+
+        return telemetry.render_prometheus()
+
     def export_trace(self, path: str) -> str:
         """Write the span timeline recorded so far (``tuplex.tpu.trace`` /
         TUPLEX_TRACE=1) as Chrome trace-event JSON — open in Perfetto

@@ -114,6 +114,32 @@ DEFAULTS: dict[str, str] = {
                                             # weight w = w consecutive stage
                                             # dispatches per scheduler cycle
                                             # (unlisted tenants weigh 1)
+    "tuplex.serve.metricsPort": "-1",       # loopback HTTP port for
+                                            # Prometheus /metrics +
+                                            # /healthz on `python -m
+                                            # tuplex_tpu serve` (runtime/
+                                            # telemetry). -1 = no server;
+                                            # 0 = pick a free port and
+                                            # announce it in
+                                            # <root>/metrics.port
+    "tuplex.serve.metricsPromS": "5",       # seconds between atomic
+                                            # <root>/metrics.prom text
+                                            # drops by the serve loop (the
+                                            # wire protocol's no-socket
+                                            # telemetry leg; <=0 disables)
+    "tuplex.serve.healthSaturation": "0.9", # admission-queue fill fraction
+                                            # (open/queueDepth) at which
+                                            # the health state degrades;
+                                            # full + rejecting = unhealthy
+    "tuplex.serve.healthWedgedCompileS": "300",  # oldest in-flight compile
+                                            # age (s) before the health
+                                            # state degrades (the wedged-
+                                            # compile watchdog; 3x ->
+                                            # unhealthy)
+    "tuplex.serve.healthStarvationS": "120",  # ready jobs waiting with all
+                                            # slots busy and no turn
+                                            # finishing for this long ->
+                                            # degraded (4x -> unhealthy)
     # --- TPU-native keys ---------------------------------------------------
     "tuplex.tpu.deviceBatchSize": "1048576",    # rows per device dispatch
     "tuplex.tpu.padBucketing": "q8",            # q8 | pow2 | exact
@@ -151,6 +177,21 @@ DEFAULTS: dict[str, str] = {
                                             # TUPLEX_STATIC_TYPES=0 is the
                                             # env escape hatch (wins over
                                             # the option, for A/B timing)
+    "tuplex.tpu.telemetry": "true",         # serve-layer telemetry
+                                            # (runtime/telemetry.py):
+                                            # streaming latency histograms,
+                                            # sampled gauges, health checks
+                                            # behind Metrics.
+                                            # export_prometheus() and the
+                                            # serve /metrics endpoint.
+                                            # Default on (O(1) per record).
+                                            # Like tuplex.tpu.trace the
+                                            # gate is process-wide and the
+                                            # option only ever turns it ON;
+                                            # the TUPLEX_TELEMETRY=0 env
+                                            # kill switch (wins over all)
+                                            # makes every record a single
+                                            # flag check, zero allocation
     "tuplex.tpu.trace": "false",            # structured span tracing
                                             # (runtime/tracing.py): nested
                                             # spans across plan/compile/

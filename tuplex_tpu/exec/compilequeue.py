@@ -71,6 +71,7 @@ _LOCK = threading.Lock()
 # process ever built (the backend JitCache is bounded; this must be too).
 _EXECS: "OrderedDict[str, Any]" = OrderedDict()
 _PENDING: dict[str, Future] = {}     # fingerprint -> in-flight compile
+_PENDING_T: dict[str, float] = {}    # fingerprint -> compile start (monotonic)
 _TAG: dict[str, list] = {}           # tag -> [seconds, count] (unconsumed)
 _POOL: Optional["_DaemonPool"] = None
 
@@ -138,6 +139,25 @@ def snapshot() -> dict:
 def delta(snap: dict) -> dict:
     with _LOCK:
         return {k: STATS[k] - snap.get(k, 0) for k in STATS}
+
+
+def pending_info() -> dict:
+    """In-flight compile pressure for telemetry/health: how many
+    fingerprints are being compiled right now and the age of the OLDEST
+    one (seconds). A compile that wedges XLA keeps its entry until it
+    finishes or its owner abandons it, so a growing oldest age is the
+    wedged-compile watchdog signal the health state machine reads
+    (runtime/telemetry)."""
+    now = time.monotonic()
+    with _LOCK:
+        oldest = min(_PENDING_T.values(), default=None)
+        queued = _POOL._q.qsize() if _POOL is not None else 0
+        return {
+            "inflight": len(_PENDING),
+            "inflight_oldest_age_seconds":
+                (now - oldest) if oldest is not None else 0.0,
+            "pool_queued": queued,
+        }
 
 
 def consume_tag(tag: str) -> tuple[float, int]:
@@ -486,6 +506,7 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
                 if fut is None:
                     fut = Future()
                     _PENDING[fp] = fut
+                    _PENDING_T[fp] = time.monotonic()
                     break
         if cached is not None:
             xferstats.bump("cache_hits", 1, tag="dedup")
@@ -600,11 +621,13 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
                 compiled = _compile_job()
         with _LOCK:
             _PENDING.pop(fp, None)
+            _PENDING_T.pop(fp, None)
         fut.set_result(compiled)
         return compiled
     except BaseException as e:
         with _LOCK:
             _PENDING.pop(fp, None)
+            _PENDING_T.pop(fp, None)
         fut.set_exception(e)
         raise
 

@@ -182,26 +182,53 @@ class JobRecorder:
         evts = tracing.events_since(self._trace_mark)
         if not evts:
             return
-        n_total = len(evts)
-        if len(evts) > self.SPAN_EVENT_CAP:
-            # keep the top spans BY DURATION, not the first N to complete
-            # — a many-partition job's structural spans (job, stage
-            # executes, compiles) finish last and must survive the cap;
-            # only the shortest leaf spans drop. Re-sort by start so the
-            # slice stays a timeline.
-            evts = sorted(sorted(evts,
-                                 key=lambda e: -(e.get("dur") or 0.0))
-                          [: self.SPAN_EVENT_CAP],
-                          key=lambda e: e["ts"])
-        spans = [{"name": e["name"], "cat": e.get("cat", ""),
-                  "ts": round(float(e["ts"]), 1),
-                  "dur": round(float(e["dur"]), 1)
-                  if e.get("dur") is not None else 0.0,
-                  "tid": e.get("tid", 0), "depth": e.get("depth", 0),
-                  **({"args": e["args"]} if e.get("args") else {})}
-                 for e in evts]
+        spans, n_total, n_dropped = _span_slice(evts, self.SPAN_EVENT_CAP)
         self._write({"event": "spans", "n_total": n_total,
-                     "spans": spans})
+                     "n_dropped": n_dropped, "spans": spans})
+
+    def serve_job_spans(self, job_id: str, evts: list,
+                        tenant: Optional[str] = None) -> None:
+        """Embed a JOB-SERVICE job's tenant-tagged span stream
+        (``tracing.events_for_stream(job_id)``) keyed by the job's own id,
+        so serve jobs get the same dashboard waterfall and `python -m
+        tuplex_tpu trace` replay lane as single-job runs — previously only
+        in-process jobs' streams survived into the replay."""
+        if not self.enabled or not evts:
+            return
+        spans, n_total, n_dropped = _span_slice(evts, self.SPAN_EVENT_CAP)
+        rec = {"event": "spans", "job": str(job_id), "n_total": n_total,
+               "n_dropped": n_dropped, "spans": spans}
+        if tenant is not None:
+            rec["tenant"] = tenant
+        self._write(rec)
+
+
+def _span_slice(evts: list, cap: int) -> tuple:
+    """The embedded per-job span slice: (spans, n_total, n_dropped).
+    Past `cap` events, keep the top spans BY DURATION, not the first N to
+    complete — a many-partition job's structural spans (job, stage
+    executes, compiles) finish last and must survive the cap; only the
+    shortest leaf spans drop. Re-sorted by start so the slice stays a
+    timeline. Truncation is never silent: the dropped count rides the
+    record (the waterfall panel renders it) and bumps the
+    ``trace_spans_dropped`` counter (runtime/xferstats — visible in
+    Metrics counters and the Prometheus scrape)."""
+    n_total = len(evts)
+    n_dropped = max(0, n_total - cap)
+    if n_dropped:
+        evts = sorted(sorted(evts, key=lambda e: -(e.get("dur") or 0.0))
+                      [:cap], key=lambda e: e["ts"])
+        from ..runtime import xferstats
+
+        xferstats.bump("trace_spans_dropped", n_dropped, tag="embed_cap")
+    spans = [{"name": e["name"], "cat": e.get("cat", ""),
+              "ts": round(float(e["ts"]), 1),
+              "dur": round(float(e["dur"]), 1)
+              if e.get("dur") is not None else 0.0,
+              "tid": e.get("tid", 0), "depth": e.get("depth", 0),
+              **({"args": e["args"]} if e.get("args") else {})}
+             for e in evts]
+    return spans, n_total, n_dropped
 
 
 _LINT_CAP = 80
@@ -296,8 +323,14 @@ def _waterfall_html(sp_ev: dict) -> str:
             f'{html.escape(cat)}" style="left:{left:.2f}%;'
             f'width:{width:.2f}%"></span></span></div>')
     n_total = sp_ev.get("n_total", len(spans))
-    head = (f"span waterfall — {len(shown)} of {n_total} span(s), "
+    n_dropped = sp_ev.get("n_dropped", 0)
+    head = (f"span waterfall — {len(shown)} of {n_total} span(s) shown, "
             f"{total / 1e3:.1f}ms window")
+    if n_dropped:
+        # the recorder capped the embedded slice: say so instead of
+        # letting a truncated panel read as the whole timeline
+        head += (f" ({n_dropped} shortest span(s) dropped at the "
+                 f"{len(spans)}-span embed cap)")
     return (f"<details open class=waterfall><summary>{html.escape(head)}"
             f"</summary>{''.join(bars)}</details>")
 
@@ -478,8 +511,14 @@ def history_to_chrome(log_dir: str = ".", out_path: str =
 
     trace_events: list = []
     for lane, (job_id, events) in enumerate(jobs.items(), start=1):
+        # serve-submitted jobs carry a tenant on their rows (serve_job_
+        # event / serve_job_spans): label the lane with it so a
+        # multi-tenant replay separates by eye
+        tenant = next((e["tenant"] for e in events if e.get("tenant")),
+                      None)
+        lane_name = f"job {job_id}" + (f" ({tenant})" if tenant else "")
         trace_events.append({"name": "process_name", "ph": "M", "pid": lane,
-                             "tid": 0, "args": {"name": f"job {job_id}"}})
+                             "tid": 0, "args": {"name": lane_name}})
         sp_ev = next((e for e in events if e.get("event") == "spans"), None)
         if sp_ev and sp_ev.get("spans"):
             t0 = min(s["ts"] for s in sp_ev["spans"])

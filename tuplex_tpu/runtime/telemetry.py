@@ -1,0 +1,604 @@
+"""Serve-layer telemetry: streaming histograms, sampled gauges, health.
+
+Per-job observability already exists (api/metrics one-shot snapshots,
+runtime/tracing span dumps) but a long-lived service needs the OPPOSITE
+shape: cheap, always-on, mergeable AGGREGATES a scraper can pull at any
+moment without touching per-event state. Three pieces:
+
+* **log-bucketed streaming histograms** — fixed memory (~120 buckets
+  spanning 1e-6..1e6 with 10 buckets/decade ≈ ±12% relative error),
+  O(1) record (one log10 + one list bump under a lock), exact
+  count/sum/min/max, mergeable across threads/hosts by elementwise bucket
+  addition, and p50/p95/p99/max readouts by cumulative walk. The serve
+  path records admission wait, stage-queue wait, per-dispatch latency and
+  end-to-end job latency into per-tenant series.
+* **sampled gauges** — a value or a zero-arg callable evaluated at
+  export time (queue depth, busy slots, resident bytes...). Gauges and
+  health checks carry an ``owner`` token so a closing JobService drops
+  everything it registered (``drop_owner``) — a process that serves many
+  short-lived services in tests must not accumulate dead callbacks.
+* **a health state machine** — named checks return (state, detail);
+  the overall state is the worst of them (ok < degraded < unhealthy).
+  The JobService wires admission-queue saturation, wedged-compile age
+  and slot starvation; ``/healthz`` and the Prometheus gauge expose it.
+
+Exposition is pull-based Prometheus text (``render_prometheus``): the
+registry's own series plus bridged families from the tagged counter
+registry (runtime/xferstats — d2h/h2d/spill/cache and every other named
+counter) and the compile queue (exec/compilequeue STATS + in-flight
+ages), so ONE scrape shows the data plane, the compile plane and the
+scheduler. ``start_metrics_server`` serves ``/metrics`` + ``/healthz``
+on a loopback stdlib HTTP thread; ``write_prom`` drops the same text
+atomically for the scratch-dir wire protocol.
+
+Disabled (``TUPLEX_TELEMETRY=0`` env, or ``tuplex.tpu.telemetry`` false)
+the record path is one module-flag check — no allocation, no lock, no
+bucket write (the same zero-overhead contract the tracing no-op path
+pins, test-asserted).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Callable, Optional
+
+# ---------------------------------------------------------------------------
+# enable gate (mirrors runtime/tracing: process-wide, env wins)
+# ---------------------------------------------------------------------------
+
+
+def _env_disabled() -> bool:
+    return os.environ.get("TUPLEX_TELEMETRY", "").strip().lower() \
+        in ("0", "false", "off")
+
+
+_enabled = not _env_disabled()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(on: bool = True) -> None:
+    """Process-wide record gate. The env kill switch TUPLEX_TELEMETRY=0
+    wins over any option-driven enable (A/B overhead timing)."""
+    global _enabled
+    _enabled = bool(on) and not _env_disabled()
+
+
+# ---------------------------------------------------------------------------
+# streaming histogram
+# ---------------------------------------------------------------------------
+
+#: bucket geometry: 12 decades from 1 microsecond to 1 megasecond covers
+#: every latency this framework can see; 10 buckets/decade bounds the
+#: percentile estimate's relative error at ~±12% (half a bucket width)
+_LO = 1e-6
+_DECADES = 12
+_PER_DECADE = 10
+_NBUCKETS = _DECADES * _PER_DECADE
+_LOG_LO = math.log10(_LO)
+
+
+def _bucket_upper(i: int) -> float:
+    """Upper bound of regular bucket i (1-based within the regular run)."""
+    return 10.0 ** (_LOG_LO + i / _PER_DECADE)
+
+
+class Histogram:
+    """Fixed-size log-bucketed streaming histogram.
+
+    ``counts[0]`` is the underflow bucket (values <= _LO, including 0 and
+    negatives), ``counts[-1]`` the overflow; count/sum/min/max are exact
+    so single-sample and extreme percentiles clamp to true values.
+    ``record`` is O(1); ``merge`` is elementwise and lossless, so
+    per-thread or per-host instances combine into one distribution.
+    """
+
+    __slots__ = ("counts", "count", "sum", "min", "max", "_lock")
+
+    def __init__(self):
+        self.counts = [0] * (_NBUCKETS + 2)
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self._lock = threading.Lock()
+
+    # -- write ---------------------------------------------------------------
+    def record(self, value: float) -> None:
+        v = float(value)
+        if not math.isfinite(v):        # NaN/±inf: drop — a sentinel from
+            return                      # a bad division must not poison
+                                        # the sum (or blow up in log10)
+        if v <= _LO:
+            idx = 0
+        else:
+            idx = 1 + int((math.log10(v) - _LOG_LO) * _PER_DECADE)
+            if idx > _NBUCKETS:
+                idx = _NBUCKETS + 1
+        with self._lock:
+            self.counts[idx] += 1
+            self.count += 1
+            self.sum += v
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold `other`'s distribution into self (both stay usable)."""
+        with other._lock:
+            oc = list(other.counts)
+            on, os_, omin, omax = (other.count, other.sum,
+                                   other.min, other.max)
+        with self._lock:
+            for i, c in enumerate(oc):
+                self.counts[i] += c
+            self.count += on
+            self.sum += os_
+            if omin < self.min:
+                self.min = omin
+            if omax > self.max:
+                self.max = omax
+        return self
+
+    # -- read ----------------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"counts": list(self.counts), "count": self.count,
+                    "sum": self.sum, "min": self.min, "max": self.max}
+
+    @staticmethod
+    def _pct_from(snap: dict, q: float) -> float:
+        n = snap["count"]
+        if n <= 0:
+            return 0.0
+        target = max(1, math.ceil(max(0.0, min(1.0, q)) * n))
+        cum = 0
+        est = snap["max"]
+        for i, c in enumerate(snap["counts"]):
+            cum += c
+            if cum >= target:
+                if i == 0:
+                    est = snap["min"]
+                elif i == _NBUCKETS + 1:
+                    est = snap["max"]
+                else:
+                    est = 10.0 ** (_LOG_LO + (i - 0.5) / _PER_DECADE)
+                break
+        return min(max(est, snap["min"]), snap["max"])
+
+    def percentile(self, q: float) -> float:
+        """Estimate the q-quantile (q in [0,1]): cumulative bucket walk,
+        geometric bucket midpoint, clamped to the exact [min, max]. 0.0
+        when empty."""
+        return self._pct_from(self.snapshot(), q)
+
+    def percentiles(self) -> dict:
+        """The standard readout: p50/p95/p99 + exact max/mean/count. ONE
+        locked snapshot feeds every quantile, so a readout racing
+        concurrent record()s stays internally consistent (four separate
+        snapshots could report p99 < p50)."""
+        snap = self.snapshot()
+        n = snap["count"]
+        return {
+            "count": n,
+            "mean": (snap["sum"] / n) if n else 0.0,
+            "p50": self._pct_from(snap, 0.50),
+            "p95": self._pct_from(snap, 0.95),
+            "p99": self._pct_from(snap, 0.99),
+            "max": snap["max"] if n else 0.0,
+        }
+
+    def prom_buckets(self, snap: Optional[dict] = None) \
+            -> list[tuple[str, int]]:
+        """Cumulative (le, count) pairs for Prometheus exposition. Sparse:
+        only boundaries where the cumulative count moves are emitted (plus
+        the mandatory +Inf) — 120 mostly-empty buckets per labeled series
+        would swamp the scrape. Pass the snapshot the caller already took
+        so _bucket/_sum/_count render from one consistent view."""
+        if snap is None:
+            snap = self.snapshot()
+        out: list[tuple[str, int]] = []
+        cum = 0
+        prev = 0
+        for i in range(_NBUCKETS + 1):          # underflow + regular runs
+            cum += snap["counts"][i]
+            if cum != prev:
+                le = _bucket_upper(i) if i > 0 else _LO
+                out.append((repr(le), cum))
+                prev = cum
+        out.append(("+Inf", snap["count"]))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+#: health states, worst wins
+OK = "ok"
+DEGRADED = "degraded"
+UNHEALTHY = "unhealthy"
+_RANK = {OK: 0, DEGRADED: 1, UNHEALTHY: 2}
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Registry:
+    """Named histogram/gauge/health-check store. Metric names use
+    Prometheus spelling minus the ``tuplex_`` prefix (added at render):
+    ``serve_job_latency_seconds``, labels as kwargs."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hists: dict[tuple, Histogram] = {}
+        # name-key -> (owner, value-or-callable)
+        self._gauges: dict[tuple, tuple] = {}
+        self._checks: dict[str, tuple] = {}
+
+    # -- histograms ----------------------------------------------------------
+    def histogram(self, name: str, **labels) -> Histogram:
+        key = (name, _label_key(labels))
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = Histogram()
+            return h
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        self.histogram(name, **labels).record(value)
+
+    def histograms(self) -> dict:
+        with self._lock:
+            return dict(self._hists)
+
+    def merged(self, name: str) -> Histogram:
+        """All label series of `name` merged into one fresh Histogram —
+        overall percentiles across tenants."""
+        out = Histogram()
+        for (n, _lk), h in self.histograms().items():
+            if n == name:
+                out.merge(h)
+        return out
+
+    # -- gauges --------------------------------------------------------------
+    def set_gauge(self, name: str, value, owner=None, **labels) -> None:
+        """Register a gauge: `value` may be a number or a zero-arg callable
+        sampled at export (a failing callable exports nothing)."""
+        with self._lock:
+            self._gauges[(name, _label_key(labels))] = (owner, value)
+
+    def gauge_samples(self) -> list[tuple[str, tuple, float]]:
+        with self._lock:
+            items = list(self._gauges.items())
+        out = []
+        for (name, lk), (_owner, v) in items:
+            try:
+                val = float(v() if callable(v) else v)
+            except Exception:
+                continue
+            out.append((name, lk, val))
+        return out
+
+    # -- health --------------------------------------------------------------
+    def register_health_check(self, name: str, fn: Callable,
+                              owner=None) -> None:
+        """`fn()` -> (state, detail) with state in ok|degraded|unhealthy."""
+        with self._lock:
+            self._checks[name] = (owner, fn)
+
+    def health(self) -> dict:
+        """Evaluate every check; overall state is the worst one. A check
+        that raises reports degraded (a broken probe is a signal, not a
+        crash)."""
+        with self._lock:
+            checks = list(self._checks.items())
+        out: dict = {"state": OK, "checks": {}}
+        for name, (_owner, fn) in checks:
+            try:
+                state, detail = fn()
+                if state not in _RANK:
+                    state, detail = DEGRADED, f"bad check state {state!r}"
+            except Exception as e:   # noqa: BLE001 - probe failure != crash
+                state, detail = DEGRADED, f"check failed: {e}"
+            out["checks"][name] = {"state": state,
+                                   **({"detail": detail} if detail else {})}
+            if _RANK[state] > _RANK[out["state"]]:
+                out["state"] = state
+        return out
+
+    # -- lifecycle -----------------------------------------------------------
+    def drop_owner(self, owner) -> None:
+        """Remove every gauge and health check `owner` registered (a
+        closing JobService; histograms stay — they are data, not
+        callbacks into dead objects)."""
+        with self._lock:
+            self._gauges = {k: v for k, v in self._gauges.items()
+                            if v[0] is not owner}
+            self._checks = {k: v for k, v in self._checks.items()
+                            if v[0] is not owner}
+
+    def clear(self) -> None:
+        """Drop everything (tests)."""
+        with self._lock:
+            self._hists.clear()
+            self._gauges.clear()
+            self._checks.clear()
+
+
+_REG = Registry()
+
+
+def registry() -> Registry:
+    return _REG
+
+
+# -- module-level conveniences (the instrumented call sites) -----------------
+
+def observe(name: str, value: float, **labels) -> None:
+    """Record one histogram sample. Disabled: one flag check, nothing
+    allocated — safe on any hot path."""
+    if not _enabled:
+        return
+    _REG.observe(name, value, **labels)
+
+
+def set_gauge(name: str, value, owner=None, **labels) -> None:
+    if not _enabled:
+        return
+    _REG.set_gauge(name, value, owner=owner, **labels)
+
+
+def register_health_check(name: str, fn: Callable, owner=None) -> None:
+    _REG.register_health_check(name, fn, owner=owner)
+
+
+def drop_owner(owner) -> None:
+    _REG.drop_owner(owner)
+
+
+def health() -> dict:
+    return _REG.health()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+_PREFIX = "tuplex_"
+
+
+def _sanitize(name: str) -> str:
+    out = []
+    for i, ch in enumerate(name):
+        ok = ch.isascii() and (ch.isalpha() or ch == "_" or ch == ":"
+                               or (ch.isdigit() and i > 0))
+        out.append(ch if ok else "_")
+    return "".join(out)
+
+
+def _esc(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _fmt_labels(pairs) -> str:
+    if not pairs:
+        return ""
+    body = ",".join(f'{_sanitize(k)}="{_esc(v)}"' for k, v in pairs)
+    return "{" + body + "}"
+
+
+def _fmt_val(v: float) -> str:
+    f = float(v)
+    if f == math.inf:
+        return "+Inf"
+    if f == -math.inf:
+        return "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _counter_families() -> dict[str, list]:
+    """Bridge runtime/xferstats into exposition families. xferstats adds a
+    tagged bump to BOTH the base counter and its per-tag bucket, so a
+    family with tags must not emit the base total alongside them (a PromQL
+    ``sum()`` over the family would double-count): tagged families emit
+    one sample per tag plus a ``tag=""`` remainder for untagged bumps;
+    tagless families emit one unlabeled sample."""
+    from . import xferstats
+
+    counters = xferstats.counters()
+    by_family: dict[str, dict] = {}
+    for key, v in xferstats.tags().items():
+        name, _, tag = key.partition(":")
+        by_family.setdefault(name, {})[tag] = v
+    fams: dict[str, list] = {}
+    for name, total in sorted(counters.items()):
+        tags = by_family.get(name)
+        if not tags:
+            fams[name] = [((), total)]
+            continue
+        rows = [((("tag", t),), v) for t, v in sorted(tags.items())]
+        rest = total - sum(tags.values())
+        if rest > 0:
+            rows.append(((("tag", ""),), rest))
+        fams[name] = rows
+    return fams
+
+
+def _compile_plane_lines(lines: list) -> None:
+    """Compile-queue counters + in-flight gauges + the AOT hit ratio."""
+    try:
+        from ..exec import compilequeue as CQ
+    except Exception:       # pragma: no cover - import cycle safety
+        return
+    stats = CQ.snapshot()
+    for k in sorted(stats):
+        if k == "compile_s":
+            continue
+        n = _PREFIX + "compile_" + _sanitize(k) + "_total"
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {_fmt_val(stats[k])}")
+    n = _PREFIX + "compile_seconds_total"
+    lines.append(f"# TYPE {n} counter")
+    lines.append(f"{n} {_fmt_val(stats.get('compile_s', 0.0))}")
+    hits = stats.get("aot_hits", 0)
+    misses = stats.get("aot_misses", 0)
+    n = _PREFIX + "aot_cache_hit_ratio"
+    lines.append(f"# TYPE {n} gauge")
+    lines.append(f"{n} {_fmt_val(hits / (hits + misses) if hits + misses else 0.0)}")
+    try:
+        info = CQ.pending_info()
+    except Exception:       # pragma: no cover - older queue builds
+        return
+    for k, v in sorted(info.items()):
+        n = _PREFIX + "compile_" + _sanitize(k)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {_fmt_val(v)}")
+
+
+def render_prometheus(reg: Optional[Registry] = None) -> str:
+    """The full scrape: registry histograms + gauges, bridged xferstats
+    counter families, compile-plane stats, and the health state as
+    gauges (0=ok 1=degraded 2=unhealthy)."""
+    reg = reg if reg is not None else _REG
+    lines: list[str] = []
+
+    # histograms, grouped by family name
+    by_name: dict[str, list] = {}
+    for (name, lk), h in sorted(reg.histograms().items()):
+        by_name.setdefault(name, []).append((lk, h))
+    for name, series in by_name.items():
+        n = _PREFIX + _sanitize(name)
+        lines.append(f"# TYPE {n} histogram")
+        for lk, h in series:
+            snap = h.snapshot()
+            for le, cum in h.prom_buckets(snap):
+                lines.append(
+                    f"{n}_bucket{_fmt_labels(tuple(lk) + (('le', le),))}"
+                    f" {cum}")
+            lines.append(f"{n}_sum{_fmt_labels(lk)} "
+                         f"{_fmt_val(snap['sum'])}")
+            lines.append(f"{n}_count{_fmt_labels(lk)} {snap['count']}")
+
+    # gauges
+    gauge_rows: dict[str, list] = {}
+    for name, lk, val in reg.gauge_samples():
+        gauge_rows.setdefault(name, []).append((lk, val))
+    for name in sorted(gauge_rows):
+        n = _PREFIX + _sanitize(name)
+        lines.append(f"# TYPE {n} gauge")
+        for lk, val in sorted(gauge_rows[name]):
+            lines.append(f"{n}{_fmt_labels(lk)} {_fmt_val(val)}")
+
+    # tagged counter registry (xferstats)
+    for name, samples in _counter_families().items():
+        n = _PREFIX + _sanitize(name) + "_total"
+        lines.append(f"# TYPE {n} counter")
+        for lk, v in samples:
+            lines.append(f"{n}{_fmt_labels(lk)} {_fmt_val(v)}")
+
+    _compile_plane_lines(lines)
+
+    # health
+    h = reg.health()
+    n = _PREFIX + "health_state"
+    lines.append(f"# TYPE {n} gauge")
+    lines.append(f"{n} {_RANK[h['state']]}")
+    if h["checks"]:
+        n = _PREFIX + "health_check_state"
+        lines.append(f"# TYPE {n} gauge")
+        for cname in sorted(h["checks"]):
+            lines.append(
+                f"{n}{_fmt_labels((('check', cname),))} "
+                f"{_RANK[h['checks'][cname]['state']]}")
+    return "\n".join(lines) + "\n"
+
+
+def write_prom(path: str, reg: Optional[Registry] = None) -> str:
+    """Atomically drop the exposition text to `path` (the scratch-dir
+    wire protocol's `<root>/metrics.prom`)."""
+    text = render_prometheus(reg)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fp:
+        fp.write(text)
+    os.replace(tmp, path)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# the /metrics + /healthz HTTP server (stdlib, loopback by default)
+# ---------------------------------------------------------------------------
+
+def _make_server(port: int, host: str):
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            if self.path.split("?")[0] in ("/healthz", "/health"):
+                h = health()
+                body = json.dumps(h).encode()
+                # degraded still returns 200 (scrapers keep reading a
+                # limping service); only unhealthy is a hard 503
+                code = 503 if h["state"] == UNHEALTHY else 200
+                ctype = "application/json"
+            elif self.path.split("?")[0] in ("/metrics", "/"):
+                body = render_prometheus().encode()
+                code = 200
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            else:
+                body = b"not found\n"
+                code = 404
+                ctype = "text/plain"
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    return http.server.HTTPServer((host, port), Handler)
+
+
+def start_metrics_server(port: int, host: str = "127.0.0.1"):
+    """Serve /metrics (Prometheus text) and /healthz (JSON; 503 only when
+    unhealthy) on a daemon thread. port=0 picks a free port. Returns
+    (server, url); call server.shutdown() to stop."""
+    srv = _make_server(port, host)
+    t = threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="tpx-metrics")
+    t.start()
+    return srv, f"http://{host}:{srv.server_address[1]}/"
+
+
+# ---------------------------------------------------------------------------
+# readout helpers (serve_bench + tests)
+# ---------------------------------------------------------------------------
+
+def latency_report(name: str = "serve_job_latency_seconds") -> dict:
+    """Merged-across-tenants percentile readout for one histogram family."""
+    return _REG.merged(name).percentiles()
+
+
+def apply_options(options) -> None:
+    """Wire the process gate from ContextOptions. Like tracing, the
+    ``tuplex.tpu.telemetry`` option turns recording ON, never off — the
+    gate is process-wide and another live service may depend on it, so
+    one tenant's option must not freeze every other tenant's histograms.
+    The only OFF switches are process-scoped by construction: the
+    TUPLEX_TELEMETRY=0 env kill switch (wins over everything; enable()
+    re-checks it) and an explicit ``telemetry.enable(False)``."""
+    if options.get_bool("tuplex.tpu.telemetry", True):
+        enable(True)

@@ -9,6 +9,12 @@ Public surface:
 * ``client`` — the scratch-dir wire protocol + the
   ``python -m tuplex_tpu serve`` loop (serve/client.py).
 * ``Context.submit(ds)`` (api/context.py) is the one-liner entry point.
+
+Observability: the service feeds per-tenant latency histograms, queue/
+slot/memory gauges and health checks into ``runtime/telemetry`` —
+scraped via ``--metrics-port`` (/metrics + /healthz), the periodic
+``<root>/metrics.prom`` drop, or ``Metrics.export_prometheus()``;
+``scripts/serve_bench.py`` measures concurrent-vs-serial p99.
 """
 
 from .jobs import (CANCELLED, DONE, FAILED, QUEUED, REJECTED, RUNNING,

@@ -14,6 +14,12 @@ Layout under the service root:
     inbox/<job>/request.pkl      client -> service (atomic rename)
     inbox/<job>/status.json      service -> client (overwritten per poll)
     inbox/<job>/response.pkl     service -> client (atomic, terminal)
+    metrics.prom                 Prometheus text drop (runtime/telemetry,
+                                 rewritten every tuplex.serve.metricsPromS
+                                 seconds — the pull-telemetry leg of the
+                                 wire protocol for clients with no port)
+    metrics.port                 bound /metrics HTTP port, written once
+                                 when tuplex.serve.metricsPort >= 0
     STOP                         touch to shut the service loop down
 """
 
@@ -146,10 +152,51 @@ def service_loop(root: str, options=None, *, poll_s: float = 0.1,
     """Run the file-protocol front end over a JobService until
     ``<root>/STOP`` appears (or `max_idle_s` of quiet, when positive —
     tests use it). Returns the number of jobs served."""
+    from ..runtime import telemetry
+
     svc = service if service is not None else JobService(options)
     inbox = os.path.join(root, "inbox")
     os.makedirs(inbox, exist_ok=True)
     stop_file = os.path.join(root, "STOP")
+    # pull telemetry: an HTTP /metrics + /healthz endpoint when a port is
+    # configured (metricsPort >= 0; 0 = pick a free one, announced via
+    # <root>/metrics.port), and a periodic metrics.prom text drop either
+    # way — the no-socket leg of the wire protocol
+    metrics_srv = None
+    prom_path = os.path.join(root, "metrics.prom")
+    port_path = os.path.join(root, "metrics.port")
+    # a previous run's announcement is a lie the moment this loop owns
+    # the root: remove it BEFORE deciding whether to serve, so a restart
+    # without a port (or a failed bind) never points clients at a dead
+    # or recycled socket
+    try:
+        os.unlink(port_path)
+    except OSError:
+        pass
+    prom_every = svc.options.get_float("tuplex.serve.metricsPromS", 5.0)
+    last_prom = 0.0
+    if telemetry.enabled():
+        port = svc.options.get_int("tuplex.serve.metricsPort", -1)
+        if port >= 0:
+            try:
+                metrics_srv, url = telemetry.start_metrics_server(port)
+            except OSError as e:
+                log.warning("metrics server failed to bind: %s", e)
+            else:
+                try:
+                    with open(port_path, "w") as fp:
+                        fp.write(str(metrics_srv.server_address[1]))
+                    log.info("metrics at %smetrics, health at %shealthz",
+                             url, url)
+                except OSError as e:
+                    # the server IS up but undiscoverable: a --metrics-port
+                    # 0 client can never find it, so take it back down
+                    # rather than leak a silently unreachable endpoint
+                    log.warning("could not announce metrics port in %s "
+                                "(%s); shutting the metrics server down",
+                                port_path, e)
+                    metrics_srv.shutdown()
+                    metrics_srv = None
     tracked: dict = {}          # jid dir -> (jdir, handle)
     waiting: dict = {}          # jid dir -> first queue-full timestamp
     finished: set = set()
@@ -203,6 +250,10 @@ def service_loop(root: str, options=None, *, poll_s: float = 0.1,
                     if time.monotonic() - first \
                             >= svc.admission_timeout_s:
                         progressed = True
+                        # this is the client-visible rejection (the
+                        # zero-wait probes above deliberately don't
+                        # count): feed the health/counter accounting
+                        svc.note_rejection()
                         # the probe submits used timeout=0; report the
                         # wait the client ACTUALLY got
                         _reject_dir(
@@ -236,6 +287,13 @@ def service_loop(root: str, options=None, *, poll_s: float = 0.1,
                     finished.add(d)
                     served += 1
                     progressed = True
+            if telemetry.enabled() and prom_every > 0 \
+                    and time.monotonic() - last_prom >= prom_every:
+                last_prom = time.monotonic()
+                try:
+                    telemetry.write_prom(prom_path)
+                except OSError:   # telemetry drop is advisory
+                    pass
             if progressed or tracked or waiting:
                 last_activity = time.monotonic()
             elif max_idle_s > 0 and \
@@ -243,6 +301,17 @@ def service_loop(root: str, options=None, *, poll_s: float = 0.1,
                 break
             time.sleep(poll_s)
     finally:
+        if telemetry.enabled():
+            try:            # final drop: the terminal aggregate survives
+                telemetry.write_prom(prom_path)
+            except OSError:
+                pass
+        if metrics_srv is not None:
+            metrics_srv.shutdown()
+            try:                   # the port dies with the server
+                os.unlink(port_path)
+            except OSError:
+                pass
         if service is None:
             svc.close()
     return served

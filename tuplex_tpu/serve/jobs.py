@@ -210,6 +210,8 @@ class JobRecord:
                             "queued_s": None, "wall_s": None}
         self.t_submit = time.perf_counter()
         self.t_start: Optional[float] = None
+        self.t_enqueue: Optional[float] = None   # last ready-queue append
+                                                 # (stage-queue-wait metric)
 
     def _counters(self) -> dict:
         """The job's scoped xferstats family — live while running, the

@@ -38,6 +38,7 @@ from collections import deque
 from typing import Optional
 
 from ..core.options import ContextOptions
+from ..runtime import telemetry
 from ..utils.logging import get_logger
 from .jobs import (CANCELLED, DONE, FAILED, QUEUED, RUNNING, JobHandle,
                    JobRecord, JobRejected, JobRequest, QueueFull,
@@ -91,8 +92,117 @@ class JobService:
         self._stop = False
         self._threads: list = []
         self._started = False
+        self._busy = 0                    # slots currently inside a turn
+        # monotonic stamp of the last QueueFull rejection; -inf = never
+        # (0.0 would read as "recent" on a freshly booted clock)
+        self._last_reject_t = float("-inf")
+        self._last_turn_done_t = time.monotonic()
+        telemetry.apply_options(o)
+        self._register_telemetry(o)
         if autostart:
             self.start()
+
+    # ------------------------------------------------------------------
+    def _register_telemetry(self, o) -> None:
+        """Sampled gauges + health checks for the always-on serve-layer
+        telemetry (runtime/telemetry). Everything is owner-scoped to this
+        service so close() drops the callbacks; reads are lock-free
+        single-attribute loads (a scrape must never contend with the
+        scheduler)."""
+        if not telemetry.enabled():
+            return
+        self._health_saturation = o.get_float(
+            "tuplex.serve.healthSaturation", 0.9)
+        self._health_wedged_s = o.get_float(
+            "tuplex.serve.healthWedgedCompileS", 300.0)
+        self._health_starvation_s = o.get_float(
+            "tuplex.serve.healthStarvationS", 120.0)
+        g = telemetry.set_gauge
+        g("serve_queue_ready_jobs", lambda: len(self._ready), owner=self)
+        g("serve_open_jobs", lambda: self._open, owner=self)
+        g("serve_queue_depth_limit", self.queue_depth, owner=self)
+        g("serve_slots", self.slots, owner=self)
+        g("serve_slots_busy", lambda: self._busy, owner=self)
+        g("serve_admission_saturation",
+          lambda: self._open / self.queue_depth, owner=self)
+        g("serve_resident_bytes", self._resident_bytes, owner=self)
+        g("serve_turns", lambda: self._turn, owner=self)
+        telemetry.register_health_check(
+            "serve_admission", self._check_admission, owner=self)
+        telemetry.register_health_check(
+            "serve_slots", self._check_slots, owner=self)
+        telemetry.register_health_check(
+            "compile_watchdog", self._check_compile, owner=self)
+
+    def note_rejection(self) -> None:
+        """Account one CLIENT-VISIBLE admission rejection (the unhealthy
+        health signal + the serve_rejected_jobs counter). Called for
+        timed-out blocking submits and by the wire loop when a polled
+        request exhausts the admission window — never for its zero-wait
+        probes."""
+        self._last_reject_t = time.monotonic()
+        from ..runtime import xferstats
+
+        xferstats.bump("serve_rejected_jobs", 1, tag="queue_full")
+
+    def _resident_bytes(self) -> int:
+        """Summed MemoryManager footprint of the live jobs (each job's
+        private backend; terminal records dropped their runner output)."""
+        total = 0
+        with self._cond:
+            recs = [r for r in self._records.values()
+                    if r.state in (QUEUED, RUNNING)]
+        for r in recs:
+            runner = r.runner
+            if runner is not None:
+                try:
+                    total += runner.backend.mm.resident_bytes()
+                except Exception:
+                    pass
+        return total
+
+    # -- health checks (runtime/telemetry state machine inputs) ----------
+    def _check_admission(self):
+        sat = self._open / self.queue_depth
+        if sat >= 1.0 \
+                and time.monotonic() - self._last_reject_t < 60.0:
+            return (telemetry.UNHEALTHY,
+                    f"admission queue full ({self._open}/"
+                    f"{self.queue_depth}) and rejecting submissions")
+        if sat >= self._health_saturation:
+            return (telemetry.DEGRADED,
+                    f"admission queue at {sat:.0%} "
+                    f"({self._open}/{self.queue_depth})")
+        return (telemetry.OK, None)
+
+    def _check_slots(self):
+        """Slot starvation: runnable jobs are waiting but no scheduler
+        turn has completed for a while — every slot is stuck inside one
+        dispatch (a wedged compile, a pathological stage)."""
+        if not self._ready or not self._started:
+            return (telemetry.OK, None)
+        stalled = time.monotonic() - self._last_turn_done_t
+        if self._busy >= self.slots and stalled > self._health_starvation_s:
+            state = telemetry.UNHEALTHY \
+                if stalled > 4 * self._health_starvation_s \
+                else telemetry.DEGRADED
+            return (state,
+                    f"{len(self._ready)} ready job(s), all {self.slots} "
+                    f"slot(s) busy, no turn finished in {stalled:.0f}s")
+        return (telemetry.OK, None)
+
+    def _check_compile(self):
+        from ..exec import compilequeue as CQ
+
+        age = CQ.pending_info()["inflight_oldest_age_seconds"]
+        if age > 3 * self._health_wedged_s:
+            return (telemetry.UNHEALTHY,
+                    f"oldest in-flight compile {age:.0f}s old")
+        if age > self._health_wedged_s:
+            return (telemetry.DEGRADED,
+                    f"oldest in-flight compile {age:.0f}s old "
+                    f"(wedged-compile watchdog)")
+        return (telemetry.OK, None)
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -120,6 +230,7 @@ class JobService:
             self._ready.clear()
             self._open = 0
             self._cond.notify_all()
+        telemetry.drop_owner(self)   # gauges/checks close over this object
         for t in self._threads:
             t.join(timeout=timeout)
         # a worker outliving its join timeout may still be mid-step: in
@@ -168,7 +279,8 @@ class JobService:
             else self.tenant_weights.get(request.tenant, 1)
         rec = JobRecord(request, weight)
         wait_s = self.admission_timeout_s if timeout is None else timeout
-        deadline = time.monotonic() + max(0.0, wait_s)
+        t_admit0 = time.monotonic()
+        deadline = t_admit0 + max(0.0, wait_s)
         # shed load BEFORE paying for the job: wait for a queue slot
         # first, build the runner (outside the lock — spec rebuild is
         # pure, and a bad request must fail the submitter, not the
@@ -182,6 +294,12 @@ class JobService:
                         and self._open >= self.queue_depth:
                     left = deadline - time.monotonic()
                     if left <= 0:
+                        # zero-wait probes (the wire loop polls with
+                        # timeout=0 and does its OWN rejection accounting
+                        # after the full admission window) must not read
+                        # as ~10 rejections/second per waiting client
+                        if wait_s > 0:
+                            self.note_rejection()
                         _reject(QueueFull(
                             f"admission queue full ({self._open}/"
                             f"{self.queue_depth} jobs) — timed out "
@@ -193,6 +311,7 @@ class JobService:
                 if rec.runner is not None:
                     self._open += 1
                     self._records[rec.id] = rec
+                    rec.t_enqueue = time.perf_counter()
                     self._ready.append(rec)
                     self._cond.notify_all()
                     break
@@ -205,6 +324,9 @@ class JobService:
                 raise JobRejected(
                     f"job rejected at admission: "
                     f"{type(e).__name__}: {e}") from e
+        telemetry.observe("serve_admission_wait_seconds",
+                          time.monotonic() - t_admit0,
+                          tenant=request.tenant)
         self._record_event(rec, "job_start",
                            action=f"serve:{request.name}",
                            tenant=request.tenant,
@@ -254,10 +376,15 @@ class JobService:
                 if self._stop:
                     return
                 rec = self._ready.popleft()
+                self._busy += 1
                 if rec.state == QUEUED:
                     rec.state = RUNNING
                     rec.t_start = time.perf_counter()
                     rec.stats["queued_s"] = rec.t_start - rec.t_submit
+            if rec.t_enqueue is not None:
+                telemetry.observe("serve_stage_queue_wait_seconds",
+                                  time.perf_counter() - rec.t_enqueue,
+                                  tenant=rec.request.tenant)
             self._run_turn(rec)
 
     def _run_turn(self, rec: JobRecord) -> None:
@@ -269,6 +396,7 @@ class JobService:
         err: Optional[BaseException] = None
         tracing.set_stream(rec.id)
         xferstats.set_scope(rec.id)
+        t_disp0 = time.perf_counter()
         try:
             done = rec.runner.step()
             if done:
@@ -278,12 +406,35 @@ class JobService:
         finally:
             tracing.set_stream(None)
             xferstats.set_scope(None)
-        wall = time.perf_counter() - (rec.t_start or rec.t_submit)
+        now = time.perf_counter()
+        telemetry.observe("serve_dispatch_seconds", now - t_disp0,
+                          tenant=rec.request.tenant)
+        wall = now - (rec.t_start or rec.t_submit)
         if err is not None or done:
             try:
                 rec.runner.cleanup()
             except Exception:
                 pass
+            # the end-to-end latency the p99 harness measures: admission
+            # to terminal, queue waits included (never just device time)
+            telemetry.observe("serve_job_latency_seconds",
+                              now - rec.t_submit,
+                              tenant=rec.request.tenant)
+            xferstats.bump("serve_jobs_finished", 1,
+                           tag="failed" if err is not None else "done")
+            # embed the job's tenant-tagged span stream into the history
+            # file so `python -m tuplex_tpu trace` replays serve jobs too
+            # (before the state flip: a waiter that sees DONE must find
+            # the rows already written)
+            if tracing.enabled():
+                evts = tracing.events_for_stream(rec.id)
+                r = self.recorder
+                if evts and r is not None and getattr(r, "enabled", False):
+                    try:
+                        r.serve_job_spans(rec.id, evts,
+                                          tenant=rec.request.tenant)
+                    except Exception:   # dashboard rows are advisory
+                        pass
             # snapshot the job's scoped counter family onto the record and
             # release the registry entry (a service that lives for
             # thousands of jobs must not keep one family per job)
@@ -317,6 +468,8 @@ class JobService:
                      rec.stats["turns"] + 1, wall)
         with self._cond:
             self._turn += 1
+            self._busy -= 1
+            self._last_turn_done_t = time.monotonic()
             rec.stats["turns"] += 1
             if rec.state == CANCELLED or self._stop:
                 # close() raced this turn: the job was already flipped to
@@ -344,6 +497,7 @@ class JobService:
                 # deficit-weighted RR: a tenant with weight w keeps the
                 # slot for w consecutive stage dispatches, then yields
                 rec.burst += 1
+                rec.t_enqueue = time.perf_counter()
                 if rec.burst < rec.weight:
                     self._ready.appendleft(rec)
                 else:
