@@ -47,6 +47,16 @@ HIGHER_BETTER = {
     "spread": False,
     "wall_s": False,
     "p50": False, "p95": False, "p99": False, "max": False, "mean": False,
+    # chaos harness keys (scripts/chaos_bench.py): fault-path latency
+    # gates like any other latency; recovery outcomes must not shrink
+    "baseline_wall_s": False,
+    "worst_over_baseline": False,    # chaos tax relative to no faults
+    "jobs_ok": True,
+    "jobs_failed_clean": None,       # informational (spec-dependent)
+    "retries": None,                 # informational (spec-dependent)
+    "compiles_killed": None,         # informational (spec-dependent)
+    "deadline_timeouts": None,
+    "crash_requeues": None,
 }
 
 

@@ -1,0 +1,325 @@
+#!/usr/bin/env python3
+"""Fault-injection harness: the zillow serve workload under chaos.
+
+The fault-tolerance layer's acceptance proof (runtime/faults +
+exec/compilequeue subprocess isolation + the serve retry ladder and
+journal recovery): run the SAME zillow pipeline through the job service
+while ``TUPLEX_FAULTS`` breaks a different plane each class, and assert
+the dual-mode contract holds at the CONTROL plane too — every submitted
+job terminates with correct results or a clean error, exactly once, and
+the service's health returns to ok without operator intervention.
+
+Fault classes:
+
+  baseline        no faults (the latency yardstick)
+  compile-hang    the first stage compile wedges (``compile:hang:once``);
+                  the forked compile child is SIGKILLed at
+                  tuplex.tpu.compileDeadlineS and the stage restarts on
+                  one degraded tier — results must still be correct
+  dispatch-flake  every third device dispatch raises
+                  (``dispatch:raise:p=0.34``); the partition retry ->
+                  degrade ladder absorbs it
+  serve-retry     a worker-loop step raises a transient fault
+                  (``serve:raise-step:once``); the job-level retry
+                  ladder requeues and completes the job
+  serve-crash     (full mode only) the serve PROCESS dies right after
+                  admitting a job (``serve:crash-after-admit:once``); a
+                  restarted process over the same root requeues it from
+                  the journal exactly once and completes it
+
+Each class reports wall seconds, jobs ok/failed, retries and compile
+kills, and the worst + final health state. The output is one BENCH-style
+JSON line ``scripts/bench_diff.py`` understands (dotted per-class keys;
+``wall_s``/latency leaf keys gate directionally), so fault-path latency
+regressions gate exactly like perf regressions:
+
+    python scripts/chaos_bench.py                  # all classes
+    python scripts/chaos_bench.py --smoke          # tier-1 CI variant
+                                                   # (in-process classes)
+    python scripts/chaos_bench.py --out CHAOS.json
+    python scripts/bench_diff.py CHAOS_old.json CHAOS.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))          # run from anywhere
+
+HEALTH_RANK = {"ok": 0, "degraded": 1, "unhealthy": 2}
+
+
+def _build_requests(ctx, csvs, tag):
+    from tuplex_tpu.models import zillow
+    from tuplex_tpu.serve import request_from_dataset
+
+    return [request_from_dataset(zillow.build_pipeline(ctx.csv(p)),
+                                 name=f"{tag}-j{i}", tenant=f"{tag}")
+            for i, p in enumerate(csvs)]
+
+
+def _set_faults(spec: str, state_dir: str, name: str):
+    from tuplex_tpu.runtime import faults
+
+    if spec:
+        os.environ["TUPLEX_FAULTS"] = spec
+        os.environ["TUPLEX_FAULTS_STATE"] = os.path.join(
+            state_dir, f"faults-{name}")
+    else:
+        os.environ.pop("TUPLEX_FAULTS", None)
+        os.environ.pop("TUPLEX_FAULTS_STATE", None)
+    faults.reset()
+
+
+def _run_thread_class(name, spec, ctx, csvs, want, state_dir,
+                      expect_ok=True, deadline=None):
+    """One in-process fault class: a JobService + wire loop on threads,
+    jobs submitted over the scratch-dir protocol, health polled live.
+    `deadline` overrides tuplex.tpu.compileDeadlineS for this class only
+    (the compile-hang class wants a tight one so the kill is fast; a
+    tight deadline on the OTHER classes would kill their genuine zillow
+    compiles and measure the wrong thing)."""
+    from tuplex_tpu.core.options import ContextOptions
+    from tuplex_tpu.exec import compilequeue as CQ
+    from tuplex_tpu.runtime import telemetry
+    from tuplex_tpu.serve import JobService
+    from tuplex_tpu.serve import client as WC
+
+    root = os.path.join(state_dir, f"root-{name}")
+    os.makedirs(root, exist_ok=True)
+    # per-class compile plane: a fresh AOT dir + cleared in-process
+    # stores, so the compile-hang class really compiles (a dedup/aot hit
+    # would dodge the injected wedge) and each class's stats are its own
+    os.environ["TUPLEX_AOT_CACHE"] = os.path.join(state_dir, f"aot-{name}")
+    CQ.clear()
+    CQ._TIMEOUTS.clear()
+    _set_faults(spec, state_dir, name)
+    opts = ContextOptions(ctx.options_store.to_dict())
+    if deadline is not None:
+        opts.set("tuplex.tpu.compileDeadlineS", deadline)
+    svc = JobService(opts)
+    t0 = time.perf_counter()
+    jids = [WC.submit(root, r) for r in _build_requests(ctx, csvs, name)]
+    loop = threading.Thread(
+        target=WC.service_loop, args=(root,),
+        kwargs=dict(service=svc, max_idle_s=3.0), daemon=True)
+    loop.start()
+    worst = "ok"
+    results = []
+
+    def watch_health(stop):
+        nonlocal worst
+        while not stop.wait(0.05):
+            st = telemetry.health()["state"] if telemetry.enabled() else "ok"
+            if HEALTH_RANK.get(st, 1) > HEALTH_RANK[worst]:
+                worst = st
+
+    stop = threading.Event()
+    w = threading.Thread(target=watch_health, args=(stop,), daemon=True)
+    w.start()
+    try:
+        for jid in jids:
+            results.append(WC.fetch(root, jid, timeout=600))
+    finally:
+        stop.set()
+        w.join(5)
+        open(os.path.join(root, "STOP"), "w").close()
+        loop.join(60)
+        final = telemetry.health()["state"] if telemetry.enabled() else "ok"
+        svc.close()
+        _set_faults("", state_dir, name)
+    wall = time.perf_counter() - t0
+    n_ok = sum(1 for r in results if r.get("ok"))
+    clean_fail = sum(1 for r in results
+                     if not r.get("ok") and r.get("error"))
+    assert n_ok + clean_fail == len(jids), \
+        f"{name}: {len(jids) - n_ok - clean_fail} job(s) vanished"
+    if expect_ok:
+        for r in results:
+            assert r.get("ok"), f"{name}: job failed: {r.get('error')}"
+            assert r["rows"] == want, f"{name}: wrong rows"
+    assert final == "ok", f"{name}: health did not return to ok ({final})"
+    retries = sum(len(r.get("attempts") or []) for r in results)
+    stats = CQ.snapshot()     # this class's own delta (cleared at start)
+    return {"wall_s": round(wall, 3), "jobs": len(jids), "jobs_ok": n_ok,
+            "jobs_failed_clean": clean_fail, "retries": retries,
+            "compiles_killed": stats.get("compiles_killed", 0),
+            "deadline_timeouts": stats.get("deadline_timeouts", 0),
+            "health_worst": worst, "health_final": final,
+            "fault": spec or "none"}
+
+
+def _run_crash_class(name, ctx, csvs, want, state_dir, conf_path):
+    """The serve-crash class needs a REAL process to kill: launch
+    `python -m tuplex_tpu serve`, let the injected crash take it down
+    after admission, restart it fault-free over the same root, and fetch
+    every job's exactly-once terminal response."""
+    from tuplex_tpu.serve import client as WC
+
+    root = os.path.join(state_dir, f"root-{name}")
+    os.makedirs(root, exist_ok=True)
+    t0 = time.perf_counter()
+    jids = [WC.submit(root, r) for r in _build_requests(ctx, csvs, name)]
+    base_env = {k: v for k, v in os.environ.items()
+                if k not in ("TUPLEX_FAULTS", "TUPLEX_FAULTS_STATE")}
+    base_env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base_env["PYTHONPATH"] = repo + os.pathsep + \
+        base_env.get("PYTHONPATH", "")
+    argv = [sys.executable, "-m", "tuplex_tpu", "serve", root,
+            "--conf", conf_path]
+    p1 = subprocess.run(
+        argv, env=dict(base_env,
+                       TUPLEX_FAULTS="serve:crash-after-admit:once"),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, timeout=600)
+    assert p1.returncode == 70, \
+        f"{name}: server did not crash as injected " \
+        f"(rc={p1.returncode}):\n{p1.stdout.decode()[-2000:]}"
+    p2 = subprocess.Popen(argv, env=base_env, stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT)
+    try:
+        results = [WC.fetch(root, jid, timeout=600) for jid in jids]
+    finally:
+        open(os.path.join(root, "STOP"), "w").close()
+        out2, _ = p2.communicate(timeout=120)
+    wall = time.perf_counter() - t0
+    for r in results:
+        assert r.get("ok"), f"{name}: job failed: {r.get('error')}"
+        assert r["rows"] == want, f"{name}: wrong rows"
+    requeues = 0
+    for jid in jids:
+        j = WC._read_journal(os.path.join(root, "inbox", jid))
+        assert j.get("state") == "done", (jid, j)
+        requeues += int(j.get("requeues", 0))
+    assert requeues >= 1, "no job was actually requeued from the journal"
+    # the restarted process's final metrics.prom drop carries its health
+    final = "ok"
+    try:
+        for line in open(os.path.join(root, "metrics.prom")):
+            if line.startswith("tuplex_health_state "):
+                final = {0: "ok", 1: "degraded",
+                         2: "unhealthy"}.get(int(float(line.split()[1])),
+                                             "unhealthy")
+    except OSError:
+        pass
+    assert final == "ok", f"{name}: restarted service health {final}"
+    return {"wall_s": round(wall, 3), "jobs": len(jids),
+            "jobs_ok": len(results), "jobs_failed_clean": 0,
+            "crash_requeues": requeues, "health_final": final,
+            "fault": "serve:crash-after-admit:once"}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="zillow serve workload under injected faults")
+    ap.add_argument("--jobs", type=int, default=3)
+    ap.add_argument("--rows", type=int, default=2000,
+                    help="zillow rows per job input")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 CI variant: tiny inputs, in-process "
+                         "classes only (the subprocess crash class has "
+                         "its own tier-1 test)")
+    ap.add_argument("--deadline", type=float, default=5.0,
+                    help="tuplex.tpu.compileDeadlineS for the "
+                         "compile-hang class (how long the wedge lives "
+                         "before the kill)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.jobs, args.rows = 2, 200
+
+    import tuplex_tpu
+    from tuplex_tpu.models import zillow
+
+    state_dir = tempfile.mkdtemp(prefix="tpx-chaos-")
+    try:
+        csvs = []
+        for i in range(args.jobs):
+            p = os.path.join(state_dir, f"zillow-{i}.csv")
+            if i == 0:
+                zillow.generate_csv(p, args.rows, seed=7)
+            else:
+                shutil.copy(csvs[0], p)
+            csvs.append(p)
+        want = zillow.run_reference_python(csvs[0])
+        conf = {
+            "tuplex.scratchDir": os.path.join(state_dir, "scratch"),
+            "tuplex.serve.retryBackoffS": 0.1,
+            "tuplex.serve.metricsPromS": 1,
+        }
+        conf_path = os.path.join(state_dir, "chaos-conf.json")
+        with open(conf_path, "w") as fp:
+            json.dump(conf, fp)
+        ctx = tuplex_tpu.Context(conf)
+
+        classes = {}
+        # full mode: only the compile-hang class runs under the tight
+        # deadline (the others measure the compiled fault paths). Smoke
+        # applies it everywhere: genuine zillow compiles then also die
+        # at the deadline and the drill runs in seconds — it checks the
+        # FAULT machinery end to end, not compiled-path latency.
+        dflt = args.deadline if args.smoke else None
+        plan = [
+            ("baseline", "", dflt),
+            ("compile-hang", "compile:hang:once", args.deadline),
+            ("dispatch-flake", "dispatch:raise:p=0.34", dflt),
+            ("serve-retry", "serve:raise-step:once", dflt),
+        ]
+        for name, spec, deadline in plan:
+            print(f"[chaos] class {name} ({spec or 'no faults'})",
+                  file=sys.stderr, flush=True)
+            classes[name] = _run_thread_class(
+                name, spec, ctx, csvs, want, state_dir,
+                deadline=deadline)
+        if not args.smoke:
+            print("[chaos] class serve-crash (subprocess)",
+                  file=sys.stderr, flush=True)
+            classes["serve-crash"] = _run_crash_class(
+                "serve-crash", ctx, csvs, want, state_dir, conf_path)
+
+        base = classes["baseline"]["wall_s"]
+        worst = max(v["wall_s"] for k, v in classes.items()
+                    if k != "baseline")
+        result = {
+            "metric": "chaos_zillow_worst_class_wall_s",
+            "value": worst,
+            "unit": "s",
+            "n_jobs": args.jobs,
+            "rows": args.rows,
+            "baseline_wall_s": base,
+            "worst_over_baseline": round(worst / base, 3) if base else 0.0,
+            "compiles_killed": sum(v.get("compiles_killed", 0)
+                                   for v in classes.values()),
+            "deadline_timeouts": sum(v.get("deadline_timeouts", 0)
+                                     for v in classes.values()),
+            "classes": classes,
+        }
+        ctx.close()
+    finally:
+        _set_faults("", state_dir, "teardown")
+        shutil.rmtree(state_dir, ignore_errors=True)
+    line = json.dumps(result)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as fp:
+            fp.write(line + "\n")
+    if args.smoke:
+        assert result["compiles_killed"] >= 1, \
+            "compile-hang class never killed a compile child"
+        assert classes["serve-retry"]["retries"] >= 1, \
+            "serve-retry class never retried"
+        print("chaos-bench OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

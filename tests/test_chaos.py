@@ -1,0 +1,78 @@
+"""Tier-1 wiring for the fault-injection harness: the chaos_bench smoke
+drill end to end in a fresh process, and the bench_diff gate over chaos
+output (fault-path latency regressions gate like perf regressions)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_chaos_bench_smoke():
+    """All smoke fault classes (compile hang -> killed child, dispatch
+    flake -> partition ladder, serve step fault -> retry ladder) deliver
+    correct results from every job and leave health at ok."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("TUPLEX_FAULTS", None)
+    env.pop("TUPLEX_FAULTS_STATE", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "chaos_bench.py"),
+         "--smoke", "--deadline", "2"],
+        capture_output=True, text=True, timeout=540, env=env, cwd=REPO)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    line = [ln for ln in r.stdout.splitlines() if ln.startswith("{")][-1]
+    result = json.loads(line)
+    assert result["metric"] == "chaos_zillow_worst_class_wall_s"
+    assert result["compiles_killed"] >= 1
+    classes = result["classes"]
+    assert set(classes) >= {"baseline", "compile-hang", "dispatch-flake",
+                            "serve-retry"}
+    for name, cls in classes.items():
+        assert cls["jobs_ok"] + cls["jobs_failed_clean"] == cls["jobs"], \
+            (name, cls)
+        assert cls["health_final"] == "ok", (name, cls)
+    assert classes["serve-retry"]["retries"] >= 1
+    assert "chaos-bench OK" in r.stderr
+
+
+def _chaos_result(wall_hang, wall_base):
+    return {"metric": "chaos_zillow_worst_class_wall_s",
+            "value": wall_hang, "unit": "s",
+            "baseline_wall_s": wall_base,
+            "worst_over_baseline": round(wall_hang / wall_base, 3),
+            "compiles_killed": 1,
+            "classes": {
+                "baseline": {"wall_s": wall_base, "jobs": 2, "jobs_ok": 2,
+                             "retries": 0},
+                "compile-hang": {"wall_s": wall_hang, "jobs": 2,
+                                 "jobs_ok": 2, "retries": 1},
+            }}
+
+
+def test_bench_diff_gates_chaos_latency_regressions(tmp_path):
+    """bench_diff understands the chaos harness output: a fault-path
+    latency regression (the compile-hang class got slower) fails the
+    gate; recovery-outcome keys compare informationally."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import bench_diff
+    finally:
+        sys.path.pop(0)
+    old = tmp_path / "old.json"
+    new_ok = tmp_path / "new_ok.json"
+    new_bad = tmp_path / "new_bad.json"
+    old.write_text(json.dumps(_chaos_result(10.0, 5.0)))
+    new_ok.write_text(json.dumps(_chaos_result(10.4, 5.1)))
+    new_bad.write_text(json.dumps(_chaos_result(14.0, 5.0)))
+    assert bench_diff.main([str(old), str(new_ok)]) == 0
+    assert bench_diff.main([str(old), str(new_bad)]) == 1
+    # the regression is attributed to the fault-path latency keys
+    flat_old, meta = bench_diff.load_result(str(old))
+    flat_bad, _ = bench_diff.load_result(str(new_bad))
+    rows, regs = bench_diff.compare(flat_old, flat_bad, 0.10, meta=meta)
+    assert "value" in regs and "classes.compile-hang.wall_s" in regs
+    assert "worst_over_baseline" in regs
+    # outcome keys are informational, never regressions by count alone
+    assert not any(r.startswith("compiles_killed") for r in regs)

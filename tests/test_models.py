@@ -61,6 +61,67 @@ def test_flights_pipeline(ctx, tmp_path):
                 assert a == b, (flights.OUTPUT_COLS[ci], a, b)
 
 
+@pytest.mark.slow
+def test_flights_airport_wedge_killed_and_degraded(tmp_path):
+    """Pin the flights airport build-side XLA:CPU wedge (ROADMAP item c:
+    3 ops, 2.2k eqns, >20 min / >120 GB at ANY batch size) as a repro
+    that now PASSES: with the default-on compile deadline tightened to
+    60 s, a wedging build-side compile is SIGKILLed in its forked child
+    and the stage degrades to one slower tier — the pipeline completes,
+    bounded, with reference-exact results, instead of hanging. On a jax
+    build whose XLA:CPU does not wedge, the compiles simply finish and
+    the same assertions hold on the compiled path."""
+    import time
+
+    import tuplex_tpu
+    from tuplex_tpu.exec import compilequeue as CQ
+    from tuplex_tpu.models import flights
+
+    perf = str(tmp_path / "flights.csv")
+    carrier = str(tmp_path / "carrier.csv")
+    airport = str(tmp_path / "airports.txt")
+    flights.generate_perf_csv(perf, 300, seed=2)
+    flights.generate_carrier_csv(carrier)
+    flights.generate_airport_db(airport)
+    ctx = tuplex_tpu.Context({
+        "tuplex.partitionSize": "256KB",
+        "tuplex.sample.maxDetectionRows": "64",
+        "tuplex.scratchDir": str(tmp_path / "scratch"),
+        "tuplex.tpu.compileDeadlineS": 60,
+    })
+    snap = CQ.snapshot()
+    t0 = time.time()
+    ds = flights.build_pipeline(ctx, perf, carrier, airport)
+    got = ds.collect()
+    wall = time.time() - t0
+    # the historical failure mode was a >20 min wedge; kill+degrade (or a
+    # healthy compile) must finish far inside that
+    assert wall < 900, f"flights collect took {wall:.0f}s — still wedged?"
+    want = flights.run_reference_python(perf, carrier, airport)
+    assert len(got) == len(want), (len(got), len(want))
+
+    def key(r):
+        i = flights.OUTPUT_COLS.index
+        return (r[i("CarrierCode")], r[i("FlightNumber")], r[i("Year")],
+                r[i("Month")], r[i("Day")], r[i("CrsDepTime")])
+
+    for g, w in zip(sorted(got, key=key), sorted(want, key=key)):
+        for ci, (a, b) in enumerate(zip(g, w)):
+            if isinstance(a, float) and isinstance(b, float):
+                assert abs(a - b) <= 1e-12 * max(1.0, abs(b)), \
+                    (flights.OUTPUT_COLS[ci], a, b)
+            else:
+                assert a == b, (flights.OUTPUT_COLS[ci], a, b)
+    d = CQ.delta(snap)
+    if d["deadline_timeouts"]:
+        # the wedge fired: every timed-out compile was KILLED (fork mode),
+        # nothing left burning for the health watchdog
+        if CQ.isolation_mode() == "fork":
+            assert d["compiles_killed"] >= 1
+        assert CQ.pending_info()["inflight"] == 0
+    ctx.close()
+
+
 def test_logs_strip_pipeline(ctx, tmp_path):
     from tuplex_tpu.models import logs
 

@@ -1,0 +1,331 @@
+"""Crash-safe serve recovery + the job-level retry ladder: journaled
+state transitions, exactly-once requeue over a restarted scratch root,
+poison-job clean failure, idempotent resubmission, torn-write-tolerant
+fetch, and the retry ladder's audit trail / backoff / telemetry."""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import tuplex_tpu
+from tuplex_tpu.runtime import faults
+from tuplex_tpu.serve import JobService, request_from_dataset
+from tuplex_tpu.serve import client as WC
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def plus7(x):
+    return x + 7
+
+
+def times5(x):
+    return x * 5
+
+
+@pytest.fixture()
+def clean_faults(tmp_path, monkeypatch):
+    monkeypatch.delenv("TUPLEX_FAULTS", raising=False)
+    monkeypatch.setenv("TUPLEX_FAULTS_STATE", str(tmp_path / "fstate"))
+    faults.reset()
+    yield monkeypatch
+    monkeypatch.delenv("TUPLEX_FAULTS", raising=False)
+    faults.reset()
+
+
+def _ctx(tmp_path, **extra):
+    conf = {"tuplex.scratchDir": str(tmp_path / "scratch"),
+            "tuplex.serve.retryBackoffS": 0.05}
+    conf.update(extra)
+    return tuplex_tpu.Context(conf)
+
+
+def _arm(monkeypatch, spec):
+    monkeypatch.setenv("TUPLEX_FAULTS", spec)
+    faults.reset()
+
+
+def _serve_thread(root, svc, max_idle_s=3.0):
+    t = threading.Thread(target=WC.service_loop, args=(root,),
+                        kwargs=dict(service=svc, max_idle_s=max_idle_s),
+                        daemon=True)
+    t.start()
+    return t
+
+
+# ---------------------------------------------------------------------------
+# retry ladder (satellite: every attempt visible, backoff, short-circuit,
+# counter exported)
+# ---------------------------------------------------------------------------
+
+def test_transient_failure_retried_to_success(tmp_path, clean_faults):
+    c = _ctx(tmp_path)
+    svc = c.job_service()
+    _arm(clean_faults, "serve:raise-step:once")
+    h = svc.submit(request_from_dataset(
+        c.parallelize([1, 2, 3]).map(plus7), name="r1", tenant="alice"))
+    assert h.wait(120) == "done", (h.state, h.error)
+    assert h.result() == [8, 9, 10]
+    atts = h.attempts()
+    assert len(atts) == 1, atts
+    assert atts[0]["attempt"] == 1 and atts[0]["transient"] \
+        and atts[0]["action"] == "retry"
+    assert h.stats["attempts"] == 1
+    # the attempt is in the tenant span stream too
+    evts = h.trace_events()
+    if evts:      # tracing may be disabled in this environment
+        assert any(e.get("name") == "serve:attempt-failed" for e in evts)
+    c.close()
+
+
+def test_every_attempt_recorded_and_backoff_respected(tmp_path,
+                                                      clean_faults):
+    c = _ctx(tmp_path, **{"tuplex.serve.retryBackoffS": 0.2,
+                          "tuplex.serve.retryCount": 3})
+    svc = c.job_service()
+    _arm(clean_faults, "serve:raise-step:n=2")
+    h = svc.submit(request_from_dataset(
+        c.parallelize([4]).map(plus7), name="r2"))
+    assert h.wait(180) == "done", (h.state, h.error)
+    atts = h.attempts()
+    assert [a["attempt"] for a in atts] == [1, 2]
+    assert [a["action"] for a in atts] == ["retry", "retry"]
+    # exponential backoff: attempt 1 waits ~0.2s, attempt 2 ~0.4s — the
+    # SECOND failure can only happen after the first backoff elapsed
+    assert atts[1]["t"] - atts[0]["t"] >= 0.18, atts
+    assert atts[0]["backoff_s"] == 0.2 and atts[1]["backoff_s"] == 0.4
+    c.close()
+
+
+def test_retry_resets_attempt_state_no_double_counting(tmp_path,
+                                                       clean_faults):
+    """A retry replays from stage 0 — the aborted attempt's stage
+    metrics and exception rows must NOT leak into the final response
+    (regression: rec.metrics/rec.exceptions survived the runner
+    rebuild and double-counted)."""
+    c = _ctx(tmp_path, **{"tuplex.tpu.maxStageOps": 1})
+    svc = c.job_service()
+
+    def build():
+        return c.parallelize([1, 2, 3]).map(plus7).map(times5)
+
+    # baseline: the same job with no fault — its stage-record count and
+    # exception count are what a retried job must ALSO end up with
+    h0 = svc.submit(request_from_dataset(build(), name="base"))
+    assert h0.wait(180) == "done", (h0.state, h0.error)
+    want_stages = len(h0.metrics.stages)
+    want_excs = len(h0.exceptions())
+    # fail at the SECOND worker step: stage 0 of attempt 1 has already
+    # recorded its metrics when the job is requeued
+    _arm(clean_faults, "serve:raise-step:after=1:once")
+    h = svc.submit(request_from_dataset(build(), name="noleak"))
+    assert h.wait(180) == "done", (h.state, h.error)
+    assert h.result() == [(x + 7) * 5 for x in [1, 2, 3]]
+    assert len(h.attempts()) == 1, h.attempts()
+    assert len(h.metrics.stages) == want_stages, \
+        (want_stages, h.metrics.stages)
+    assert len(h.exceptions()) == want_excs
+    c.close()
+
+
+def test_deterministic_failure_short_circuits(tmp_path, clean_faults):
+    c = _ctx(tmp_path)
+    svc = c.job_service()
+    _arm(clean_faults, "serve:raise-step:kind=det")
+    h = svc.submit(request_from_dataset(
+        c.parallelize([1]).map(plus7), name="det"))
+    assert h.wait(120) == "failed", (h.state, h.error)
+    atts = h.attempts()
+    assert len(atts) == 1 and atts[0]["action"] == "fail" \
+        and atts[0]["transient"] is False
+    assert "FaultInjected" in (h.error or "")
+    c.close()
+
+
+def test_retries_exhausted_fails_with_trail(tmp_path, clean_faults):
+    c = _ctx(tmp_path, **{"tuplex.serve.retryCount": 1})
+    svc = c.job_service()
+    _arm(clean_faults, "serve:raise-step")      # every step fails
+    h = svc.submit(request_from_dataset(
+        c.parallelize([1]).map(plus7), name="exhaust"))
+    assert h.wait(180) == "failed", (h.state, h.error)
+    atts = h.attempts()
+    assert [a["action"] for a in atts] == ["retry", "fail"]
+    c.close()
+
+
+def test_serve_job_retries_counter_exported(tmp_path, clean_faults):
+    from tuplex_tpu.runtime import telemetry
+
+    if not telemetry.enabled():
+        pytest.skip("telemetry disabled")
+    c = _ctx(tmp_path)
+    svc = c.job_service()
+    _arm(clean_faults, "serve:raise-step:once")
+    h = svc.submit(request_from_dataset(
+        c.parallelize([9]).map(plus7), name="cnt", tenant="bob"))
+    assert h.wait(120) == "done", (h.state, h.error)
+    text = telemetry.render_prometheus()
+    line = [ln for ln in text.splitlines()
+            if ln.startswith("tuplex_serve_job_retries")]
+    assert line, text[:1500]
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# journal + recovery over the scratch root
+# ---------------------------------------------------------------------------
+
+def test_journal_transitions_and_completed_results_survive_restart(
+        tmp_path, clean_faults):
+    c = _ctx(tmp_path)
+    svc = c.job_service()
+    root = str(tmp_path / "root")
+    req = request_from_dataset(
+        c.parallelize([5, 6]).map(plus7), name="w1",
+        scratch_dir=str(tmp_path / "scratch" / "wire"))
+    jid = WC.submit(root, req)
+    t = _serve_thread(root, svc)
+    resp = WC.fetch(root, jid, timeout=180)
+    t.join(60)
+    assert resp["ok"] and resp["rows"] == [12, 13]
+    jdir = os.path.join(root, "inbox", jid)
+    j = WC._read_journal(jdir)
+    assert j["state"] == "done" and j["requeues"] == 0, j
+    mtime = os.path.getmtime(os.path.join(jdir, "response.pkl"))
+    # restart over the same root: the finished job is NOT re-admitted,
+    # its response stays fetchable byte-for-byte
+    t2 = _serve_thread(root, svc, max_idle_s=1.0)
+    t2.join(60)
+    assert os.path.getmtime(os.path.join(jdir, "response.pkl")) == mtime
+    resp2 = WC.fetch(root, jid, timeout=10)
+    assert resp2["ok"] and resp2["rows"] == [12, 13]
+    c.close()
+
+
+def test_duplicate_submit_same_jid_is_idempotent(tmp_path, clean_faults):
+    c = _ctx(tmp_path)
+    root = str(tmp_path / "root")
+    req = request_from_dataset(c.parallelize([1]).map(plus7), name="dup",
+                               scratch_dir=str(tmp_path / "sw1"))
+    jid = WC.submit(root, req, jid="fixed-id-0001")
+    assert jid == "fixed-id-0001"
+    first = open(os.path.join(root, "inbox", jid, "request.pkl"),
+                 "rb").read()
+    req2 = request_from_dataset(c.parallelize([999]).map(plus7),
+                                name="dup2",
+                                scratch_dir=str(tmp_path / "sw2"))
+    assert WC.submit(root, req2, jid="fixed-id-0001") == jid
+    # the FIRST request stands untouched
+    assert open(os.path.join(root, "inbox", jid, "request.pkl"),
+                "rb").read() == first
+    c.close()
+
+
+def test_poison_job_fails_cleanly_after_crash_budget(tmp_path,
+                                                     clean_faults):
+    root = str(tmp_path / "root")
+    inbox = os.path.join(root, "inbox")
+    pdir = os.path.join(inbox, "poisonjob0001")
+    os.makedirs(pdir)
+    with open(os.path.join(pdir, "request.pkl"), "wb") as fp:
+        fp.write(b"never-read")
+    with open(os.path.join(pdir, "journal.json"), "w") as fp:
+        json.dump({"state": "running", "requeues": 2}, fp)
+    finished, requeued, failed = WC._recover_inbox(inbox, 2)
+    assert "poisonjob0001" in finished and failed == 1 and requeued == 0
+    resp = pickle.load(open(os.path.join(pdir, "response.pkl"), "rb"))
+    assert resp["ok"] is False and "crash" in resp["error"]
+    # under the budget: requeued, not failed
+    qdir = os.path.join(inbox, "requeueme0001")
+    os.makedirs(qdir)
+    with open(os.path.join(qdir, "journal.json"), "w") as fp:
+        json.dump({"state": "admitted", "requeues": 0}, fp)
+    finished, requeued, failed = WC._recover_inbox(inbox, 2)
+    assert requeued == 1 and "requeueme0001" not in finished
+    assert WC._read_journal(qdir)["requeues"] == 1
+
+
+def test_crash_mid_job_requeues_exactly_once(tmp_path, clean_faults):
+    """THE acceptance scenario: kill the serve process right after it
+    admits a job, restart it over the same scratch root, and the job
+    completes exactly once with correct results."""
+    root = str(tmp_path / "root")
+    os.makedirs(root)
+    c = _ctx(tmp_path)
+    data = list(range(50))
+    req = request_from_dataset(
+        c.parallelize(data).map(times5), name="crashy",
+        scratch_dir=str(tmp_path / "scratch" / "wire"))
+    jid = WC.submit(root, req)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               TUPLEX_FAULTS="serve:crash-after-admit:once")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    argv = [sys.executable, "-m", "tuplex_tpu", "serve", root]
+    p1 = subprocess.run(argv, env=env, stdout=subprocess.PIPE,
+                        stderr=subprocess.STDOUT, timeout=300)
+    assert p1.returncode == 70, p1.stdout.decode()[-2000:]
+    assert WC._read_journal(
+        os.path.join(root, "inbox", jid))["state"] == "admitted"
+    assert not os.path.exists(
+        os.path.join(root, "inbox", jid, "response.pkl"))
+    env2 = dict(env)
+    env2.pop("TUPLEX_FAULTS")
+    p2 = subprocess.Popen(argv, env=env2, stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT)
+    try:
+        resp = WC.fetch(root, jid, timeout=300)
+    finally:
+        with open(os.path.join(root, "STOP"), "w"):
+            pass
+        p2.communicate(timeout=120)
+    assert resp["ok"] and resp["rows"] == [x * 5 for x in data], \
+        str(resp)[:500]
+    j = WC._read_journal(os.path.join(root, "inbox", jid))
+    assert j["state"] == "done" and j["requeues"] == 1, j
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# fetch-side torn-write tolerance (satellite)
+# ---------------------------------------------------------------------------
+
+def test_fetch_ignores_torn_response_until_atomic_rename(tmp_path):
+    root = str(tmp_path / "root")
+    jdir = os.path.join(root, "inbox", "tornjob00001")
+    os.makedirs(jdir)
+    real = {"ok": True, "rows": [1, 2, 3]}
+    torn = pickle.dumps(real)[:7]           # a crashed writer's leftovers
+    with open(os.path.join(jdir, "response.pkl"), "wb") as fp:
+        fp.write(torn)
+    got = {}
+
+    def reader():
+        got["resp"] = WC.fetch(root, "tornjob00001", timeout=30,
+                               poll_s=0.02)
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    assert t.is_alive(), "fetch returned a torn response"
+    WC._atomic_write(os.path.join(jdir, "response.pkl"),
+                     pickle.dumps(real))
+    t.join(30)
+    assert got.get("resp") == real
+
+
+def test_fetch_times_out_with_torn_diagnosis(tmp_path):
+    root = str(tmp_path / "root")
+    jdir = os.path.join(root, "inbox", "tornforever0")
+    os.makedirs(jdir)
+    with open(os.path.join(jdir, "response.pkl"), "wb") as fp:
+        fp.write(b"\x80")                   # forever-partial pickle
+    with pytest.raises(TimeoutError) as ei:
+        WC.fetch(root, "tornforever0", timeout=0.5, poll_s=0.05)
+    assert "torn" in str(ei.value)

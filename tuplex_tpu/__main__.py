@@ -78,6 +78,14 @@ def main(argv=None) -> int:
                          "in <root>/metrics.port; default off — the "
                          "periodic <root>/metrics.prom drop happens "
                          "regardless; tuplex.serve.metricsPort)")
+    sv.add_argument("--retry-count", type=int, default=None,
+                    help="job-level retries for transient failures, and "
+                         "the crash-requeue budget for jobs recovered "
+                         "from a previous process's journal "
+                         "(tuplex.serve.retryCount)")
+    sv.add_argument("--retry-backoff", type=float, default=None,
+                    help="base seconds of the exponential retry backoff "
+                         "(tuplex.serve.retryBackoffS)")
     sub.add_parser("version", help="print the package version")
     args = parser.parse_args(argv)
 
@@ -115,6 +123,10 @@ def main(argv=None) -> int:
             opts.set("tuplex.serve.queueDepth", args.queue_depth)
         if args.metrics_port is not None:
             opts.set("tuplex.serve.metricsPort", args.metrics_port)
+        if args.retry_count is not None:
+            opts.set("tuplex.serve.retryCount", args.retry_count)
+        if args.retry_backoff is not None:
+            opts.set("tuplex.serve.retryBackoffS", args.retry_backoff)
         try:
             n = service_loop(args.root, opts)
         except KeyboardInterrupt:

@@ -109,6 +109,33 @@ DEFAULTS: dict[str, str] = {
                                             # long-lived service stays
                                             # bounded (held JobHandles keep
                                             # their own record alive)
+    "tuplex.serve.retryCount": "2",         # job-level retry ladder: a job
+                                            # whose failure classifies as
+                                            # TRANSIENT (device/dispatch
+                                            # runtime errors, compile
+                                            # deadline, injected transient
+                                            # faults) is requeued up to
+                                            # this many times from stage 0;
+                                            # deterministic failures (user
+                                            # code, bad requests) short-
+                                            # circuit with a clear error.
+                                            # Every attempt lands in the
+                                            # job record + tenant span
+                                            # stream + the
+                                            # serve_job_retries counter.
+                                            # The wire loop reuses it as
+                                            # the crash-requeue budget: a
+                                            # job that was in flight when
+                                            # the serve process died is
+                                            # requeued on restart until
+                                            # its requeue count exceeds
+                                            # this, then failed cleanly
+    "tuplex.serve.retryBackoffS": "0.5",    # base of the exponential
+                                            # retry backoff: attempt k
+                                            # waits retryBackoffS * 2^(k-1)
+                                            # seconds before requeueing
+                                            # (the slot is freed while it
+                                            # waits; 0 = immediate)
     "tuplex.serve.tenantWeights": "",       # "tenantA:2,tenantB:1" —
                                             # deficit-weighted round-robin:
                                             # weight w = w consecutive stage
@@ -156,14 +183,25 @@ DEFAULTS: dict[str, str] = {
                                             # tuner (plan/splittuner.py)
                                             # splits finer or degrades to a
                                             # host-CPU compile to stay under
-    "tuplex.tpu.compileDeadlineS": "0",     # hard wait ceiling per stage
-                                            # compile; on timeout the stage
-                                            # falls back to the interpreter
-                                            # and a content-addressed marker
-                                            # skips it in later processes.
-                                            # OPT-IN (0=off): abandoning a
-                                            # native compile risks teardown
-                                            # crashes (STATUS r7)
+    "tuplex.tpu.compileDeadlineS": "300",   # hard ceiling per stage
+                                            # compile, DEFAULT ON: the
+                                            # compile runs in a killable
+                                            # forked child (exec/
+                                            # compilequeue isolation_mode;
+                                            # TUPLEX_COMPILE_ISOLATION=
+                                            # thread reverts to the old
+                                            # abandon-on-a-thread wait) and
+                                            # a blown deadline SIGKILLs it,
+                                            # writes a content-addressed
+                                            # `.timeout` marker so later
+                                            # processes skip the wedge
+                                            # instantly, and degrades the
+                                            # WHOLE stage to one slower
+                                            # tier (host-CPU compile, else
+                                            # interpreter — never a
+                                            # mid-stage compiled/
+                                            # interpreted row split).
+                                            # 0 disables
     "tuplex.tpu.parallelCompile": "true",   # plan-level AOT compile pool
                                             # (exec/compilequeue.py);
                                             # TUPLEX_PARALLEL_COMPILE=0 also

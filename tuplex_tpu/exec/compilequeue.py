@@ -61,6 +61,8 @@ STATS: dict[str, Any] = {
     "aot_hits": 0, "aot_misses": 0, "aot_errors": 0,
     "dedup_hits": 0, "pool_jobs": 0, "traces": 0,
     "deadline_timeouts": 0, "deadline_skips": 0,
+    "subprocess_compiles": 0, "compiles_killed": 0,
+    "fork_deadlocks": 0,
 }
 
 _LOCK = threading.Lock()
@@ -85,10 +87,12 @@ def _mem_capacity() -> int:
 
 class CompileTimeout(Exception):
     """A stage compile exceeded the compile deadline (or a previous run's
-    marker says it did). The caller's first-call failure ladder routes the
-    stage to the interpreter — correct, just slower — instead of wedging
-    the job on a pathological XLA compile (observed: a 3-op / 2.2k-eqn
-    string stage that XLA:CPU chews >20 min and >120 GB on)."""
+    marker says it did). In fork-isolation mode the compile CHILD was
+    SIGKILLed — nothing keeps burning — and the caller degrades the
+    WHOLE stage to one slower tier (host-CPU compile or interpreter,
+    exec/local's tier ladder) instead of wedging the job on a
+    pathological XLA compile (observed: a 3-op / 2.2k-eqn string stage
+    that XLA:CPU chews >20 min and >120 GB on)."""
 
 
 _TIMEOUTS: set = set()               # fingerprints that timed out (process)
@@ -304,10 +308,12 @@ def _artifact_meta() -> dict:
             "jax": jax.__version__, "created": time.time()}
 
 
-def _disk_load(fp: str):
+def _disk_load(fp: str, path: Optional[str] = None):
     """Deserialize an AOT artifact, or None. A mismatched platform/jax
-    version is a miss (prune_stale() reclaims such files)."""
-    path = _artifact_path(fp)
+    version is a miss (prune_stale() reclaims such files). `path`
+    overrides the content-addressed location (the subprocess-compile
+    handback when no cache dir is configured)."""
+    path = path if path is not None else _artifact_path(fp)
     if path is None or not os.path.exists(path):
         return None
     import jax
@@ -324,8 +330,8 @@ def _disk_load(fp: str):
                                    rec["out_tree"])
 
 
-def _disk_store(fp: str, compiled) -> None:
-    path = _artifact_path(fp)
+def _disk_store(fp: str, compiled, path: Optional[str] = None) -> None:
+    path = path if path is not None else _artifact_path(fp)
     if path is None:
         return
     from jax.experimental import serialize_executable as se
@@ -379,8 +385,229 @@ def prune_stale(cache_dir: Optional[str] = None) -> int:
 
 def _compile_lowered(lowered):
     """The single expensive call — tests inject latency here to prove the
-    pool actually runs compiles concurrently."""
+    pool actually runs compiles concurrently, and the fault harness
+    (runtime/faults, TUPLEX_FAULTS="compile:...") injects hangs/raises
+    here to prove a wedged compile is killed rather than waited out. In
+    subprocess-isolation mode this body runs in the forked CHILD, so an
+    injected hang is wedged exactly where a real XLA wedge would be."""
+    from ..runtime import faults
+
+    faults.maybe("compile")
     return lowered.compile()
+
+
+# ---------------------------------------------------------------------------
+# subprocess compile isolation
+# ---------------------------------------------------------------------------
+# A deadline is only honest if blowing it KILLS the work: abandoning a
+# native XLA compile on a daemon thread leaves it burning CPU/RSS (the
+# flights airport build-side wedge: >20 min, >120 GB on 3 ops) and can
+# segfault interpreter teardown — which is why tuplex.tpu.compileDeadlineS
+# shipped default-off for four PRs. Deadline-bearing compiles therefore
+# run in a forked child: the parent traces and lowers (cheap, and the
+# fingerprint needs the trace anyway), forks, and the child does the one
+# expensive lowered.compile(), hands the executable back as a
+# serialized-PJRT artifact through the content-addressed on-disk store,
+# and _exits. A blown deadline SIGKILLs the child — the wedge dies WITH
+# it — and the parent raises CompileTimeout into the normal whole-stage
+# degrade ladder (exec/local: host-CPU compile or interpreter tier).
+#
+# Fork, not spawn: the lowered computation is not picklable (stage fns
+# close over live plan state), while a forked child inherits it for
+# free. The known risk — a lock held by another thread at fork time
+# deadlocking the child — is covered by the same deadline that covers a
+# real wedge: a deadlocked child is killed and the stage degrades.
+# `auto` mode forks only on the CPU backend (forking a process that owns
+# an accelerator client is undefined behavior in most PJRT plugins);
+# accelerator backends keep the abandon-on-a-thread fallback.
+
+_FORK_WARNED = False
+
+# Forking while another thread sits inside native code (a jax trace or
+# MLIR lower — both lock the shared MLIR context — an XLA compile, a
+# PJRT executable (de)serialize) snapshots that thread's held C++ locks
+# into the child, where no one will ever release them — the child
+# deadlocks in lowered.compile() and burns its whole deadline before the
+# kill (observed: a pool of 4 concurrent fork-compiles wedging one
+# child on a futex). The gate serializes every fork() and every
+# PARENT-side native phase of this module — trace, fingerprint (jaxpr
+# pretty-print + const fetch), lower, artifact (de)serialize — so the
+# fork snapshot is taken while compile-plane threads are only ever in
+# Python-level waits. The forked CHILD inherits the gate in the held
+# state and must never touch it (child code paths are gate-free).
+# Residual risk (a non-compile thread inside native code at fork time,
+# e.g. a serve dispatch executing a kernel) is covered by the deadline
+# itself — the deadlocked child is killed and the stage degrades, which
+# is the failure mode this layer exists to bound.
+_FORK_GATE = threading.Lock()
+
+
+def isolation_mode() -> str:
+    """'fork' | 'thread' (TUPLEX_COMPILE_ISOLATION=auto|fork|thread;
+    auto = fork on the CPU backend where os.fork exists)."""
+    mode = os.environ.get("TUPLEX_COMPILE_ISOLATION", "auto").lower()
+    if mode in ("thread", "0", "off", "none"):
+        return "thread"
+    if not hasattr(os, "fork"):
+        return "thread"
+    if mode == "fork":
+        return "fork"
+    try:
+        import jax
+
+        return "fork" if jax.default_backend() == "cpu" else "thread"
+    except Exception:   # pragma: no cover - no jax backend yet
+        return "thread"
+
+
+# A forked child that snapshotted a foreign thread's held native lock
+# deadlocks on a futex and STOPS accumulating cpu time (it may have
+# burned a few seconds first — compiles can deadlock mid-flight); a
+# genuinely wedged XLA compile (the thing the deadline exists for)
+# burns cpu continuously for minutes. The distinction is readable from
+# /proc/<pid>/stat, so the parent samples the child's cpu clock every
+# second and kills a child that makes NO cpu progress for a whole grace
+# window, then falls back to the in-thread compile — without writing a
+# `.timeout` marker, because the compile itself was never the problem.
+_DEADLOCK_GRACE_S = 5.0
+_DEADLOCK_CPU_S = 0.2           # minimum cpu-seconds that count as
+                                # progress between samples
+
+
+def _child_cpu_s(pid: int):
+    """The child's consumed cpu seconds (utime+stime), or None when
+    /proc isn't available (non-Linux)."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            fields = f.read().rsplit(") ", 1)[1].split()
+        return (int(fields[11]) + int(fields[12])) \
+            / os.sysconf("SC_CLK_TCK")
+    except Exception:
+        return None
+
+
+def _kill_child(pid: int) -> None:
+    import signal
+
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except OSError:     # already gone
+        pass
+    try:
+        os.waitpid(pid, 0)          # reap — no zombie per killed compile
+    except OSError:
+        pass
+
+
+def _compile_in_subprocess(fp: str, lowered, deadline_s: float,
+                           n_ops: int):
+    """Compile `lowered` in a killable forked child. Returns the compiled
+    executable (deserialized from the artifact the child stored), None if
+    the child failed for a non-deadline reason (caller falls back to the
+    in-thread compile so the real error surfaces), or raises
+    CompileTimeout after SIGKILLing a child that outlived the deadline."""
+    path = _artifact_path(fp)
+    ephemeral = None
+    if path is None:                 # no cache dir: scratch handback file
+        import tempfile
+
+        ephemeral = os.path.join(
+            tempfile.gettempdir(), f"tpx-aot-{os.getpid()}-{fp[:16]}.aot")
+        path = ephemeral
+    global _FORK_WARNED
+    if not _FORK_WARNED:
+        # jax warns on EVERY os.fork() from a threaded process; the
+        # deadline is precisely the mitigation for the deadlock it warns
+        # about (a deadlocked child is killed and the stage degrades), so
+        # silence the repeat — once per process, message-scoped
+        import warnings
+
+        warnings.filterwarnings(
+            "ignore", message=r".*os\.fork\(\) was called.*",
+            category=RuntimeWarning)
+        _FORK_WARNED = True
+    with _FORK_GATE:
+        t0 = time.perf_counter()   # deadline starts at the actual fork,
+        pid = os.fork()            # not at the gate queue
+    if pid == 0:
+        # the child inherits _FORK_GATE in the HELD state (the parent
+        # acquires it around fork()) — child code must never touch the
+        # gate or any gated helper; _compile_lowered and the explicit-
+        # path _disk_store below are gate-free by design
+        code = 1
+        try:
+            compiled = _compile_lowered(lowered)
+            _disk_store(fp, compiled, path=path)
+            code = 0
+        except BaseException:        # noqa: BLE001 - child reports via rc
+            code = 1
+        finally:
+            os._exit(code)           # no atexit/teardown in the child
+    try:
+        deadline = t0 + deadline_s if deadline_s and deadline_s > 0 \
+            else None
+        next_censor = t0 + _CENSOR_INTERVAL_S
+        next_cpu_check = t0 + 1.0
+        last_cpu = 0.0
+        last_progress_t = t0
+        while True:
+            done, status = os.waitpid(pid, os.WNOHANG)
+            if done:
+                break
+            now = time.perf_counter()
+            if (deadline is None or now < deadline) \
+                    and now >= next_cpu_check:
+                next_cpu_check = now + 1.0
+                cpu = _child_cpu_s(pid)
+                if cpu is not None:
+                    if cpu - last_cpu >= _DEADLOCK_CPU_S:
+                        last_cpu = cpu
+                        last_progress_t = now
+                    elif now - last_progress_t >= _DEADLOCK_GRACE_S:
+                        # cpu-stalled child = fork deadlock, not a
+                        # wedge: kill it early and let the caller
+                        # compile in-thread; no `.timeout` marker — the
+                        # compile was never at fault
+                        _kill_child(pid)
+                        with _LOCK:
+                            STATS["fork_deadlocks"] += 1
+                        return None
+            if deadline is not None and now >= deadline:
+                _kill_child(pid)
+                with _LOCK:
+                    STATS["deadline_timeouts"] += 1
+                    STATS["compiles_killed"] += 1
+                _note_deadline_exceeded(fp)
+                if n_ops > 0:
+                    try:    # a killed compile still teaches the tuner
+                        from ..plan.splittuner import model_for
+
+                        model_for().record_running(n_ops, now - t0)
+                    except Exception:
+                        pass
+                raise CompileTimeout(
+                    f"stage compile exceeded the {deadline_s:g}s "
+                    f"deadline ({fp[:12]}…); compile child killed")
+            if n_ops > 0 and now >= next_censor:
+                next_censor += _CENSOR_INTERVAL_S
+                try:        # censored lower-bound obs, like the watchdog
+                    from ..plan.splittuner import model_for
+
+                    model_for().record_running(n_ops, now - t0)
+                except Exception:
+                    pass
+            # fast compiles deserve a tight poll; long ones a cheap one
+            time.sleep(min(0.05, max(0.002, (now - t0) / 20.0)))
+        if not (os.WIFEXITED(status) and os.WEXITSTATUS(status) == 0):
+            return None
+        with _FORK_GATE:   # PJRT deserialize is native: see the gate
+            return _disk_load(fp, path=path)
+    finally:
+        if ephemeral is not None:
+            try:
+                os.remove(ephemeral)
+            except OSError:
+                pass
 
 
 _CENSOR_INTERVAL_S = 60.0
@@ -436,13 +663,15 @@ def _note_compile(tag: str, dt: float, n_ops: int) -> None:
 
 def default_deadline_s() -> float:
     """Hard ceiling on how long a dispatch will WAIT for one executable
-    (tuplex.tpu.compileDeadlineS carries it down from the backend; env
-    TUPLEX_COMPILE_DEADLINE_S for bare aot_jit users). Default 0 = OFF:
-    abandoning a native XLA compile leaves it burning on a daemon thread,
-    which can segfault interpreter teardown, and the interpreter-fallback
-    mix it forces mid-plan diverged on flights (observed; see STATUS r7) —
-    so the deadline is an explicit opt-in until compiles can be abandoned
-    in a subprocess."""
+    for callers that didn't pass one (tuplex.tpu.compileDeadlineS —
+    default ON at 300 s — carries it down from the backend; env
+    TUPLEX_COMPILE_DEADLINE_S for bare aot_jit users, default 0). The
+    deadline became safe to default on once deadline-bearing compiles
+    moved into a killable forked child (isolation_mode): a blown
+    deadline SIGKILLs the compile instead of abandoning a native thread,
+    and exec/local degrades the whole stage to ONE slower tier instead
+    of splitting rows across compiled/interpreted mid-stage (the
+    divergence that kept the old default off)."""
     try:
         return float(os.environ.get("TUPLEX_COMPILE_DEADLINE_S", "0"))
     except ValueError:
@@ -477,11 +706,13 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
     # backend's first-call demotion ladder depends on that
     with TR.span("compile:trace", "compile") as _sp:
         _sp.set("tag", tag[:16])
-        traced = trace_m(*args)
+        with _FORK_GATE:   # traces take the shared MLIR/C++ context
+            traced = trace_m(*args)   # locks a fork must not snapshot
     with _LOCK:
         STATS["traces"] += 1
     try:
-        fp = fingerprint_traced(traced, salt=salt + f"/don{donate}")
+        with _FORK_GATE:   # jaxpr pretty-print + const fetch: native too
+            fp = fingerprint_traced(traced, salt=salt + f"/don{donate}")
     except Exception:
         # content addressing unavailable for this trace (e.g. a const
         # that can't be fetched/hashed): compile without caching — still
@@ -490,7 +721,9 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
         with TR.span("compile:xla", "compile") as _sp:
             _sp.set("tag", tag[:16]).set("n_ops", n_ops) \
                .set("cache", "unaddressable")
-            compiled = _compile_with_watchdog(traced.lower(), n_ops)
+            with _FORK_GATE:               # native lower: see the gate
+                lowered = traced.lower()
+            compiled = _compile_with_watchdog(lowered, n_ops)
         _note_compile(tag, time.perf_counter() - t0, n_ops)
         return compiled
 
@@ -542,7 +775,8 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
         t0 = time.perf_counter()
         with TR.span("compile:lower", "compile") as _sp:
             _sp.set("tag", tag[:16])
-            lowered = traced.lower()
+            with _FORK_GATE:       # lowers are native code: see the gate
+                lowered = traced.lower()
         with TR.span("compile:xla", "compile") as _sp:
             _sp.set("tag", tag[:16]).set("n_ops", n_ops) \
                .set("cache", "miss").set("fp", fp[:12])
@@ -550,7 +784,8 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
         _note_compile(tag, time.perf_counter() - t0, n_ops)
         if aot_cache_enabled():
             try:
-                _disk_store(fp, compiled)
+                with _FORK_GATE:   # native serialize: see the gate
+                    _disk_store(fp, compiled)
             except Exception:   # pragma: no cover - disk best-effort
                 with _LOCK:
                     STATS["aot_errors"] += 1
@@ -562,7 +797,8 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
             try:
                 with TR.span("compile:aot-load", "compile") as _sp:
                     _sp.set("tag", tag[:16]).set("fp", fp[:12])
-                    compiled = _disk_load(fp)
+                    with _FORK_GATE:   # native deserialize: see the gate
+                        compiled = _disk_load(fp)
                     _sp.set("cache",
                             "aot-hit" if compiled is not None else "miss")
             except Exception:
@@ -593,30 +829,60 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
                 f"compile of {fp[:12]}… previously exceeded the deadline")
         if compiled is None:
             if deadline_s and deadline_s > 0:
-                # dedicated daemon thread (NOT the pool: a pool worker
-                # waiting on a nested pool job can deadlock the pool) so
-                # a pathological XLA compile can be abandoned — it keeps
-                # burning in background and publishes if it ever finishes,
-                # but the job moves on (interpreter) at the deadline
-                cfut: Future = Future()
+                if isolation_mode() == "fork":
+                    # killable child: compile in a forked subprocess and
+                    # hand the executable back through the on-disk
+                    # artifact store; a blown deadline SIGKILLs the child
+                    # (raising CompileTimeout from the helper) instead of
+                    # abandoning a native thread
+                    with TR.span("compile:lower", "compile") as _sp:
+                        _sp.set("tag", tag[:16])
+                        with _FORK_GATE:   # native lower: see the gate
+                            lowered = traced.lower()
+                    t0 = time.perf_counter()
+                    with TR.span("compile:xla", "compile") as _sp:
+                        _sp.set("tag", tag[:16]).set("n_ops", n_ops) \
+                           .set("cache", "miss").set("fp", fp[:12]) \
+                           .set("isolation", "subprocess")
+                        compiled = _compile_in_subprocess(
+                            fp, lowered, deadline_s, n_ops)
+                    if compiled is not None:
+                        _note_compile(tag, time.perf_counter() - t0,
+                                      n_ops)
+                        with _LOCK:
+                            STATS["subprocess_compiles"] += 1
+                        _publish(compiled)
+                    # compiled None: the child died for a NON-deadline
+                    # reason — fall through to the in-thread compile so
+                    # the genuine error (an XLA rejection, a serializer
+                    # gap) propagates exactly as it always did
+                if compiled is None:
+                    # abandon-on-a-thread fallback (no fork / accelerator
+                    # backend / child failure): dedicated daemon thread
+                    # (NOT the pool: a pool worker waiting on a nested
+                    # pool job can deadlock the pool). A wedged compile
+                    # keeps burning in background and publishes if it
+                    # ever finishes, but the job moves on at the deadline
+                    cfut: Future = Future()
 
-                def _runner():
+                    def _runner():
+                        try:
+                            cfut.set_result(_compile_job())
+                        except BaseException as e:  # noqa: BLE001
+                            cfut.set_exception(e)
+
+                    threading.Thread(target=_runner, daemon=True,
+                                     name="tpx-compile-deadline").start()
                     try:
-                        cfut.set_result(_compile_job())
-                    except BaseException as e:  # noqa: BLE001
-                        cfut.set_exception(e)
-
-                threading.Thread(target=_runner, daemon=True,
-                                 name="tpx-compile-deadline").start()
-                try:
-                    compiled = cfut.result(timeout=deadline_s)
-                except FutureTimeout:
-                    _note_deadline_exceeded(fp)
-                    with _LOCK:
-                        STATS["deadline_timeouts"] += 1
-                    raise CompileTimeout(
-                        f"stage compile exceeded the {deadline_s:.0f}s "
-                        f"deadline ({fp[:12]}…); falling back") from None
+                        compiled = cfut.result(timeout=deadline_s)
+                    except FutureTimeout:
+                        _note_deadline_exceeded(fp)
+                        with _LOCK:
+                            STATS["deadline_timeouts"] += 1
+                        raise CompileTimeout(
+                            f"stage compile exceeded the "
+                            f"{deadline_s:.0f}s deadline ({fp[:12]}…); "
+                            f"falling back") from None
             else:
                 compiled = _compile_job()
         with _LOCK:
@@ -688,6 +954,17 @@ def _args_avals(args: tuple):
 _FALLBACK = object()
 
 
+def deserialize_defect(e: BaseException) -> bool:
+    """A deserialized PJRT executable that LOADED but cannot RUN — the
+    known XLA:CPU gap where serialized executables of some fused kernels
+    lose their jit-compiled symbol library ("Symbols not found: ...").
+    Callers pin the affected spec to a plain in-process jit: correct,
+    compiled, and — when the artifact came from the fork-isolation
+    handback — safe to compile inline, because the killed-or-finished
+    child already proved this compile terminates within the deadline."""
+    return "Symbols not found" in str(e)
+
+
 class AotJit:
     """Drop-in for ``jax.jit(fn)`` that routes per-input-spec compilation
     through the content-addressed store: dispatch never compiles an
@@ -739,6 +1016,14 @@ class AotJit:
         except TypeError:
             # call-convention mismatch (aval/weak-type drift): pin this
             # spec to the plain jit, which retraces with jit's own rules
+            self._by_spec[key] = _FALLBACK
+            return self._plain()(*args)
+        except Exception as e:
+            if not deserialize_defect(e):
+                raise
+            # unloadable serialized executable (see deserialize_defect):
+            # recompile this spec in-process via the plain jit instead of
+            # demoting the stage to the interpreter
             self._by_spec[key] = _FALLBACK
             return self._plain()(*args)
 

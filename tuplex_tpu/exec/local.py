@@ -29,7 +29,9 @@ from ..core.errors import (ExceptionCode, NotCompilable, TuplexException,
 from ..core.row import Row
 from ..plan import logical as L
 from ..plan.physical import TransformStage
+from .compilequeue import CompileTimeout
 from ..runtime import columns as C
+from ..runtime import faults
 from ..runtime import tracing as TR
 from ..runtime import xferstats
 from ..runtime.packing import PackedOuts, PackedStageFn
@@ -81,18 +83,24 @@ class _CpuJit:
     Any AOT-machinery failure falls back to the plain pinned jit; trace
     errors (NotCompilable) propagate either way."""
 
-    def __init__(self, fn, tag: str = "", n_ops: int = 0):
+    def __init__(self, fn, tag: str = "", n_ops: int = 0,
+                 deadline: float = 0.0):
         import jax
 
         self._raw = fn
         self._tag = tag
         self._n_ops = n_ops
+        self._deadline = deadline or 0.0
         self._fn = jax.jit(fn)
         self._by_spec: dict = {}
 
     def _queue_entry(self, args):
         """(compiled-or-None, spec key) via the compile queue; None routes
-        the call to the plain pinned jit. Must run inside the cpu pin."""
+        the call to the plain pinned jit. Must run inside the cpu pin.
+        With a deadline set, CompileTimeout PROPAGATES — the host-CPU
+        compile is itself killable (the flights wedge IS an XLA:CPU
+        compile), and swallowing it into the unbounded plain jit would
+        reintroduce the exact hang the deadline exists to kill."""
         from . import compilequeue as CQ
 
         try:
@@ -106,8 +114,12 @@ class _CpuJit:
         try:
             entry = CQ.compile_traced(self._raw, avals, salt="/cpupin",
                                       tag=self._tag, n_ops=self._n_ops,
-                                      deadline_s=0.0)
-        except (CQ._AotUnsupported, CQ.CompileTimeout):
+                                      deadline_s=self._deadline)
+        except CQ._AotUnsupported:
+            entry = None
+        except CQ.CompileTimeout:
+            if self._deadline > 0:
+                raise
             entry = None
         self._by_spec[key] = entry
         return entry, key
@@ -128,6 +140,15 @@ class _CpuJit:
                     except TypeError:
                         # call-convention mismatch (weak-type drift): pin
                         # this spec to the plain jit like AotJit does
+                        self._by_spec[key] = None
+                    except Exception as e:
+                        from . import compilequeue as CQ
+
+                        if not CQ.deserialize_defect(e):
+                            raise
+                        # unloadable serialized executable: recompile
+                        # in-process via the plain pinned jit (AotJit's
+                        # fallback, under the cpu pin)
                         self._by_spec[key] = None
             return self._fn(*args, **kwargs)
 
@@ -151,6 +172,30 @@ class _DispatchFailed:
 
     def __init__(self, err: Exception):
         self.err = err
+
+
+class _CompileTimedOut:
+    """Sentinel riding the dispatch window when the stage executable's
+    compile blew the deadline (killed child / negative-cache skip). NOT a
+    task failure: per-partition retries can't help — the collect side
+    restarts the WHOLE stage on one degraded tier (_TierRestart) so rows
+    are never split across compiled/interpreted tiers mid-stage (the
+    flights divergence, ROADMAP item b)."""
+
+    def __init__(self, err: Exception):
+        self.err = err
+
+
+class _TierRestart(Exception):
+    """Control flow: re-run the current stage from its first partition on
+    `tier` ('cpu' = host-pinned compile, 'interpreter'). Raised by the
+    windowed executor's collect side on a _CompileTimedOut sentinel and
+    caught by _execute_windowed's tier loop — never escapes the stage."""
+
+    def __init__(self, tier: str, cause: Exception):
+        super().__init__(tier)
+        self.tier = tier
+        self.cause = cause
 
 
 @dataclass
@@ -429,16 +474,85 @@ class LocalBackend:
         LocalBackend.cc:1531-1586). Device dispatch is ASYNC — while the
         device crunches partition i, the host stages partition i+1 and
         merges partition i-1; `partitions` may be a lazy iterator, so
-        take(n) stops pulling source data once the limit is satisfied."""
+        take(n) stops pulling source data once the limit is satisfied.
+
+        This wrapper is the TIER loop: a stage whose executable compile
+        blows the deadline (killed compile child, `.timeout` negative
+        cache) is restarted FROM ITS FIRST PARTITION on one degraded
+        tier — host-CPU compile where that's a distinct backend, else
+        interpreter — because results already emitted on the compiled
+        tier must not be merged with later rows from a different tier
+        (the mixed compiled/interpreted divergence observed on flights,
+        ROADMAP item b). Every pulled partition is recorded so the
+        replay sees exactly the same input; the few duplicated dispatch
+        seconds are the price of tier purity."""
+        from itertools import chain
+
+        parts_it = iter(partitions)
+        first_part = next(parts_it, None)
+
+        def parts_stream():
+            if first_part is not None:
+                yield first_part
+            yield from parts_it
+
+        prefetch = max(0, self.options.get_int(
+            "tuplex.tpu.sourcePrefetch", 2))
+        live = _prefetch_iter(parts_stream(), prefetch) if prefetch \
+            else parts_stream()
+        seen: list = []
+        # replay retention costs O(input) partition references (spilled,
+        # not resident, under memory pressure — but still disk): only pay
+        # it where a CompileTimeout can actually happen. With the
+        # deadline disabled (or interpret-only) the restart is
+        # unreachable and streaming retention stays O(window).
+        record_replay = not self.interpret_only and self.options.get_float(
+            "tuplex.tpu.compileDeadlineS", 0.0) > 0
+
+        def recording():
+            for p in live:
+                if record_replay:
+                    seen.append(p)
+                yield p
+
+        rec = recording()
+        tier = "device"
+        restarts = 0
+        while True:
+            stream = chain(list(seen), rec) if restarts else rec
+            try:
+                res = self._run_stage_tier(stage, stream, first_part,
+                                           intermediate, tier)
+                res.metrics["tier_restarts"] = restarts
+                return res
+            except _TierRestart as tr:
+                restarts += 1
+                # a degraded tier timing out again steps down once more;
+                # the cap is belt-and-braces (the ladder is 3 rungs)
+                tier = "interpreter" if restarts >= 3 else tr.tier
+                from ..utils.logging import get_logger
+
+                get_logger("exec").warning(
+                    "stage %s compile deadline (%s); restarting the "
+                    "whole stage on the %s tier (restart %d)",
+                    stage.key()[:12], tr.cause, tier, restarts)
+
+    def _run_stage_tier(self, stage: TransformStage, stream, first_part,
+                        intermediate, tier: str) -> StageResult:
+        """One tier attempt of the windowed executor. `tier` is 'device'
+        (normal: accelerator/packed compile), 'cpu' (host-pinned compile
+        after a device-tier deadline) or 'interpreter' (no compiled fast
+        path at all). Raises _TierRestart when a compile deadline means
+        the stage must re-run one rung down."""
         from collections import deque
+
+        from . import compilequeue as CQ
 
         t0 = time.perf_counter()
         mm_snap = self.mm.metrics_snapshot()
         fl_snap = len(self.failure_log)
         metrics: dict[str, Any] = {"fast_path_s": 0.0, "slow_path_s": 0.0,
                                    "general_path_s": 0.0, "compile_s": 0.0}
-        parts_it = iter(partitions)
-        first_part = next(parts_it, None)
         device_fn = None
         in_schema = first_part.schema if first_part is not None else None
         skey = stage.key() + "/" + (in_schema.name if in_schema else "") \
@@ -459,10 +573,12 @@ class LocalBackend:
             from ..runtime.jaxcfg import device_handoff_enabled as _dh
 
             packed = not _dh(consumer)
-        if not self.interpret_only and skey not in self._not_compilable \
+        if tier != "interpreter" and not self.interpret_only \
+                and skey not in self._not_compilable \
                 and in_schema is not None:
             device_fn, use_comp = self._build_stage_fn(
-                stage, in_schema, skey, use_comp, packed=packed)
+                stage, in_schema, skey, use_comp, packed=packed,
+                force_cpu=(tier == "cpu"))
 
         out_parts: list[C.Partition] = []
         exceptions: list[ExceptionRecord] = []
@@ -488,6 +604,15 @@ class LocalBackend:
             part, outs, dispatch_s = window.popleft()
             if limit >= 0 and emitted_total >= limit:
                 return  # limit met: drop already-dispatched work unprocessed
+            if isinstance(outs, _DispatchFailed) \
+                    and isinstance(outs.err, CQ.CompileTimeout):
+                outs = _CompileTimedOut(outs.err)
+            if isinstance(outs, _CompileTimedOut):
+                # a blown compile deadline is NOT a task failure: retrying
+                # the partition would re-burn the deadline and a per-
+                # partition interpreter fallback would split the stage's
+                # rows across tiers — restart the whole stage one rung down
+                raise _TierRestart(self._next_tier(tier), outs.err)
             # registering a previous output may have spilled this partition
             # in the dispatch->collect gap; touch swaps it back in and the
             # pin keeps it resident against concurrent prefetch mm calls
@@ -612,27 +737,19 @@ class LocalBackend:
                 except Exception:
                     pass
 
-        def parts_stream():
-            if first_part is not None:
-                yield first_part
-            yield from parts_it
-
-        prefetch = max(0, self.options.get_int(
-            "tuplex.tpu.sourcePrefetch", 2))
-        stream = _prefetch_iter(parts_stream(), prefetch) if prefetch \
-            else parts_stream()
         for part in stream:
             check_interrupted()
             if limit >= 0 and emitted_total >= limit:
                 break
-            if skey in self._not_compilable:
+            if skey in self._not_compilable or tier == "interpreter":
                 device_fn = None
             elif use_comp and stage.key() in self._compaction_off:
                 # an earlier partition overflowed (or failed to trace) under
                 # compaction: rebuild the plain fn instead of paying the
                 # dispatch-then-redo cost for every remaining partition
                 device_fn, use_comp = self._build_stage_fn(
-                    stage, in_schema, skey, False, packed=packed)
+                    stage, in_schema, skey, False, packed=packed,
+                    force_cpu=(tier == "cpu"))
             self.mm.touch(part)
             try:
                 window.append(self._dispatch_partition(part, device_fn,
@@ -658,6 +775,11 @@ class LocalBackend:
         cs, cn = _cq.consume_tag(stage.key())
         metrics["compile_s"] += cs
         metrics["stage_compiles"] = cn
+        # which tier this stage's rows ALL ran on (tier purity is the
+        # contract the deadline-degrade restart enforces); task-failure
+        # fallbacks within the ladder still show up in failure_log
+        metrics["tier"] = {"device": "compiled", "cpu": "cpu-compiled",
+                           "interpreter": "interpreter"}[tier]
         metrics["wall_s"] = time.perf_counter() - t0
         metrics["rows_out"] = emitted_total
         metrics["exception_rows"] = len(exceptions)
@@ -828,12 +950,30 @@ class LocalBackend:
         return None
 
     # ------------------------------------------------------------------
+    def _next_tier(self, tier: str) -> str:
+        """One rung down the stage-tier ladder after a compile deadline:
+        device-compiled -> host-CPU-compiled (only where the host CPU is
+        a DISTINCT backend — on a CPU default backend the same XLA:CPU
+        compile would wedge again) -> interpreter."""
+        if tier == "device" and type(self) is LocalBackend \
+                and _cpu_device() is not None:
+            from ..runtime.jaxcfg import jax as _jax
+
+            if _jax.default_backend() != "cpu":
+                return "cpu"
+        return "interpreter"
+
+    # ------------------------------------------------------------------
     def _build_stage_fn(self, stage, in_schema, skey: str, use_comp: bool,
-                        packed: bool = True):
+                        packed: bool = True, force_cpu: bool = False):
         """Build + jit the fast-path fn. A build failure under compaction
         retries without it (an opt-in optimization must never demote the
-        stage to the interpreter); only a plain build failure does that."""
-        cpu_pin = getattr(stage, "cpu_compile", False) and \
+        stage to the interpreter); only a plain build failure does that.
+        ``force_cpu`` is the deadline-degrade 'cpu' tier: pin the compile
+        to the host CPU backend regardless of the stage's plan-time
+        ``cpu_compile`` flag (same mechanism as the split tuner's
+        compile-budget degrade)."""
+        cpu_pin = (force_cpu or getattr(stage, "cpu_compile", False)) and \
             _cpu_device() is not None
         if cpu_pin:
             from ..runtime.jaxcfg import jax as _jax
@@ -845,18 +985,24 @@ class LocalBackend:
                     in_schema, compaction=use_comp,
                     fused_fold=self.supports_fused_fold)
                 if cpu_pin:
-                    # compile-budget degrade (plan/splittuner): the stage's
-                    # predicted accelerator compile blows the budget, so it
-                    # compiles on the host CPU backend instead — device
-                    # transfers still happen at the stage boundary, only
-                    # the compute stays host-side. _CpuJit routes the
-                    # compile through compilequeue.compile_traced (traced
-                    # under the cpu pin), so it is counted into the
-                    # stage's compile_s/stage_compiles, cached and reused.
+                    # compile-budget degrade (plan/splittuner) or the
+                    # deadline-degrade 'cpu' tier: the stage compiles on
+                    # the host CPU backend instead — device transfers
+                    # still happen at the stage boundary, only the
+                    # compute stays host-side. _CpuJit routes the compile
+                    # through compilequeue.compile_traced (traced under
+                    # the cpu pin), so it is counted into the stage's
+                    # compile_s/stage_compiles, cached, reused — and
+                    # still deadline-bounded (an XLA:CPU compile can
+                    # wedge too; CompileTimeout propagates to the tier
+                    # ladder's next rung).
+                    deadline = self.options.get_float(
+                        "tuplex.tpu.compileDeadlineS", 0.0)
                     return self.jit_cache.get_or_build(
                         ("stagefn", skey, use_comp, "cpupin"),
                         lambda: _CpuJit(raw_fn, tag=stage.key(),
-                                        n_ops=len(stage.ops))), use_comp
+                                        n_ops=len(stage.ops),
+                                        deadline=deadline)), use_comp
                 return self.jit_cache.get_or_build(
                     ("stagefn", skey, use_comp, packed),
                     lambda: self._jit_stage_fn(raw_fn, packed=packed,
@@ -891,6 +1037,9 @@ class LocalBackend:
         Returns (part, pending_outs | None, dispatch_seconds)."""
         if device_fn is None or part.n_normal() == 0:
             return (part, None, 0.0)
+        faults.maybe("dispatch")   # chaos checkpoint (runtime/faults): a
+        # raise here rides the window as _DispatchFailed into the same
+        # retry -> degrade ladder a real device failure takes
         t0 = time.perf_counter()
         with TR.span("partition:dispatch", "exec") as _sp:
             _sp.set("rows", part.num_rows).set("start", part.start_index)
@@ -955,6 +1104,12 @@ class LocalBackend:
                                               packed=packed)
             self._not_compilable.add(skey)
             return (part, None, time.perf_counter() - t0)
+        except CompileTimeout as e:
+            # the executable's compile was killed at the deadline (or the
+            # `.timeout` negative cache skipped it): NOT a per-partition
+            # problem — ride the window as a sentinel so the collect side
+            # restarts the WHOLE stage on one degraded tier
+            return (part, _CompileTimedOut(e), time.perf_counter() - t0)
         except Exception as e:
             if not first_call:
                 raise  # executed before: a real runtime failure

@@ -1,0 +1,259 @@
+"""Deterministic fault injection for the compile and serve planes.
+
+The data plane's robustness story (rows that deviate degrade, never kill
+the job) is testable because exceptions are injectable — just feed bad
+rows. The CONTROL plane's story (a wedged compile is killed, a crashed
+serve process recovers) has no such natural lever, so this module is it:
+a ``TUPLEX_FAULTS`` spec names injection points wired through
+exec/compilequeue (the one expensive compile call), exec/local (the
+per-partition dispatch) and the serve worker/wire loops, and
+``scripts/chaos_bench.py`` drives the zillow serve workload under each
+fault class asserting every job still terminates with correct results or
+a clean error.
+
+Spec grammar (comma- or semicolon-separated clauses)::
+
+    TUPLEX_FAULTS="compile:hang:p=1:once,dispatch:raise:p=0.3"
+    TUPLEX_FAULTS="serve:crash-after-admit"
+    TUPLEX_FAULTS="serve:raise-step:kind=det:once"
+
+    clause  := site ":" action [":" param]*
+    site    := compile | dispatch | serve | <any maybe() site>
+    action  := hang | raise | crash  [ "-" point ]
+    param   := p=<float 0..1>   fire probability        (default 1)
+             | once             at most one firing      (= n=1)
+             | n=<int>          at most n firings
+             | after=<int>      skip the first n eligible calls
+             | delay=<seconds>  hang duration           (default 3600)
+             | kind=det|transient   FaultInjected classification
+                                (default transient — the serve retry
+                                ladder retries it; det short-circuits)
+
+The optional ``-point`` suffix on the action scopes a clause to one
+named checkpoint of a site — ``serve:crash-after-admit`` fires only at
+the wire loop's ``maybe("serve", point="after-admit")`` — while a bare
+action matches every checkpoint of its site.
+
+Semantics:
+
+* **hang** sleeps ``delay`` seconds (default 3600) — inside the forked
+  compile child this is exactly a wedged XLA compile: the parent's
+  deadline SIGKILLs it.
+* **raise** raises :class:`FaultInjected` (``transient`` attr per
+  ``kind``) — exercises the dispatch retry ladder and the serve job
+  retry ladder.
+* **crash** calls ``os._exit(70)`` — the serve-process crash the journal
+  recovery must survive.
+
+Counting (``once``/``n``/``after``/the probability stream) is
+process-local by default. Set ``TUPLEX_FAULTS_STATE=<file>`` to count
+firings in a shared append-only file instead, so clauses keep their
+budget across forked compile children and serve-process restarts (each
+eligible call appends one byte per clause slot; the file's per-slot size
+is the count). Probability draws come from ``random.Random(seed)``
+(``TUPLEX_FAULTS_SEED``, default 0) — a chaos run is reproducible.
+
+Disabled (no ``TUPLEX_FAULTS``) the hot-path cost of ``maybe()`` is one
+module-attribute load and a truthiness check.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Optional
+
+__all__ = ["FaultInjected", "enabled", "maybe", "reset", "spec_clauses"]
+
+
+class FaultInjected(RuntimeError):
+    """An injected failure (``raise`` action). ``transient`` mirrors the
+    clause's ``kind=`` param: the serve retry ladder retries transient
+    faults and short-circuits deterministic ones — exactly the
+    distinction it must make for real failures."""
+
+    def __init__(self, msg: str, transient: bool = True):
+        super().__init__(msg)
+        self.transient = transient
+
+
+class _Clause:
+    def __init__(self, site: str, action: str, point: Optional[str],
+                 p: float, limit: Optional[int], after: int,
+                 delay: float, transient: bool, index: int, text: str):
+        self.site = site
+        self.action = action          # hang | raise | crash
+        self.point = point            # None = any checkpoint of the site
+        self.p = p
+        self.limit = limit            # max firings (None = unlimited)
+        self.after = after            # eligible calls to skip first
+        self.delay = delay
+        self.transient = transient
+        self.index = index            # slot in the shared state file
+        self.text = text
+        self.calls = 0                # process-local eligible-call count
+        self.fired = 0                # process-local firing count
+
+
+def _parse(spec: str) -> list:
+    clauses: list = []
+    for idx, raw in enumerate(
+            p for chunk in spec.replace(";", ",").split(",")
+            if (p := chunk.strip())):
+        parts = raw.split(":")
+        if len(parts) < 2:
+            continue                  # malformed clause: ignored, not fatal
+        site, action = parts[0].strip(), parts[1].strip()
+        point = None
+        for base in ("hang", "raise", "crash"):
+            if action == base:
+                break
+            if action.startswith(base + "-"):
+                action, point = base, action[len(base) + 1:]
+                break
+        else:
+            continue                  # unknown action
+        p, limit, after, delay, transient = 1.0, None, 0, 3600.0, True
+        for param in parts[2:]:
+            param = param.strip()
+            if param == "once":
+                limit = 1
+            elif param.startswith("p="):
+                p = max(0.0, min(1.0, float(param[2:])))
+            elif param.startswith("n="):
+                limit = max(0, int(param[2:]))
+            elif param.startswith("after="):
+                after = max(0, int(param[6:]))
+            elif param.startswith("delay="):
+                delay = float(param[6:])
+            elif param.startswith("kind="):
+                transient = param[5:].strip() != "det"
+        clauses.append(_Clause(site, action, point, p, limit, after,
+                               delay, transient, idx, raw))
+    return clauses
+
+
+_LOCK = threading.Lock()
+_CLAUSES: Optional[list] = None       # None = env not parsed yet
+_RNG: Optional[random.Random] = None
+
+
+def reset() -> None:
+    """Re-read ``TUPLEX_FAULTS`` on next use (tests flip the env)."""
+    global _CLAUSES, _RNG
+    with _LOCK:
+        _CLAUSES = None
+        _RNG = None
+
+
+def _load() -> list:
+    global _CLAUSES, _RNG
+    with _LOCK:
+        if _CLAUSES is None:
+            _CLAUSES = _parse(os.environ.get("TUPLEX_FAULTS", ""))
+            try:
+                seed = int(os.environ.get("TUPLEX_FAULTS_SEED", "0"))
+            except ValueError:
+                seed = 0
+            _RNG = random.Random(seed)
+        return _CLAUSES
+
+
+def enabled() -> bool:
+    return bool(_load())
+
+
+def spec_clauses() -> list:
+    """Parsed clause texts (chaos_bench reports what it injected)."""
+    return [c.text for c in _load()]
+
+
+# -- shared (cross-process) counting ----------------------------------------
+# One byte appended per event per clause slot; O_APPEND makes concurrent
+# writers (forked compile children, a restarted serve process) safe, and
+# the count is simply the slot file's size. Slot files live next to the
+# configured state file, keyed by the clause TEXT (crc) as well as its
+# index — reusing one state file across different TUPLEX_FAULTS specs
+# must not let an old spec's spent budget silence a new clause.
+
+def _state_base() -> Optional[str]:
+    return os.environ.get("TUPLEX_FAULTS_STATE") or None
+
+
+def _bump_shared(base: str, clause: _Clause, kind: str) -> int:
+    import zlib
+
+    crc = zlib.crc32(clause.text.encode()) & 0xFFFFFFFF
+    path = f"{base}.{clause.index}-{crc:08x}.{kind}"
+    try:
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, b".")
+        finally:
+            os.close(fd)
+        return os.path.getsize(path)
+    except OSError:                   # state file unusable: local counting
+        clause_count = (clause.calls if kind == "calls" else clause.fired)
+        return clause_count
+
+
+def _count(clause: _Clause, kind: str) -> int:
+    """Record one event (an eligible call or a firing) and return the
+    TOTAL so far, shared across processes when a state file is set. The
+    in-process counters bump under the lock so two threads can never
+    both claim the last slot of a `once`/`n=` budget."""
+    base = _state_base()
+    with _LOCK:
+        if kind == "calls":
+            clause.calls += 1
+            local = clause.calls
+        else:
+            clause.fired += 1
+            local = clause.fired
+    return _bump_shared(base, clause, kind) if base else local
+
+
+def maybe(site: str, point: Optional[str] = None, **ctx) -> None:
+    """Injection checkpoint. No-op unless a ``TUPLEX_FAULTS`` clause
+    matches `site` (and `point`, when the clause names one); then the
+    clause's action fires subject to its after/n/p budget."""
+    clauses = _CLAUSES if _CLAUSES is not None else _load()
+    if not clauses:
+        return
+    for c in clauses:
+        if c.site != site or (c.point is not None and c.point != point):
+            continue
+        calls = _count(c, "calls")
+        if calls <= c.after:
+            continue
+        if c.p < 1.0:
+            with _LOCK:
+                draw = _RNG.random()
+            if draw >= c.p:
+                continue
+        if c.limit is not None:
+            # reserve a firing slot first so concurrent callers (compile
+            # pool threads, forked children) can't both claim the last one
+            fired = _count(c, "fired")
+            if fired > c.limit:
+                continue
+        else:
+            _count(c, "fired")
+        _fire(c, site, point, ctx)
+
+
+def _fire(c: _Clause, site: str, point: Optional[str], ctx: dict) -> None:
+    where = f"{site}" + (f"@{point}" if point else "")
+    detail = ", ".join(f"{k}={v}" for k, v in sorted(ctx.items()))
+    if c.action == "hang":
+        time.sleep(c.delay)
+        return
+    if c.action == "crash":
+        # emulate a hard process death: no atexit, no finally blocks —
+        # exactly what the serve journal recovery must tolerate
+        os._exit(70)
+    raise FaultInjected(
+        f"injected fault at {where}" + (f" ({detail})" if detail else ""),
+        transient=c.transient)

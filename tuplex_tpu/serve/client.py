@@ -14,6 +14,16 @@ Layout under the service root:
     inbox/<job>/request.pkl      client -> service (atomic rename)
     inbox/<job>/status.json      service -> client (overwritten per poll)
     inbox/<job>/response.pkl     service -> client (atomic, terminal)
+    inbox/<job>/journal.json     service-side state journal (atomic):
+                                 admitted/running/terminal transitions +
+                                 the crash-requeue count. A restarted
+                                 service over the same root reads it to
+                                 requeue in-flight jobs exactly once,
+                                 keep completed responses fetchable, and
+                                 fail poison jobs (in flight through
+                                 more than tuplex.serve.retryCount
+                                 crashes) cleanly instead of crash-
+                                 looping on them
     metrics.prom                 Prometheus text drop (runtime/telemetry,
                                  rewritten every tuplex.serve.metricsPromS
                                  seconds — the pull-telemetry leg of the
@@ -33,8 +43,8 @@ import uuid
 from typing import Optional
 
 from ..utils.logging import get_logger
-from .jobs import (DONE, FAILED, JobRejected, JobRequest, QueueFull,
-                   cleanup_request_scratch)
+from .jobs import (DONE, FAILED, RUNNING, JobRejected, JobRequest,
+                   QueueFull, cleanup_request_scratch)
 from .service import JobService
 
 log = get_logger("tuplex_tpu.serve")
@@ -53,21 +63,48 @@ def _atomic_write(path: str, data: bytes) -> None:
 # client side
 # ---------------------------------------------------------------------------
 
-def submit(root: str, request: JobRequest) -> str:
+def submit(root: str, request: JobRequest,
+           jid: Optional[str] = None) -> str:
     """Drop a request into the service inbox; returns the job dir name.
     Only wire-safe requests travel (every stage by spec — live stage
-    objects are an in-process construct)."""
+    objects are an in-process construct).
+
+    `jid` is an optional idempotency key: resubmitting under a jid whose
+    request already landed is a no-op (the first request stands and its
+    status/response stay authoritative), so a client that crashed
+    between submit and fetch can blindly resubmit-then-fetch without
+    ever running the job twice."""
     if not request.wire_safe():
         # the request dies here: its staged input parts must die with it
         cleanup_request_scratch(request.stages)
         raise JobRejected(
             "request carries live stage objects (join/aggregate tier); "
             "only spec-serialized pipelines can travel the wire protocol")
-    jid = uuid.uuid4().hex[:12]
+    jid = jid or uuid.uuid4().hex[:12]
     jdir = os.path.join(root, "inbox", jid)
     os.makedirs(jdir, exist_ok=True)
-    _atomic_write(os.path.join(jdir, "request.pkl"),
-                  pickle.dumps(request))
+    req_path = os.path.join(jdir, "request.pkl")
+    if os.path.exists(req_path):
+        # duplicate submission: idempotent — the first request stands.
+        # Release the NEW request's staged scratch (it would leak), but
+        # never an indir the standing request also references (a caller
+        # resubmitting the SAME request object must not have its staged
+        # input deleted out from under the admitted job).
+        keep: set = set()
+        try:
+            with open(req_path, "rb") as fp:
+                standing = pickle.load(fp)
+            keep = {e.get("indir") for e in standing.stages
+                    if isinstance(e, dict)}
+        except Exception:
+            keep = {e.get("indir") for e in request.stages
+                    if isinstance(e, dict)}   # unreadable: clean nothing
+        cleanup_request_scratch(
+            [e for e in request.stages
+             if isinstance(e, dict) and e.get("indir")
+             and e["indir"] not in keep])
+        return jid
+    _atomic_write(req_path, pickle.dumps(request))
     return jid
 
 
@@ -86,21 +123,131 @@ def fetch(root: str, jid: str, timeout: float = 600.0,
           poll_s: float = 0.1) -> dict:
     """Block until the job's terminal response lands; returns the response
     dict ({"ok": bool, "rows": [...], "metrics": {...}} or
-    {"ok": False, "error": ...}). TimeoutError past `timeout`."""
+    {"ok": False, "error": ...}). TimeoutError past `timeout`.
+
+    The reader trusts ONLY complete atomic renames: a torn/partial
+    ``response.pkl`` (a crashed writer's leftovers, a network filesystem
+    exposing a rename mid-flight) is treated as not-yet-arrived and
+    polling continues — the real response can still land over it via
+    ``os.replace`` — instead of surfacing a confusing unpickling error
+    to the caller."""
     resp = os.path.join(root, "inbox", jid, "response.pkl")
     deadline = time.monotonic() + timeout
-    while not os.path.exists(resp):
+    saw_torn = False
+    while True:
+        if os.path.exists(resp):
+            try:
+                with open(resp, "rb") as fp:
+                    return pickle.load(fp)
+            except (OSError, EOFError, pickle.UnpicklingError,
+                    IndexError):
+                saw_torn = True     # partial bytes: keep polling
+            # ImportError/AttributeError from a COMPLETE pickle are
+            # version skew between client and service, not a torn write
+            # — surface them instead of polling out the whole timeout
         if time.monotonic() > deadline:
-            raise TimeoutError(f"no response for job {jid} "
-                               f"after {timeout:.0f}s")
+            raise TimeoutError(
+                f"no response for job {jid} after {timeout:.0f}s"
+                + (" (a torn/partial response.pkl was present — the "
+                   "writer likely crashed mid-write and never replaced "
+                   "it atomically)" if saw_torn else ""))
         time.sleep(poll_s)
-    with open(resp, "rb") as fp:
-        return pickle.load(fp)
 
 
 # ---------------------------------------------------------------------------
 # service side (the `python -m tuplex_tpu serve` loop)
 # ---------------------------------------------------------------------------
+
+def _read_journal(jdir: str) -> dict:
+    try:
+        with open(os.path.join(jdir, "journal.json")) as fp:
+            return json.load(fp)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _write_journal(jdir: str, state: str, cache: Optional[dict] = None,
+                   **fields) -> None:
+    """Atomically journal a job-state transition. `cache` (jdir -> last
+    journal dict) avoids a read-modify-write per poll: only CHANGES hit
+    the filesystem, and the persistent ``requeues`` counter survives
+    every rewrite."""
+    prev = (cache.get(jdir) if cache is not None else None) \
+        or _read_journal(jdir)
+    rec = {"requeues": int(prev.get("requeues", 0)), "state": state}
+    rec.update(fields)
+    if cache is not None:
+        old = cache.get(jdir)
+        if old is not None and \
+                {k: v for k, v in old.items() if k != "updated"} == rec:
+            return
+    out = dict(rec)
+    out["updated"] = time.time()
+    try:
+        _atomic_write(os.path.join(jdir, "journal.json"),
+                      json.dumps(out).encode())
+        if cache is not None:
+            cache[jdir] = out
+    except OSError:     # journal is the recovery substrate, writes are
+        pass            # still best-effort per tick — the next one retries
+
+
+def _recover_inbox(inbox: str, requeue_budget: int) -> tuple:
+    """Crash recovery over a pre-existing service root, run once before
+    the loop starts. Returns (finished_dirs, n_requeued, n_failed).
+
+    * a dir with ``response.pkl`` is DONE — its result stays fetchable
+      and it is never re-admitted (duplicate submissions under that jid
+      are idempotently ignored);
+    * a dir journaled admitted/running was IN FLIGHT when the previous
+      service process died: bump its crash-requeue count and let the
+      normal admission scan requeue it (exactly once per restart);
+    * a job already requeued more than `requeue_budget` times is a
+      poison job (it keeps being in flight when the service dies):
+      terminal-fail it cleanly instead of crash-looping on it."""
+    finished: set = set()
+    requeued = failed = 0
+    try:
+        names = sorted(os.listdir(inbox))
+    except OSError:
+        return finished, requeued, failed
+    for d in names:
+        jdir = os.path.join(inbox, d)
+        if not os.path.isdir(jdir):
+            continue
+        if os.path.exists(os.path.join(jdir, "response.pkl")):
+            finished.add(d)
+            continue
+        j = _read_journal(jdir)
+        if j.get("state") not in ("admitted", "running", "recovered"):
+            continue        # never admitted: the normal scan handles it
+        requeues = int(j.get("requeues", 0)) + 1
+        if requeues > max(1, requeue_budget):
+            msg = (f"job was in flight through {requeues - 1} service "
+                   f"crash(es) (tuplex.serve.retryCount); failing "
+                   f"cleanly instead of requeueing again")
+            try:      # terminal: release the request's staged input
+                with open(os.path.join(jdir, "request.pkl"), "rb") as fp:
+                    cleanup_request_scratch(pickle.load(fp).stages)
+            except Exception:   # unreadable request: nothing staged to
+                pass            # find — the dir itself stays diagnosable
+            _atomic_write(os.path.join(jdir, "response.pkl"),
+                          pickle.dumps({"ok": False, "state": FAILED,
+                                        "error": msg}))
+            _write_status(jdir, FAILED, {"error": msg})
+            _write_journal(jdir, FAILED, requeues=requeues)
+            finished.add(d)
+            failed += 1
+            log.warning("recovery: poison job %s failed cleanly "
+                        "(%d crash requeues)", d, requeues - 1)
+        else:
+            _write_journal(jdir, "recovered", requeues=requeues)
+            requeued += 1
+            log.info("recovery: requeueing in-flight job %s "
+                     "(crash requeue %d/%d)", d, requeues,
+                     max(1, requeue_budget))
+    return finished, requeued, failed
+
 
 def _write_status(jdir: str, handle_or_state,
                   extra: Optional[dict] = None,
@@ -130,20 +277,27 @@ def _write_status(jdir: str, handle_or_state,
         pass
 
 
-def _finish(jdir: str, handle) -> None:
+def _finish(jdir: str, handle, jcache: Optional[dict] = None) -> None:
     if handle.state == DONE:
         resp = {"ok": True, "rows": handle._rec.result_rows,
                 "metrics": handle.metrics.as_dict(),
                 "counters": handle.counters(),
                 "stats": handle.stats,
+                "attempts": handle.attempts(),
                 "exception_counts": {}}
         for e in handle.exceptions():
             resp["exception_counts"][e.exc_name] = \
                 resp["exception_counts"].get(e.exc_name, 0) + 1
     else:
         resp = {"ok": False, "state": handle.state,
-                "error": handle.error or handle.state}
+                "error": handle.error or handle.state,
+                "attempts": handle.attempts()}
     _atomic_write(os.path.join(jdir, "response.pkl"), pickle.dumps(resp))
+    # journal AFTER the response rename: a crash between the two leaves
+    # an admitted/running journal next to a response — recovery treats
+    # the response as authoritative, so the job is still terminal
+    _write_journal(jdir, handle.state, jcache,
+                   attempts=len(handle.attempts()))
 
 
 def service_loop(root: str, options=None, *, poll_s: float = 0.1,
@@ -199,12 +353,21 @@ def service_loop(root: str, options=None, *, poll_s: float = 0.1,
                     metrics_srv = None
     tracked: dict = {}          # jid dir -> (jdir, handle)
     waiting: dict = {}          # jid dir -> first queue-full timestamp
-    finished: set = set()
     status_cache: dict = {}     # jdir -> last status json written
+    journal_cache: dict = {}    # jdir -> last journal dict written
+    # crash recovery BEFORE the first scan: completed jobs stay fetchable
+    # (and are never re-admitted), jobs that were in flight when a
+    # previous service process died over this root are requeued exactly
+    # once, poison jobs are failed cleanly
+    finished, n_requeued, n_poisoned = _recover_inbox(
+        inbox, svc.retry_count)
     served = 0
     last_activity = time.monotonic()
-    log.info("job service listening on %s (slots=%d, depth=%d)",
-             root, svc.slots, svc.queue_depth)
+    log.info("job service listening on %s (slots=%d, depth=%d)%s",
+             root, svc.slots, svc.queue_depth,
+             f" — recovered root: {n_requeued} requeued, "
+             f"{n_poisoned} poison-failed, {len(finished)} kept"
+             if (n_requeued or n_poisoned) else "")
 
     def _reject_dir(d, jdir, msg, stages=None):
         if stages is not None:
@@ -213,6 +376,7 @@ def service_loop(root: str, options=None, *, poll_s: float = 0.1,
                       pickle.dumps({"ok": False, "state": "rejected",
                                     "error": msg}))
         _write_status(jdir, "rejected", {"error": msg})
+        _write_journal(jdir, "rejected", journal_cache)
         status_cache.pop(jdir, None)
         waiting.pop(d, None)
         finished.add(d)
@@ -277,13 +441,25 @@ def service_loop(root: str, options=None, *, poll_s: float = 0.1,
                 waiting.pop(d, None)
                 tracked[d] = (jdir, handle)
                 _write_status(jdir, handle, cache=status_cache)
+                # journal the admission BEFORE this tick returns: a crash
+                # from here on leaves an admitted/running record the next
+                # service over this root requeues exactly once
+                _write_journal(jdir, "admitted", journal_cache,
+                               job=handle.id)
+                from ..runtime import faults
+
+                faults.maybe("serve", point="after-admit")
             for d in list(tracked):
                 jdir, handle = tracked[d]
                 _write_status(jdir, handle, cache=status_cache)
+                if handle.state == RUNNING:
+                    _write_journal(jdir, "running", journal_cache,
+                                   job=handle.id)
                 if handle.state in _TERMINAL:
-                    _finish(jdir, handle)
+                    _finish(jdir, handle, journal_cache)
                     del tracked[d]
                     status_cache.pop(jdir, None)
+                    journal_cache.pop(jdir, None)
                     finished.add(d)
                     served += 1
                     progressed = True

@@ -52,6 +52,43 @@ class JobFailed(TuplexException):
     """Raised by ``JobHandle.result()`` when the job's execution failed."""
 
 
+def transient_failure(exc: BaseException) -> bool:
+    """Whether a job failure is worth RETRYING (the serve retry ladder's
+    one classification decision). Transient = the run environment broke —
+    a killed/deadlined compile, a device or dispatch runtime error, an
+    injected transient fault, I/O flaking — so a fresh attempt on the
+    same warm device can succeed. Deterministic = the job itself is wrong
+    (user-code exceptions the resolvers didn't absorb, malformed
+    requests, plan errors): retrying burns device time to fail
+    identically, so it short-circuits with the clear error instead.
+
+    Unknown exception types default to DETERMINISTIC: a retry loop that
+    guesses "transient" on everything turns every poison job into
+    retryCount poison jobs."""
+    from ..exec.compilequeue import CompileTimeout
+    from ..runtime.faults import FaultInjected
+
+    if isinstance(exc, FaultInjected):
+        return exc.transient
+    if isinstance(exc, CompileTimeout):
+        return True
+    if isinstance(exc, (FileNotFoundError, PermissionError,
+                        IsADirectoryError, NotADirectoryError)):
+        return False            # bad paths/permissions recur identically
+    if isinstance(exc, (ConnectionError, BrokenPipeError, TimeoutError,
+                        OSError)):
+        return True             # I/O flaking: a fresh attempt can win
+    if isinstance(exc, TuplexException):
+        return False            # framework-classified user/plan errors
+    name = type(exc).__name__
+    if name in ("XlaRuntimeError", "RuntimeError", "InternalError"):
+        msg = str(exc)
+        return any(p in msg for p in (
+            "RESOURCE_EXHAUSTED", "DEADLINE", "UNAVAILABLE", "INTERNAL",
+            "ABORTED", "device", "Device", "dispatch"))
+    return False
+
+
 #: job lifecycle states
 QUEUED = "queued"
 RUNNING = "running"
@@ -158,6 +195,12 @@ class JobHandle:
     def exceptions(self) -> list:
         return list(self._rec.exceptions)
 
+    def attempts(self) -> list:
+        """The retry ladder's audit trail: one record per FAILED attempt
+        ({attempt, error, transient, action, backoff_s, t}). Empty for a
+        job that succeeded first try."""
+        return [dict(a) for a in self._rec.attempts]
+
     # -- completion --------------------------------------------------------
     def wait(self, timeout: Optional[float] = None) -> str:
         """Block until the job reaches a terminal state (or `timeout`
@@ -206,8 +249,14 @@ class JobRecord:
         self.final_counters: Optional[dict] = None
         self.weight = max(1, int(weight))
         self.burst = 0                      # consecutive steps this round
+        self.attempt = 0                    # completed FAILED attempts
+        self.attempts: list = []            # one dict per failed attempt
+                                            # (error, transient verdict,
+                                            # backoff, action) — the retry
+                                            # ladder's audit trail
         self.stats: dict = {"turns": 0, "finished_turn": None,
-                            "queued_s": None, "wall_s": None}
+                            "queued_s": None, "wall_s": None,
+                            "attempts": 0}
         self.t_submit = time.perf_counter()
         self.t_start: Optional[float] = None
         self.t_enqueue: Optional[float] = None   # last ready-queue append
@@ -222,6 +271,19 @@ class JobRecord:
         from ..runtime import xferstats
 
         return xferstats.scoped(self.id)
+
+    def reset_for_retry(self) -> None:
+        """Clear the per-ATTEMPT result state before a retry replays the
+        job from stage 0: stage metrics, exception rows and result rows
+        belong to the aborted attempt — keeping them would double-count
+        them into the final response (the attempts audit trail and the
+        scoped counter family deliberately persist across attempts)."""
+        from ..api.metrics import Metrics
+
+        self.metrics = Metrics()
+        self.metrics.counters_source = self._counters
+        self.exceptions = []
+        self.result_rows = None
 
 
 class _RunnerCtx:

@@ -38,11 +38,11 @@ from collections import deque
 from typing import Optional
 
 from ..core.options import ContextOptions
-from ..runtime import telemetry
+from ..runtime import faults, telemetry
 from ..utils.logging import get_logger
 from .jobs import (CANCELLED, DONE, FAILED, QUEUED, RUNNING, JobHandle,
                    JobRecord, JobRejected, JobRequest, QueueFull,
-                   _JobRunner)
+                   _JobRunner, transient_failure)
 
 log = get_logger("tuplex_tpu.serve")
 
@@ -80,6 +80,15 @@ class JobService:
         self.tenant_weights = _parse_weights(
             o.get_str("tuplex.serve.tenantWeights", ""))
         self.retain_jobs = max(1, o.get_int("tuplex.serve.retainJobs", 256))
+        # job-level retry ladder: transient failures (device/dispatch
+        # runtime errors, compile deadlines — jobs.transient_failure)
+        # requeue from stage 0 with exponential backoff; deterministic
+        # failures short-circuit. The wire loop reuses retry_count as the
+        # crash-requeue budget (serve/client journal recovery).
+        self.retry_count = max(0, o.get_int("tuplex.serve.retryCount", 2))
+        self.retry_backoff_s = max(0.0, o.get_float(
+            "tuplex.serve.retryBackoffS", 0.5))
+        self._delayed: list = []          # (due_monotonic, JobRecord)
         self.recorder = recorder          # history.JobRecorder (optional)
         self._cond = threading.Condition()
         self._ready: deque = deque()      # runnable JobRecords (DRR order)
@@ -127,6 +136,7 @@ class JobService:
           lambda: self._open / self.queue_depth, owner=self)
         g("serve_resident_bytes", self._resident_bytes, owner=self)
         g("serve_turns", lambda: self._turn, owner=self)
+        g("serve_retry_backlog", lambda: len(self._delayed), owner=self)
         telemetry.register_health_check(
             "serve_admission", self._check_admission, owner=self)
         telemetry.register_health_check(
@@ -228,6 +238,7 @@ class JobService:
                     rec.error = "service closed"
                     cancelled.append(rec)
             self._ready.clear()
+            self._delayed.clear()    # backoff waiters die with the service
             self._open = 0
             self._cond.notify_all()
         telemetry.drop_owner(self)   # gauges/checks close over this object
@@ -371,7 +382,17 @@ class JobService:
     def _worker(self) -> None:
         while True:
             with self._cond:
-                while not self._stop and not self._ready:
+                while not self._stop:
+                    # promote retry-backoff waiters whose delay elapsed
+                    # (the 0.2s condition poll bounds the promotion lag)
+                    if self._delayed:
+                        now = time.monotonic()
+                        due = [x for x in self._delayed if x[0] <= now]
+                        for x in due:
+                            self._delayed.remove(x)
+                            self._ready.append(x[1])
+                    if self._ready:
+                        break
                     self._cond.wait(0.2)
                 if self._stop:
                     return
@@ -387,22 +408,66 @@ class JobService:
                                   tenant=rec.request.tenant)
             self._run_turn(rec)
 
+    def _note_attempt(self, rec: JobRecord, err: BaseException) -> bool:
+        """Record one failed attempt on the job's audit trail (and its
+        tenant span stream — the caller still has the stream set) and
+        decide whether the retry ladder takes it: transient failures
+        retry up to tuplex.serve.retryCount with exponential backoff,
+        deterministic ones short-circuit with the clear error."""
+        from ..runtime import tracing
+
+        transient = False
+        try:
+            transient = transient_failure(err)
+        except Exception:       # classifier must never mask the failure
+            pass
+        will_retry = transient and rec.attempt < self.retry_count \
+            and not self._stop
+        backoff = self.retry_backoff_s * (2 ** rec.attempt) \
+            if will_retry else 0.0
+        entry = {"attempt": rec.attempt + 1,
+                 "error": f"{type(err).__name__}: {err}",
+                 "transient": transient,
+                 "action": "retry" if will_retry else "fail",
+                 "backoff_s": round(backoff, 3),
+                 "t": time.time()}
+        rec.attempts.append(entry)
+        rec.stats["attempts"] = len(rec.attempts)
+        tracing.instant("serve:attempt-failed", "serve", {
+            "attempt": entry["attempt"], "transient": transient,
+            "action": entry["action"], "error": entry["error"][:120]})
+        self._record_event(rec, "job_retry" if will_retry else "job_fail",
+                           attempt=entry["attempt"],
+                           transient=transient,
+                           backoff_s=entry["backoff_s"],
+                           tenant=rec.request.tenant,
+                           error=entry["error"])
+        return will_retry
+
     def _run_turn(self, rec: JobRecord) -> None:
         """One scheduler turn: one stage dispatch of `rec`, telemetry
-        scoped to the job, then DRR requeue / completion under the lock."""
+        scoped to the job, then DRR requeue / completion under the lock.
+        A failed turn consults the retry ladder BEFORE going terminal:
+        transient failures requeue the job from stage 0 after its
+        exponential backoff (the slot frees immediately — backoff never
+        blocks a worker)."""
         from ..runtime import tracing, xferstats
 
         done = False
         err: Optional[BaseException] = None
+        retrying = False
         tracing.set_stream(rec.id)
         xferstats.set_scope(rec.id)
         t_disp0 = time.perf_counter()
         try:
+            faults.maybe("serve", point="step")   # chaos checkpoint: an
+            # injected raise classifies exactly like a real step failure
             done = rec.runner.step()
             if done:
                 rec.runner.finalize()
         except BaseException as e:   # noqa: BLE001 - job dies, service lives
             err = e
+            retrying = self._note_attempt(rec, e)
         finally:
             tracing.set_stream(None)
             xferstats.set_scope(None)
@@ -410,6 +475,43 @@ class JobService:
         telemetry.observe("serve_dispatch_seconds", now - t_disp0,
                           tenant=rec.request.tenant)
         wall = now - (rec.t_start or rec.t_submit)
+        if retrying:
+            rec.attempt += 1
+            backoff = rec.attempts[-1]["backoff_s"]
+            xferstats.bump("serve_job_retries", 1, tag=rec.request.tenant)
+            log.warning("job %s attempt %d failed (%s); retrying in %.2gs",
+                        rec.id, rec.attempt, rec.attempts[-1]["error"],
+                        backoff)
+            try:
+                # fresh runner: the retry replays the job from stage 0
+                # over the ORIGINAL request (its staged scratch is only
+                # cleaned at the true terminal turn); the aborted
+                # attempt's metrics/exceptions/rows are dropped so the
+                # final response never double-counts them
+                rec.reset_for_retry()
+                rec.runner = _JobRunner(rec, self.options,
+                                        self.default_budget)
+            except Exception as e2:   # rebuild failed: terminal after all
+                retrying = False
+                err = e2
+                rec.attempts[-1]["action"] = "fail"
+        if retrying:
+            with self._cond:
+                self._turn += 1
+                self._busy -= 1
+                self._last_turn_done_t = time.monotonic()
+                rec.stats["turns"] += 1
+                if rec.state == CANCELLED or self._stop:
+                    # close() raced the retry: keep the CANCELLED verdict
+                    if rec.final_counters is None:
+                        rec.final_counters = xferstats.drop_scope(rec.id)
+                    self._cond.notify_all()
+                    return
+                # the slot frees NOW; the job re-enters the ready queue
+                # once its backoff elapses (worker-loop promotion)
+                self._delayed.append((time.monotonic() + backoff, rec))
+                self._cond.notify_all()
+            return
         if err is not None or done:
             try:
                 rec.runner.cleanup()
