@@ -43,6 +43,23 @@ HIGHER_BETTER = {
     "stage_compiles": False,
     "d2h_bytes": False,
     "h2d_bytes": False,
+    # device-plane cost attribution (runtime/devprof): measured device
+    # seconds and the peak executable footprint must not rise; achieved
+    # roofline fraction must not fall. The leaf-name rule makes the
+    # per-stage dotted keys (stage_costs.0.device_s, ...) gate too.
+    # flops/device_bytes are properties of the compiled graph, not
+    # speed — a plan change legitimately moves them, so informational.
+    "device_s": False,
+    "device_cold_s": False,
+    "hbm_peak": False,
+    # informational: peak footprint vs the JOB's MemoryManager budget —
+    # a host-side config change (tuplex.executorMemory) moves it with
+    # zero device-side change, so it must not gate
+    "hbm_budget_frac": None,
+    "roofline_frac": True,
+    "flops": None,                   # informational (plan-dependent)
+    "device_bytes": None,            # informational (plan-dependent)
+    "device_dispatches": None,
     "analyzer_ms": False,
     "spread": False,
     "wall_s": False,

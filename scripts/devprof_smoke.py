@@ -1,0 +1,87 @@
+#!/usr/bin/env python3
+"""Device-profiling smoke test: run the zillow model pipeline with cost
+attribution ON (the default) and assert the ISSUE-12 acceptance chain —
+every compiled stage carries a StageCost (XLA cost/memory analysis),
+measured device seconds are positive, the roofline fraction is a real
+fraction in (0, 1], and the SAME numbers appear in the Prometheus
+/metrics exposition and the persisted stage index compilestats reads.
+
+Run directly (CI wires it as a tier-1 test via tests/test_devprof.py):
+
+    JAX_PLATFORMS=cpu python scripts/devprof_smoke.py
+
+Exits 0 and prints one `devprof-smoke OK ...` line on success; any
+assertion failure is a non-zero exit. DEVPROF_SMOKE_ROWS overrides the
+input size (default 400 — matching tests/test_zillow_model.py so a warm
+AOT artifact cache skips the XLA compiles)."""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))          # run from anywhere
+
+N_ROWS = int(os.environ.get("DEVPROF_SMOKE_ROWS", "400"))
+
+
+def main() -> int:
+    import tuplex_tpu
+    from tuplex_tpu.models import zillow
+    from tuplex_tpu.runtime import devprof, telemetry
+
+    with tempfile.TemporaryDirectory() as d:
+        data = os.path.join(d, "zillow.csv")
+        zillow.generate_csv(data, N_ROWS, seed=7)
+        ctx = tuplex_tpu.Context()
+        assert devprof.enabled(), \
+            "devprof disabled (TUPLEX_DEVPROF=0 set?) — nothing to smoke"
+        got = zillow.build_pipeline(ctx.csv(data)).collect()
+        assert got == zillow.run_reference_python(data), \
+            "device profiling changed pipeline output"
+
+        compiled = [m for m in ctx.metrics.stages
+                    if m.get("tier") == "compiled"
+                    and m.get("fast_path_s", 0) > 0]
+        assert compiled, "no stage ran on the compiled tier"
+        for i, m in enumerate(compiled):
+            # every compiled stage: a dispatch window was measured ...
+            assert m.get("device_dispatches", 0) > 0, (i, m)
+            assert m.get("device_s", 0.0) > 0.0, (i, m)
+            # ... the executable's StageCost was harvested or recovered
+            assert m.get("flops", 0.0) > 0.0, \
+                (i, "no StageCost (cost_analysis returned nothing?)", m)
+            assert m.get("hbm_peak", 0) > 0, (i, m)
+            # ... and the roofline math produced a real fraction
+            rf = m.get("roofline_frac")
+            assert rf is not None and 0.0 < rf <= 1.0, (i, rf, m)
+
+        assert ctx.metrics.deviceTime() > 0.0
+        assert ctx.metrics.as_dict()["device_s"] > 0.0
+
+        # the same numbers reach the Prometheus exposition ...
+        text = telemetry.render_prometheus()
+        for fam in ("tuplex_devprof_stage_device_seconds",
+                    "tuplex_devprof_stage_flops",
+                    "tuplex_devprof_stage_hbm_peak_bytes",
+                    "tuplex_devprof_stage_roofline_frac",
+                    "tuplex_device_dispatch_seconds_bucket"):
+            assert fam in text, f"{fam} missing from /metrics exposition"
+
+        # ... and the persisted stage index `compilestats` queries
+        idx = devprof.load_stage_index()
+        with_cost = [e for e in idx.values()
+                     if e.get("analysis") is not None]
+        assert with_cost, f"stage index has no analysis records: {idx}"
+
+        peaks = devprof.platform_peaks()
+        print(f"devprof-smoke OK — {len(compiled)} compiled stage(s), "
+              f"device {ctx.metrics.deviceTime() * 1e3:.1f} ms, "
+              f"peaks {peaks.name} ({peaks.kind}), rows={len(got)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

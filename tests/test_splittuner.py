@@ -48,6 +48,32 @@ def test_boundary_cost_median_and_persistence(model_dir):
     assert ST.CompileModel("cpu").boundary_cost() == pytest.approx(0.3)
 
 
+def test_device_dispatch_cost_feeds_boundary_tax(model_dir):
+    """The devprof feed (runtime/devprof.stage_report -> warm median ->
+    record_device_dispatch) is the first MEASURED device-cost feature in
+    the split decision: every extra segment is one extra device dispatch,
+    so its measured occupancy joins the per-boundary tax."""
+    m = ST.CompileModel("cpu")
+    assert m.device_dispatch_cost() == 0.0           # nothing measured yet
+    base = ST.plan_split(12, budget_s=0.0, model=m)
+    for s in (0.05, 0.15, 0.10):
+        m.record_device_dispatch(s)
+    # min: the cheapest observed dispatch proxies the FIXED per-dispatch
+    # device overhead (compute splits with the stage, the fixed part
+    # is what an extra boundary actually pays)
+    assert m.device_dispatch_cost() == pytest.approx(0.05)
+    # persists with the model like boundary samples do
+    assert ST.CompileModel("cpu").device_dispatch_cost() == \
+        pytest.approx(0.05)
+    dec = ST.plan_split(12, budget_s=0.0, model=m)
+    if dec.k > 1:
+        # the tax per boundary is now host boundary + measured device
+        unit = m.boundary_cost() + m.device_dispatch_cost()
+        assert dec.boundary_s == pytest.approx((dec.k - 1) * unit)
+    # a dearer boundary can only push the decision toward FEWER segments
+    assert dec.k <= base.k
+
+
 def test_plan_split_cheap_curve_keeps_fusion(model_dir):
     m = ST.CompileModel("cpu")
     for n, s in [(5, 0.05), (10, 0.1), (20, 0.2)]:

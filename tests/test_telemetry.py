@@ -577,4 +577,11 @@ def test_serve_bench_smoke():
     for mode in ("concurrent", "serial"):
         for k in ("p50", "p95", "p99", "max", "mean", "wall_s"):
             assert result[mode][k] >= 0, (mode, k, result)
-    assert result["telemetry_count"] >= 7    # warm + 2x3 jobs
+    # telemetry_count is the CONCURRENT-mode histogram count only: the
+    # harness filters the streaming-histogram cross-check to the
+    # mode-prefixed "conc-*" tenant labels (scripts/serve_bench.py reqs()),
+    # so the warm job and the 3 serial jobs are excluded by design. The
+    # old ">= 7 (warm + 2x3 jobs)" expectation predated that filter and
+    # failed every run as `assert 3 >= 7`; the script itself already
+    # pins the exact contract (telemetry_count == jobs) in --smoke.
+    assert result["telemetry_count"] == 3    # the 3 concurrent jobs

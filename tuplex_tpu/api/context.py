@@ -40,6 +40,12 @@ class Context:
             from ..runtime import tracing
 
             tracing.enable(True)
+        # device-plane cost attribution (runtime/devprof): same process-
+        # wide on-only semantics as tracing/telemetry; TUPLEX_DEVPROF=0
+        # is the env kill switch that wins over everything
+        from ..runtime import devprof as _dp
+
+        _dp.apply_options(self.options_store)
         self.backend = self._make_backend()
         self.metrics = Metrics()
         from ..history import JobRecorder

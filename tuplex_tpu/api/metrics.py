@@ -81,6 +81,20 @@ class Metrics:
     def totalRowsOut(self) -> int:
         return sum(int(m.get("rows_out", 0)) for m in self.stages)
 
+    def deviceTime(self) -> float:
+        """Total MEASURED device seconds across stages (runtime/devprof:
+        launch→ready per dispatch, cold compile waits included in the
+        cold split). 0.0 when attribution is off (TUPLEX_DEVPROF=0) or
+        nothing dispatched to a compiled executable."""
+        return sum(float(m.get("device_s", 0.0)) for m in self.stages)
+
+    def hbmPeak(self) -> int:
+        """Largest per-execution peak device-memory footprint of any
+        stage executable (XLA memory_analysis: arguments + outputs +
+        temps + generated code)."""
+        return max((int(m.get("hbm_peak", 0)) for m in self.stages),
+                   default=0)
+
     def d2hBytes(self) -> int:
         """Device->host transfer bytes attributed per stage (the boundary
         tunnel tax the varlen wire / handoff work is judged against)."""
@@ -140,6 +154,8 @@ class Metrics:
             "general_path_s": self.generalPathWallTime(),
             "slow_path_s": self.slowPathWallTime(),
             "wall_s": self.totalWallTime(),
+            "device_s": self.deviceTime(),
+            "hbm_peak": self.hbmPeak(),
             "compile_s": self.compileTime(),
             "stage_compiles": self.stageCompileCount(),
             "rows_out": self.totalRowsOut(),

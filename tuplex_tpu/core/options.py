@@ -230,6 +230,31 @@ DEFAULTS: dict[str, str] = {
                                             # kill switch (wins over all)
                                             # makes every record a single
                                             # flag check, zero allocation
+    "tuplex.tpu.devprof": "true",           # device-plane cost
+                                            # attribution (runtime/
+                                            # devprof.py): harvests XLA
+                                            # cost/memory analysis per
+                                            # compiled stage (persisted
+                                            # next to the AOT artifact),
+                                            # measures device time per
+                                            # dispatch (launch→ready,
+                                            # cold/warm split) and emits
+                                            # roofline readouts into
+                                            # stage metrics, bench JSON,
+                                            # /metrics gauges, spans and
+                                            # the dashboard. Default on.
+                                            # NOTE the enabled dispatch
+                                            # path blocks each partition
+                                            # until the device finishes
+                                            # (that IS the measurement) —
+                                            # TUPLEX_DEVPROF=0 is the env
+                                            # kill switch restoring the
+                                            # fully-async window with a
+                                            # single flag check (zero
+                                            # allocation, test-pinned).
+                                            # Like trace/telemetry the
+                                            # gate is process-wide and
+                                            # the option only turns it ON
     "tuplex.tpu.trace": "false",            # structured span tracing
                                             # (runtime/tracing.py): nested
                                             # spans across plan/compile/

@@ -536,6 +536,18 @@ def _compile_in_subprocess(fp: str, lowered, deadline_s: float,
         # path _disk_store below are gate-free by design
         code = 1
         try:
+            # drop the inherited std fds FIRST: the child reports only
+            # via its exit code, and an ORPHANED child (parent killed
+            # mid-compile; a fork-deadlocked orphan can outlive it by
+            # hours) holding the parent's stdout/stderr pipes keeps
+            # every `cmd | consumer` harness waiting for EOF forever
+            # (observed hanging a piped pytest run for 25 minutes)
+            devnull = os.open(os.devnull, os.O_RDWR)
+            for fd in (0, 1, 2):
+                os.dup2(devnull, fd)
+        except OSError:
+            pass
+        try:
             compiled = _compile_lowered(lowered)
             _disk_store(fp, compiled, path=path)
             code = 0
@@ -644,6 +656,22 @@ def _compile_with_watchdog(lowered, n_ops: int):
         stop.set()
 
 
+def _note_devprof(tag: str, fp: str, compiled) -> None:
+    """Cost-attribution hook (runtime/devprof): harvest-or-recover XLA's
+    cost/memory analysis for every executable that becomes visible here —
+    fresh compiles, AOT disk hits, subprocess handbacks. Under the fork
+    gate because cost_analysis()/memory_analysis() are native calls (see
+    _FORK_GATE); best-effort by contract."""
+    try:
+        from ..runtime import devprof
+
+        if devprof.enabled():
+            with _FORK_GATE:
+                devprof.note_compiled(tag, fp, compiled)
+    except Exception:   # pragma: no cover - attribution is best-effort
+        pass
+
+
 def _note_compile(tag: str, dt: float, n_ops: int) -> None:
     with _LOCK:
         STATS["stage_compiles"] += 1
@@ -725,6 +753,7 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
                 lowered = traced.lower()
             compiled = _compile_with_watchdog(lowered, n_ops)
         _note_compile(tag, time.perf_counter() - t0, n_ops)
+        _note_devprof(tag, "", compiled)   # tag-only: no content address
         return compiled
 
     while True:
@@ -746,13 +775,26 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
             TR.instant("compile:cache-hit", "compile",
                        {"tag": tag[:16], "cache": "hit",
                         "store": "in-process", "fp": fp[:12]})
+            try:     # dedup hit: the cost record exists; only the
+                from ..runtime import devprof   # tag->fp edge is new
+
+                devprof.note_tag(tag, fp)
+            except Exception:   # pragma: no cover
+                pass
             return cached
         try:            # someone else is compiling this very fingerprint
             with TR.span("compile:queue-wait", "compile") as _sp:
                 _sp.set("tag", tag[:16]).set("join", "in-flight") \
                    .set("fp", fp[:12])
-                return fut.result(
+                joined = fut.result(
                     timeout=deadline_s if deadline_s else None)
+            try:    # the owner's _publish noted ITS tag; the joiner's
+                from ..runtime import devprof   # tag->fp edge is new
+
+                devprof.note_tag(tag, fp)
+            except Exception:   # pragma: no cover
+                pass
+            return joined
         except FutureTimeout:
             raise CompileTimeout(
                 f"waited {deadline_s:.0f}s on an in-flight compile "
@@ -769,6 +811,10 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
             _EXECS.move_to_end(fp)
             while len(_EXECS) > _mem_capacity():
                 _EXECS.popitem(last=False)   # disk artifact remains
+        # every executable that becomes dispatchable passes through here
+        # (fresh compile, AOT disk hit, subprocess handback): the single
+        # chokepoint where the cost-attribution layer sees it
+        _note_devprof(tag, fp, compiled)
         return compiled
 
     def _compile_job():

@@ -31,6 +31,7 @@ from ..plan import logical as L
 from ..plan.physical import TransformStage
 from .compilequeue import CompileTimeout
 from ..runtime import columns as C
+from ..runtime import devprof as DP
 from ..runtime import faults
 from ..runtime import tracing as TR
 from ..runtime import xferstats
@@ -464,6 +465,11 @@ class LocalBackend:
             res = self._execute_windowed(stage, partitions, intermediate)
             if sp is not TR.NOOP:
                 sp.set("rows_out", res.metrics.get("rows_out", 0))
+                for k in ("device_s", "flops", "hbm_peak",
+                          "roofline_frac"):
+                    v = res.metrics.get(k)
+                    if v is not None:
+                        sp.set(k, round(float(v), 6))
         return res
 
     def _execute_windowed(self, stage: TransformStage,
@@ -775,6 +781,20 @@ class LocalBackend:
         cs, cn = _cq.consume_tag(stage.key())
         metrics["compile_s"] += cs
         metrics["stage_compiles"] = cn
+        # device-plane cost attribution (runtime/devprof): measured device
+        # seconds, XLA flops/bytes/peak-memory and the roofline fraction
+        # for THIS stage's dispatches, flat numeric keys riding the same
+        # record compile_s does (bench JSON, history, Prometheus)
+        try:
+            # owner = this backend: concurrent serve jobs share stage
+            # keys by design (isomorphic compile sharing) but must not
+            # pool or steal each other's dispatch windows
+            rep = DP.stage_report(stage.key(), mm_budget=self.mm.budget,
+                                  owner=id(self))
+            if rep:
+                metrics.update(rep)
+        except Exception:   # pragma: no cover - attribution best-effort
+            pass
         # which tier this stage's rows ALL ran on (tier purity is the
         # contract the deadline-degrade restart enforces); task-failure
         # fallbacks within the ladder still show up in failure_log
@@ -1069,10 +1089,36 @@ class LocalBackend:
         first_call = not self.jit_cache.was_traced(cache_key, spec)
         try:
             # name formatted only when tracing is on — dispatch is the
-            # per-partition hot path and the off-path must stay free
+            # per-partition hot path and the off-path must stay free.
+            # The devprof gate is read ONCE: another thread flipping it
+            # mid-dispatch (a new Context's apply_options) must not pair
+            # a zero t_dev with a later record (a perf_counter-epoch
+            # "sample" would poison the histograms and the tuner feed).
+            dp_on = DP.enabled() and stage is not None
+            t_dev = time.perf_counter() if dp_on else 0.0
             with TR.device_annotation(f"tpx:dispatch:{skey[:12]}"
                                       if TR.enabled() else ""):
                 outs = device_fn(batch.arrays)
+            # the async-return stamp: everything up to here is staging +
+            # H2D + launch; the split tuner's BOUNDARY sample below must
+            # use this, not a post-block stamp — with devprof on, the
+            # block absorbs the stage's whole device execution and one
+            # such sample persisted into the compile model would inflate
+            # boundary_cost() ~1000x and weld every plan to k=1
+            t_ret = time.perf_counter()
+            if dp_on:
+                # measured device time: wait for this dispatch's device
+                # work (is_ready polling — see devprof.block_ready) and
+                # record launch→ready per partition, cold (first call
+                # spans the compile/AOT-load wait) vs warm. Costs the
+                # dispatch/merge overlap — that is the price of
+                # attribution; TUPLEX_DEVPROF=0 restores the fully-async
+                # window with a single flag check here.
+                DP.block_ready(outs)
+                DP.record_dispatch(stage.key(),
+                                   time.perf_counter() - t_dev,
+                                   cold=first_call, rows=part.num_rows,
+                                   owner=id(self))
             if leaf_h2d:
                 xferstats.note_h2d(leaf_h2d, tag="leaf_stage")
             self.jit_cache.note_traced(cache_key, spec)
@@ -1090,8 +1136,7 @@ class LocalBackend:
                 try:
                     from ..plan.splittuner import model_for
 
-                    model_for().record_boundary(
-                        time.perf_counter() - t0)
+                    model_for().record_boundary(t_ret - t0)
                 except Exception:
                     pass
         except NotCompilable:

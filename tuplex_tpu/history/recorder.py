@@ -294,6 +294,17 @@ def _plan_lint_findings(plan: list) -> list:
     return out
 
 
+def _fmt_eng(v) -> str:
+    """Engineering-notation cell for the device-utilization table
+    (flops/bytes counts), em-dash when absent; the ladder itself is
+    shared with compilestats (runtime/devprof.fmt_eng)."""
+    if v is None:
+        return "—"
+    from ..runtime.devprof import fmt_eng
+
+    return fmt_eng(v)
+
+
 _WF_CAP = 120      # bars per job (longest-first keeps the picture honest)
 
 
@@ -431,6 +442,38 @@ def _render_doc(log_dir: str, live: bool) -> str:
             rows_html.append(
                 f"<tr class=task><td colspan=7>&nbsp;&nbsp;"
                 f"{html.escape(label)}: {html.escape(desc)}</td></tr>")
+        # per-stage device utilization (runtime/devprof metrics riding the
+        # stage record): measured device seconds, XLA flops/bytes, peak
+        # executable footprint and the achieved roofline fraction
+        dev = [e for e in stages if e["metrics"].get("device_s")]
+        if dev:
+            cells = ["<table class=devtab><tr><th>stage</th>"
+                     "<th>device s</th><th>dispatches</th><th>FLOPs</th>"
+                     "<th>bytes</th><th>peak mem</th><th>roofline</th>"
+                     "</tr>"]
+            for e in dev:
+                m = e["metrics"]
+                rf = m.get("roofline_frac")
+                bar = ""
+                if rf is not None:
+                    pct = max(0.0, min(1.0, float(rf))) * 100
+                    bar = (f"<span class=rlbar><span class=rlfill "
+                           f"style=\"width:{pct:.2f}%\"></span></span> "
+                           f"{pct:.2f}%")
+                cells.append(
+                    f"<tr><td>{e.get('no', '?')} "
+                    f"[{html.escape(str(e.get('kind', '')))}]</td>"
+                    f"<td>{m.get('device_s', 0):.4f}</td>"
+                    f"<td>{int(m.get('device_dispatches', 0))}</td>"
+                    f"<td>{_fmt_eng(m.get('flops'))}</td>"
+                    f"<td>{_fmt_eng(m.get('device_bytes'))}</td>"
+                    f"<td>{_fmt_eng(m.get('hbm_peak'))}</td>"
+                    f"<td>{bar or '—'}</td></tr>")
+            cells.append("</table>")
+            rows_html.append(
+                f"<tr class=dev><td colspan=7><details><summary>device "
+                f"utilization — {len(dev)} stage(s)</summary>"
+                f"{''.join(cells)}</details></td></tr>")
         for e in stages:
             for s in e.get("exception_sample", []):
                 rows_html.append(
@@ -473,6 +516,13 @@ def _render_doc(log_dir: str, live: bool) -> str:
  tr.running td {{ color: #0a6; font-style: italic; }}
  tr.lint td {{ color: #865; font-size: 12px; border-bottom: none; }}
  tr.wf td {{ border-bottom: none; }}
+ tr.dev td {{ border-bottom: none; }}
+ tr.dev summary {{ font-size: 12px; color: #456; cursor: pointer; }}
+ table.devtab {{ width: auto; font-size: 12px; margin: .3rem 0 .3rem 1rem; }}
+ table.devtab th, table.devtab td {{ padding: .15rem .6rem; }}
+ .rlbar {{ display: inline-block; width: 80px; height: 8px;
+           background: #eee; vertical-align: middle; }}
+ .rlfill {{ display: block; height: 8px; background: #5a9e6f; }}
  code {{ background: #f0f0f0; padding: 0 .3em; }}
  .waterfall summary {{ font-size: 12px; color: #456; cursor: pointer; }}
  .wfrow {{ display: flex; align-items: center; font-size: 11px;

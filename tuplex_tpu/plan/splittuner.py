@@ -77,6 +77,12 @@ class CompileModel:
             os.path.join(d, f"compile_model_{platform}.json") if d else "")
         self.obs: list[list] = []        # [n_ops, seconds]
         self.boundary: list[float] = []
+        # measured warm per-dispatch DEVICE seconds (runtime/devprof:
+        # launch→ready, compile excluded) — the first real device-cost
+        # feature in the split decision: an extra boundary re-dispatches
+        # the downstream segment, so its measured device occupancy joins
+        # the host-side boundary tax below
+        self.device: list[float] = []
         # n_ops -> best-known LOWER BOUND seconds for compiles that have
         # not (yet) finished: a watchdog in the compile queue refreshes
         # this while a compile runs, so a compile that is killed /
@@ -100,10 +106,13 @@ class CompileModel:
                         if isinstance(o, list) and len(o) == 2][-_MAX_OBS:]
             self.boundary = [float(b) for b in
                              d.get("boundary", [])][-_MAX_OBS:]
+            self.device = [float(b) for b in
+                           d.get("device", [])][-_MAX_OBS:]
             self.censored = {int(k): float(v) for k, v in
                              d.get("censored", {}).items()}
         except Exception:   # pragma: no cover - corrupt model: start fresh
             self.obs, self.boundary, self.censored = [], [], {}
+            self.device = []
         self._fit = None
 
     def _save(self) -> None:
@@ -115,6 +124,7 @@ class CompileModel:
                 json.dump({"platform": self.platform, "updated": time.time(),
                            "obs": self.obs[-_MAX_OBS:],
                            "boundary": self.boundary[-_MAX_OBS:],
+                           "device": self.device[-_MAX_OBS:],
                            "censored": {str(k): v for k, v in
                                         self.censored.items()}}, fp)
             os.replace(tmp, self.path)
@@ -149,6 +159,17 @@ class CompileModel:
         with self._lock:
             self.boundary.append(float(seconds))
             self.boundary = self.boundary[-_MAX_OBS:]
+            self._save()
+
+    def record_device_dispatch(self, seconds: float) -> None:
+        """Measured warm device seconds for one stage dispatch (devprof
+        feeds the per-stage warm MEDIAN once per stage per process, so
+        one chatty stage can't flood the window)."""
+        if seconds <= 0:
+            return
+        with self._lock:
+            self.device.append(float(seconds))
+            self.device = self.device[-_MAX_OBS:]
             self._save()
 
     # -- prediction -----------------------------------------------------
@@ -236,6 +257,21 @@ class CompileModel:
                 return b[len(b) // 2]
         return _DEFAULT_BOUNDARY.get(self.platform, _DEFAULT_BOUNDARY_ACCEL)
 
+    def device_dispatch_cost(self) -> float:
+        """The FIXED device-side cost of one extra dispatch, estimated
+        as the smallest measured warm dispatch (runtime/devprof feeds
+        per-stage warm medians); 0.0 before any measurement exists.
+        Minimum, not median: a stage's occupancy is mostly compute that
+        SPLITS with the stage — only the fixed part (launch, output
+        round-trip, lost-fusion floor) is paid per extra boundary, and
+        the cheapest observed dispatch is the best available proxy for
+        it (an upper bound that tightens as small dispatches are
+        observed)."""
+        with self._lock:
+            if self.device:
+                return min(self.device)
+        return 0.0
+
 
 _MODELS: dict[str, CompileModel] = {}
 _MODELS_LOCK = threading.Lock()
@@ -318,7 +354,10 @@ def plan_split(n_ops: int, budget_s: float,
     the accelerator (_split_oversize)."""
     model = model or model_for()
     n_ops = max(int(n_ops), 1)
-    bcost = model.boundary_cost()
+    # per-boundary unit tax: the host-side dispatch+transfer sample plus
+    # the MEASURED device occupancy of one extra dispatch (devprof's warm
+    # launch→ready median; 0.0 until a profiled run exists)
+    bcost = model.boundary_cost() + model.device_dispatch_cost()
     (_, _, _), fitted = model.curve()
     cands = []
     for k in range(1, min(n_ops, max_segments) + 1):

@@ -467,6 +467,47 @@ def _compile_plane_lines(lines: list) -> None:
         lines.append(f"{n} {_fmt_val(v)}")
 
 
+#: devprof stage-report keys exported as per-stage gauges, with their
+#: Prometheus family names (the `stage` label carries the stage cache key)
+_DEVPROF_GAUGES = (
+    ("device_s", "devprof_stage_device_seconds"),
+    ("device_cold_s", "devprof_stage_device_cold_seconds"),
+    ("device_dispatches", "devprof_stage_dispatches"),
+    ("flops", "devprof_stage_flops"),
+    ("device_bytes", "devprof_stage_bytes"),
+    ("hbm_peak", "devprof_stage_hbm_peak_bytes"),
+    ("roofline_frac", "devprof_stage_roofline_frac"),
+    ("hbm_budget_frac", "devprof_stage_hbm_budget_frac"),
+)
+
+
+def _devprof_lines(lines: list) -> None:
+    """Device-plane cost attribution (runtime/devprof): the last report
+    per stage as labeled gauges, so one scrape shows measured device
+    seconds, XLA flops/bytes/peak-memory and the roofline fraction next
+    to the latency histograms the dispatch path already records
+    (``device_dispatch_seconds{stage,state}``)."""
+    try:
+        from . import devprof
+    except Exception:       # pragma: no cover - import cycle safety
+        return
+    reps = devprof.reports()
+    if not reps:
+        return
+    trunc = devprof.STAGE_LABEL_LEN     # one truncation for histogram
+    for key, fam in _DEVPROF_GAUGES:    # AND gauge labels: PromQL joins
+        rows = [(tag, r[key]) for tag, r in sorted(reps.items())
+                if key in r]
+        if not rows:
+            continue
+        n = _PREFIX + fam
+        lines.append(f"# TYPE {n} gauge")
+        for tag, v in rows:
+            lines.append(
+                f"{n}{_fmt_labels((('stage', tag[:trunc]),))} "
+                f"{_fmt_val(v)}")
+
+
 def render_prometheus(reg: Optional[Registry] = None) -> str:
     """The full scrape: registry histograms + gauges, bridged xferstats
     counter families, compile-plane stats, and the health state as
@@ -509,6 +550,7 @@ def render_prometheus(reg: Optional[Registry] = None) -> str:
             lines.append(f"{n}{_fmt_labels(lk)} {_fmt_val(v)}")
 
     _compile_plane_lines(lines)
+    _devprof_lines(lines)
 
     # health
     h = reg.health()

@@ -107,6 +107,9 @@ class JobService:
         self._last_reject_t = float("-inf")
         self._last_turn_done_t = time.monotonic()
         telemetry.apply_options(o)
+        from ..runtime import devprof
+
+        devprof.apply_options(o)   # serve CLI builds options Context-less
         self._register_telemetry(o)
         if autostart:
             self.start()

@@ -71,6 +71,7 @@ def _stage_rows(stages, model) -> tuple[list, dict]:
             continue
         n_ops = len(st.ops)
         row = {"i": i, "kind": kind, "n_ops": n_ops,
+               "key": st.key(),
                "interpreter": bool(st.force_interpret),
                "cpu_compile": bool(getattr(st, "cpu_compile", False))}
         if not st.force_interpret:
@@ -89,9 +90,41 @@ def _stage_rows(stages, model) -> tuple[list, dict]:
     return rows, {fp: ix for fp, ix in by_fp.items() if len(ix) > 1}
 
 
+def _cost_line(entry: Optional[dict]) -> Optional[str]:
+    """One human line from a devprof stage-index entry (runtime/devprof):
+    the measured device-plane record a PREVIOUS run of this stage left in
+    the AOT cache dir — ``stage.key()`` is content-derived, so planning
+    the same script again computes the same key. Explicit about the two
+    nothing-to-show cases instead of printing blanks."""
+    from ..runtime import devprof
+
+    if entry is None:
+        return None     # never ran: the caller prints nothing extra
+    ana = entry.get("analysis")
+    if ana is None:
+        return ("device analysis UNAVAILABLE (backend returned nothing; "
+                "measured device "
+                f"{entry.get('device_s_per_dispatch', 0.0) * 1e3:.1f} "
+                "ms/dispatch)")
+    cost = devprof.StageCost.from_dict(ana)
+    bits = [devprof.fmt_flops(cost.flops),
+            f"{devprof.fmt_bytes(cost.bytes_accessed)} accessed",
+            f"peak {devprof.fmt_bytes(cost.peak_bytes)}"]
+    ds = entry.get("device_s_per_dispatch")
+    if ds:
+        bits.append(f"device {ds * 1e3:.1f} ms/dispatch")
+    rf = entry.get("roofline_frac")
+    if rf:
+        bits.append(f"roofline {rf * 100:.1f}%")
+    if cost.partial:
+        bits.append("(partial analysis)")
+    return "measured cost: " + ", ".join(bits)
+
+
 def main(script: str, platform: Optional[str] = None) -> int:
     from ..plan.physical import plan_stages
     from ..plan.splittuner import model_for
+    from ..runtime import devprof
 
     try:
         captured = _capture_plans(script)
@@ -108,10 +141,14 @@ def main(script: str, platform: Optional[str] = None) -> int:
 
     model = model_for(platform)
     (_, _, curve_c), fitted = model.curve()
+    dev_cost = model.device_dispatch_cost()
     print(f"compile model: platform={model.platform} "
           f"{'measured curve' if fitted else 'default curve'} "
           f"(exponent {curve_c:.2f}), "
-          f"boundary cost {model.boundary_cost() * 1e3:.1f} ms")
+          f"boundary cost {model.boundary_cost() * 1e3:.1f} ms"
+          + (f", device dispatch {dev_cost * 1e3:.1f} ms (measured)"
+             if dev_cost > 0 else ""))
+    cost_index = devprof.load_stage_index()
     rc = 0
     for pi, (action, sink, options) in enumerate(captured):
         print(f"\nplan {pi + 1} ({action}):")
@@ -142,13 +179,28 @@ def main(script: str, platform: Optional[str] = None) -> int:
             print(f"{head}: {', '.join(bits)}")
             if row.get("split"):
                 print(f"    {row['split']}")
+            if not row.get("interpreter"):
+                cl = _cost_line(cost_index.get(row.get("key", "")))
+                if cl:
+                    print(f"    {cl}")
         saved = 0.0
+        by_i = {r["i"]: r for r in rows}
         for fp, ix in dedup.items():
             dupes = ix[1:]
             saved += sum(r["predicted_s"] for r in rows
                          if r["i"] in dupes and r.get("predicted_s"))
             print(f"  dedup: stages {ix} share one executable "
                   f"(fingerprint {fp[:12]}…)")
+            # the shared executable's measured device-plane cost (any
+            # member's index entry — they dedup to one compile)
+            gl = next((cl for i2 in ix
+                       if (cl := _cost_line(cost_index.get(
+                           by_i.get(i2, {}).get("key", ""))))), None)
+            if gl:
+                print(f"    group {gl}")
+            else:
+                print("    group cost: no record yet (stages never ran "
+                      "with devprof on)")
         budget = options.get_float("tuplex.tpu.compileBudgetS", 480.0)
         line = (f"  predicted compile total: {total:.1f}s serial"
                 + (f", {total - saved:.1f}s after dedup" if saved else ""))
