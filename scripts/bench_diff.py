@@ -60,6 +60,34 @@ HIGHER_BETTER = {
     "flops": None,                   # informational (plan-dependent)
     "device_bytes": None,            # informational (plan-dependent)
     "device_dispatches": None,
+    # exception-plane observability (runtime/excprof): the fraction of
+    # rows leaking off the compiled fast path must not grow, nor the
+    # process-global drift vs the plan-time baseline — both regress like
+    # perf (a rate jump means the normal-case speculation decayed). The
+    # leaf-name rule gates serve_bench's per-tenant dotted twins
+    # (tenants.<t>.exception_rate) too. Tier-mix fractions: rows falling
+    # ALL the way to the interpreter must not grow; the exact-exit and
+    # general shares are informational (a shift between them is a plan
+    # change, not a regression — only the interpreter tail is pure tax).
+    "exception_rate": False,
+    "drift_score": False,
+    # matched via the two-segment rule in direction(): the leaf
+    # 'interpreter' alone is too generic to gate, so the tier-mix keys
+    # register under their parent — "resolve_tier_mix.interpreter"
+    # (Metrics.as_dict) and "tier_mix.interpreter" (serve_bench's
+    # tenants.<t>.tier_mix.interpreter) both resolve here
+    "resolve_tier_mix.interpreter": False,
+    "tier_mix.interpreter": False,
+    "resolve_tier_mix.exact_exit": None,
+    "resolve_tier_mix.general": None,
+    "rows_seen": None,               # informational (dataset-dependent)
+    # chaos drift scenario (scripts/chaos_bench.py): windows until the
+    # respecialize signal trips after the shift / until health recovers
+    # after the revert — detection and recovery latency gate like p99;
+    # whether the signal fired at all must not fall (1 -> 0 is a break)
+    "drift_trip_windows": False,
+    "drift_recover_windows": False,
+    "respecialize_fired": True,
     "analyzer_ms": False,
     "spread": False,
     "wall_s": False,
@@ -118,12 +146,18 @@ def value_direction(meta: dict):
 
 def direction(key: str, meta: dict):
     """Direction for a (possibly dotted) key: the leaf name decides, so
-    ``concurrent.p99`` compares like ``p99``; "value" defers to the
-    file's unit/metric."""
+    ``concurrent.p99`` compares like ``p99``; when the leaf alone is
+    unknown the last TWO segments are tried (``tenants.a.tier_mix.
+    interpreter`` gates like ``tier_mix.interpreter`` — 'interpreter'
+    by itself is too generic to register); "value" defers to the file's
+    unit/metric."""
     leaf = key.rsplit(".", 1)[-1]
     if leaf == "value":
         return value_direction(meta)
-    return HIGHER_BETTER.get(leaf, HIGHER_BETTER.get(key))
+    if leaf in HIGHER_BETTER:
+        return HIGHER_BETTER[leaf]
+    leaf2 = ".".join(key.split(".")[-2:])
+    return HIGHER_BETTER.get(leaf2, HIGHER_BETTER.get(key))
 
 
 def compare(old: dict, new: dict, threshold: float,
@@ -133,8 +167,11 @@ def compare(old: dict, new: dict, threshold: float,
     meta = meta or {}
     shared = sorted(set(old) & set(new))
     if keys:
+        # match full dotted keys, bare leaves, and the two-segment form
+        # direction() resolves (tier_mix.interpreter under tenants.<t>.)
         shared = [k for k in shared if k in keys
-                  or k.rsplit(".", 1)[-1] in keys]
+                  or k.rsplit(".", 1)[-1] in keys
+                  or ".".join(k.split(".")[-2:]) in keys]
     for k in shared:
         ov, nv = old[k], new[k]
         delta = (nv - ov) / abs(ov) if ov else (0.0 if nv == ov else

@@ -26,6 +26,13 @@ Fault classes:
                   admitting a job (``serve:crash-after-admit:once``); a
                   restarted process over the same root requeues it from
                   the journal exactly once and completes it
+  drift           no injected faults — the chaos is the DATA: one
+                  tenant's input distribution shifts mid-run (half the
+                  rows' facts cell breaks), the exception-plane EWMA
+                  (runtime/excprof) must trip respecialize_recommended
+                  and the degraded `exception_drift` health state within
+                  one window, and both must recover on their own once
+                  the shift reverts
 
 Each class reports wall seconds, jobs ok/failed, retries and compile
 kills, and the worst + final health state. The output is one BENCH-style
@@ -102,6 +109,15 @@ def _run_thread_class(name, spec, ctx, csvs, want, state_dir,
     os.environ["TUPLEX_AOT_CACHE"] = os.path.join(state_dir, f"aot-{name}")
     CQ.clear()
     CQ._TIMEOUTS.clear()
+    # ... and a fresh exception-plane slate: the drift windows/EWMA are
+    # process-global and sticky, so a fault class that legitimately
+    # pushes partitions to the interpreter (dispatch-flake) must not
+    # inherit the previous class's anchor — or leave ITS drift score
+    # pinning the exception_drift health check degraded at the final
+    # health read of a later class
+    from tuplex_tpu.runtime import excprof as _EXP
+
+    _EXP.clear()
     _set_faults(spec, state_dir, name)
     opts = ContextOptions(ctx.options_store.to_dict())
     if deadline is not None:
@@ -156,6 +172,132 @@ def _run_thread_class(name, spec, ctx, csvs, want, state_dir,
             "deadline_timeouts": stats.get("deadline_timeouts", 0),
             "health_worst": worst, "health_final": final,
             "fault": spec or "none"}
+
+
+def _shift_csv(src: str, dst: str, frac: float = 0.5) -> str:
+    """The injected distribution shift: rewrite `frac` of the rows'
+    "facts and features" cell to the generator's broken-facts shape, so
+    extractBd/Ba/Sqft raise ValueError on them — same schema, same
+    pipeline, radically different exception profile."""
+    import csv
+
+    from tuplex_tpu.models import zillow
+
+    period = max(2, int(round(1.0 / max(frac, 1e-6))))
+    with open(src, newline="") as fin, open(dst, "w", newline="") as fout:
+        r = csv.DictReader(fin)
+        w = csv.DictWriter(fout, fieldnames=zillow.COLUMNS)
+        w.writeheader()
+        for i, row in enumerate(r):
+            if i % period == 0:
+                row["facts and features"] = "-- , contact agent"
+            w.writerow(row)
+    return dst
+
+
+def _run_drift_class(name, ctx, state_dir, rows):
+    """The `drift` scenario (runtime/excprof acceptance): one tenant's
+    input distribution shifts mid-run — the windowed EWMA must leave the
+    plan-time-anchored baseline, trip ``respecialize_recommended`` and
+    the degraded `exception_drift` health state within one window, then
+    RECOVER to ok once the shift reverts. No injected faults: the chaos
+    here is the data itself."""
+    from tuplex_tpu.core.options import ContextOptions
+    from tuplex_tpu.exec import compilequeue as CQ
+    from tuplex_tpu.models import zillow
+    from tuplex_tpu.runtime import excprof, telemetry
+    from tuplex_tpu.serve import JobService, request_from_dataset
+
+    clean = os.path.join(state_dir, "drift-clean.csv")
+    zillow.generate_csv(clean, rows, seed=11)
+    shifted = _shift_csv(clean, os.path.join(state_dir,
+                                             "drift-shifted.csv"))
+    want = zillow.run_reference_python(clean)
+    # fresh compile plane (an inherited `.timeout` negative-cache marker
+    # from the smoke classes' tight deadline would degrade the stage to
+    # the interpreter WHOLESALE — rate 1.0 on clean traffic, no drift
+    # signal left to measure) + fresh exception-plane state, with a short
+    # window/half-life so the scenario runs in seconds
+    os.environ["TUPLEX_AOT_CACHE"] = os.path.join(state_dir, f"aot-{name}")
+    CQ.clear()
+    CQ._TIMEOUTS.clear()
+    _set_faults("", state_dir, name)
+    excprof.clear()
+    window_s = 0.4
+    opts = ContextOptions(ctx.options_store.to_dict())
+    opts.set("tuplex.serve.driftWindowS", window_s)
+    opts.set("tuplex.tpu.excprofHalfLifeS", window_s)
+    tenant = "drifty"
+    svc = JobService(opts)
+    t0 = time.perf_counter()
+    n_jobs = [0]
+
+    def run_one(path):
+        h = svc.submit(request_from_dataset(
+            zillow.build_pipeline(ctx.csv(path)),
+            name=f"{name}-j{n_jobs[0]}", tenant=tenant))
+        n_jobs[0] += 1
+        assert h.wait(1200) == "done", (h.name, h.state, h.error)
+        return h
+
+    def settle():
+        time.sleep(window_s * 1.2)
+        excprof.roll()
+
+    try:
+        # phase A — the plan-normal era: clean traffic calibrates the
+        # anchor (first rolled window) and the EWMA
+        h = run_one(clean)
+        assert h.result() == want, "drift: wrong clean-phase output"
+        settle()
+        run_one(clean)
+        settle()
+        assert not excprof.respecialize_recommended(tenant), \
+            f"drift: tripped on clean traffic " \
+            f"(score {excprof.drift_score(tenant):.2f})"
+        # phase B — the shift: same pipeline, dirty facts
+        trip_windows = 0
+        for _ in range(6):
+            run_one(shifted)
+            settle()
+            trip_windows += 1
+            if excprof.respecialize_recommended(tenant):
+                break
+        fired = excprof.respecialize_recommended(tenant)
+        peak = excprof.drift_score(tenant)
+        assert fired, f"drift: never tripped (score {peak:.2f})"
+        health_shift = telemetry.health()["state"] \
+            if telemetry.enabled() else "degraded"
+        assert health_shift != "ok", \
+            "drift: health stayed ok through the shift"
+        # phase C — revert: clean traffic again, the EWMA must decay
+        # below threshold and health must return to ok on its own
+        recover_windows = 0
+        for _ in range(30):
+            run_one(clean)
+            settle()
+            recover_windows += 1
+            if not excprof.respecialize_recommended(tenant):
+                break
+        assert not excprof.respecialize_recommended(tenant), \
+            f"drift: never recovered " \
+            f"(score {excprof.drift_score(tenant):.2f})"
+        final = telemetry.health()["state"] \
+            if telemetry.enabled() else "ok"
+        assert final == "ok", f"drift: health did not recover ({final})"
+    finally:
+        svc.close()
+    wall = time.perf_counter() - t0
+    rep = excprof.scope_report(tenant)
+    return {"wall_s": round(wall, 3), "jobs": n_jobs[0],
+            "jobs_ok": n_jobs[0], "jobs_failed_clean": 0,
+            "retries": 0, "respecialize_fired": int(fired),
+            "drift_trip_windows": trip_windows,
+            "drift_recover_windows": recover_windows,
+            "drift_peak": round(peak, 3),
+            "exception_rate": round(rep["exception_rate"], 4),
+            "health_worst": health_shift, "health_final": final,
+            "fault": "data-shift (no injected faults)"}
 
 
 def _run_crash_class(name, ctx, csvs, want, state_dir, conf_path):
@@ -280,6 +422,14 @@ def main(argv=None) -> int:
             classes[name] = _run_thread_class(
                 name, spec, ctx, csvs, want, state_dir,
                 deadline=deadline)
+        # the drift class runs WITHOUT the tight smoke deadline — its
+        # genuine compiles must live, or the whole stage degrades to the
+        # interpreter and the exception rate saturates at 1.0 for clean
+        # traffic too (no signal left to trip on)
+        print("[chaos] class drift (mid-run distribution shift)",
+              file=sys.stderr, flush=True)
+        classes["drift"] = _run_drift_class("drift", ctx, state_dir,
+                                            args.rows)
         if not args.smoke:
             print("[chaos] class serve-crash (subprocess)",
                   file=sys.stderr, flush=True)
@@ -287,8 +437,11 @@ def main(argv=None) -> int:
                 "serve-crash", ctx, csvs, want, state_dir, conf_path)
 
         base = classes["baseline"]["wall_s"]
+        # the drift class's wall is dominated by its deliberate window
+        # sleeps + fresh compiles, not a fault path — it reports its own
+        # trip/recover latencies instead of gating the worst-class wall
         worst = max(v["wall_s"] for k, v in classes.items()
-                    if k != "baseline")
+                    if k not in ("baseline", "drift"))
         result = {
             "metric": "chaos_zillow_worst_class_wall_s",
             "value": worst,
@@ -317,6 +470,8 @@ def main(argv=None) -> int:
             "compile-hang class never killed a compile child"
         assert classes["serve-retry"]["retries"] >= 1, \
             "serve-retry class never retried"
+        assert classes["drift"]["respecialize_fired"] == 1, \
+            "drift class never recommended respecialization"
         print("chaos-bench OK", file=sys.stderr)
     return 0
 

@@ -1,0 +1,127 @@
+#!/usr/bin/env python3
+"""Exception-plane smoke test: run the zillow model pipeline with the
+exception profiler ON (the default) and assert the ISSUE-13 acceptance
+chain — the plan-time baseline was captured, every stage that saw rows
+carries the excprof stage metrics (rows_seen / exception_rate / per-tier
+retired counts), the dirty rows' codes are attributed per stage x op x
+code AND land inside the plan-time expected inventory (zero unexpected
+codes on the bundled generator), sampled deviant rows were captured, and
+the SAME numbers appear in the Prometheus /metrics exposition, the
+Metrics.as_dict() bench keys and the history excprof event the
+dashboard + `excstats` CLI read.
+
+Run directly (CI wires it as a tier-1 test via tests/test_excprof.py):
+
+    JAX_PLATFORMS=cpu python scripts/excprof_smoke.py
+
+Exits 0 and prints one `excprof-smoke OK ...` line on success; any
+assertion failure is a non-zero exit. EXCPROF_SMOKE_ROWS overrides the
+input size (default 400 — matching tests/test_zillow_model.py so a warm
+AOT artifact cache skips the XLA compiles)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))          # run from anywhere
+
+N_ROWS = int(os.environ.get("EXCPROF_SMOKE_ROWS", "400"))
+
+
+def main() -> int:
+    import tuplex_tpu
+    from tuplex_tpu.runtime import excprof, telemetry
+    from tuplex_tpu.models import zillow
+
+    with tempfile.TemporaryDirectory() as d:
+        data = os.path.join(d, "zillow.csv")
+        zillow.generate_csv(data, N_ROWS, seed=7)
+        ctx = tuplex_tpu.Context({"tuplex.logDir": d,
+                                  "tuplex.webui.enable": True})
+        assert excprof.enabled(), \
+            "excprof disabled (TUPLEX_EXCPROF=0 set?) — nothing to smoke"
+        got = zillow.build_pipeline(ctx.csv(data)).collect()
+        assert got == zillow.run_reference_python(data), \
+            "exception profiling changed pipeline output"
+
+        # plan-time baselines were captured for the executed stages
+        bases = excprof.baselines()
+        assert bases, "no plan-time baseline captured"
+
+        # the zillow generator's ~4-6% dirt must show up as attributed
+        # exception traffic: rows seen, a positive-but-small rate, codes
+        # keyed (code, op) inside the plan inventory — zero unexpected
+        reps = excprof.reports()
+        assert reps, "no exception-plane reports"
+        seen = sum(r["rows"] for r in reps.values())
+        errs = sum(r["errs"] for r in reps.values())
+        assert seen >= N_ROWS, (seen, N_ROWS)
+        assert errs > 0, "zillow dirt produced no exception rows"
+        coded = {k: r for k, r in reps.items() if r["codes"]}
+        assert coded, "no per-code attribution"
+        for key, r in coded.items():
+            assert r["unexpected"] == 0, \
+                (key, "codes outside the plan-time inventory", r)
+            base = r.get("baseline")
+            assert base is not None and base["codes"], (key, r)
+        # ... and each erring row was attributed to a resolve tier
+        tiers = {}
+        for r in reps.values():
+            for t, n in r["tiers"].items():
+                tiers[t] = tiers.get(t, 0) + n
+        assert tiers, "no resolve-tier attribution"
+
+        # sampled deviant rows: bounded, repr-truncated
+        samples = excprof.samples()
+        assert samples, "no deviant rows sampled"
+        for (key, code), caps in samples.items():
+            assert 0 < len(caps) <= 3, (key, code, caps)
+            assert all(len(c) <= 161 for c in caps), (key, code, caps)
+
+        # the stage metrics carry the flat excprof keys -> bench JSON
+        ex_stages = [m for m in ctx.metrics.stages if m.get("rows_seen")]
+        assert ex_stages, "no stage metrics carry rows_seen"
+        md = ctx.metrics.as_dict()
+        assert md["exception_rate"] > 0.0, md["exception_rate"]
+        assert 0.0 < md["exception_rate"] < 0.5, md["exception_rate"]
+        mix = md["resolve_tier_mix"]
+        assert abs(sum(mix.values()) - 1.0) < 1e-6, mix
+
+        # the same numbers reach the Prometheus exposition ...
+        text = telemetry.render_prometheus()
+        for fam in ("tuplex_excprof_rows_total",
+                    "tuplex_excprof_exception_rows",
+                    "tuplex_excprof_exception_rate",
+                    "tuplex_excprof_resolve_tier_rows",
+                    "tuplex_excprof_drift_score",
+                    "tuplex_excprof_respecialize_recommended"):
+            assert fam in text, f"{fam} missing from /metrics exposition"
+
+        # ... and the history excprof event the dashboard / excstats read
+        hist = os.path.join(d, "tuplex_history.jsonl")
+        exev = None
+        with open(hist) as fp:
+            for line in fp:
+                r = json.loads(line)
+                if r.get("event") == "excprof":
+                    exev = r
+        assert exev is not None, "no excprof event in the history file"
+        assert exev["stages"] and exev["samples"], exev
+        from tuplex_tpu.history.recorder import render_report
+
+        html = open(render_report(d)).read()
+        assert "exception plane" in html, "dashboard drift panel missing"
+
+        print(f"excprof-smoke OK — {len(reps)} stage(s), "
+              f"{errs}/{seen} rows off the fast path "
+              f"(rate {md['exception_rate'] * 100:.2f}%), tiers {tiers}, "
+              f"{len(samples)} sampled stage x code bucket(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -169,6 +169,26 @@ def main(argv=None) -> int:
                 conc_hist.merge(h)
         tele = conc_hist.percentiles()
 
+        # per-tenant exception plane (runtime/excprof, scoped like the
+        # xferstats counter families): the exception RATE and which
+        # resolve tier the deviant rows landed on, per tenant — latency
+        # percentiles alone can hide a tenant quietly paying the
+        # interpreter tax on every row. bench_diff gates the dotted
+        # exception_rate / tier_mix.interpreter keys like perf.
+        from tuplex_tpu.runtime import excprof
+
+        tenants = {}
+        for t in sorted(excprof.scopes()):
+            rep = excprof.scope_report(t)
+            if not rep["rows"]:
+                continue
+            tenants[t] = {
+                "exception_rate": round(rep["exception_rate"], 5),
+                "tier_mix": {k: round(v, 4)
+                             for k, v in rep["tier_mix"].items()},
+                "drift_score": round(rep["drift_score"], 4),
+            }
+
         result = {
             "metric": "serve_zillow_p99_latency_s",
             "value": round(_pct(sorted(conc_lat), 0.99), 4),
@@ -182,6 +202,7 @@ def main(argv=None) -> int:
             if conc_wall > 0 else 0.0,
             "telemetry_p99": round(tele["p99"], 4),
             "telemetry_count": tele["count"],
+            "tenants": tenants,
         }
         svc.close()
         ctx.close()
@@ -200,6 +221,13 @@ def main(argv=None) -> int:
         if _T.enabled():
             assert result["telemetry_count"] == args.jobs, result
             assert result["telemetry_p99"] >= 0.8 * result["value"], result
+        from tuplex_tpu.runtime import excprof as _EX
+
+        if _EX.enabled():
+            # the exception plane saw every tenant: rows were attributed
+            # per scope even when nothing erred (rate 0 is a statement,
+            # not an absence)
+            assert result["tenants"], result
         print("serve-bench OK", file=sys.stderr)
     return 0
 

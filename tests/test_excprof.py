@@ -1,0 +1,557 @@
+"""Exception-plane observability (runtime/excprof): windowed accounting,
+plan-time baseline capture, EWMA drift trip + recover, the
+respecialize_recommended signal and its health check, sampled-row bounds
++ truncation, the kill-switch zero-alloc contract, per-tenant scoping,
+Prometheus/Metrics/history exposition, the excstats CLI, the
+`.nodeser` deserialize-defect negative cache (exec/compilequeue) and the
+zillow smoke (scripts/excprof_smoke.py) tier-1 wiring."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from tuplex_tpu.runtime import excprof as EX
+from tuplex_tpu.runtime import telemetry as T
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: short deterministic window for the drift tests: dt/half-life >= 2
+#: per settle() below, so one window moves the EWMA by >= 75% of the gap
+WIN = 0.05
+
+
+@pytest.fixture(autouse=True)
+def _fresh_excprof():
+    EX.clear()
+    EX.enable(True)
+    EX.configure(window_s=10.0, half_life_s=30.0, threshold=0.5,
+                 sample_k=3, normal_rate=0.05)
+    yield
+    EX.clear()
+    EX.enable(True)
+    EX.configure(window_s=10.0, half_life_s=30.0, threshold=0.5,
+                 sample_k=3, normal_rate=0.05)
+
+
+class _Stage:
+    """Plan-stage stub: exactly the surface capture_baseline touches."""
+
+    def __init__(self, key, codes=(2, 101), tier="general+interpreter",
+                 pruned=False):
+        self._key, self._codes, self._tier, self._pruned = \
+            key, codes, tier, pruned
+
+    def key(self):
+        return self._key
+
+    def resolve_plan(self):
+        return SimpleNamespace(codes=tuple(self._codes), tier=self._tier)
+
+    def speculation_pruned(self):
+        return self._pruned
+
+
+def _packed(*code_op_pairs):
+    import numpy as np
+
+    return np.array([c | (op << 8) for c, op in code_op_pairs],
+                    dtype=np.int64)
+
+
+def _settle():
+    time.sleep(WIN * 2.2)
+    EX.roll()
+
+
+# ---------------------------------------------------------------------------
+# baseline capture
+# ---------------------------------------------------------------------------
+
+def test_baseline_capture_idempotent():
+    EX.capture_baseline(_Stage("s1", codes=(2, 5)))
+    EX.capture_baseline(_Stage("s1", codes=(1,), tier="none"))  # ignored
+    b = EX.baselines()["s1"]
+    assert b["codes"] == frozenset({2, 5})
+    assert b["tier"] == "general+interpreter"
+    assert b["pruned"] is False
+
+
+def test_baseline_survives_broken_stage():
+    class _Broken:
+        def key(self):
+            return "sB"
+
+        def resolve_plan(self):
+            raise RuntimeError("no plan")
+
+        def speculation_pruned(self):
+            return False
+
+    EX.capture_baseline(_Broken())
+    assert EX.baselines()["sB"]["codes"] == frozenset()
+
+
+# ---------------------------------------------------------------------------
+# recording: device unpack + tier outcomes + reports
+# ---------------------------------------------------------------------------
+
+def test_note_device_counts_codes_and_unexpected():
+    EX.capture_baseline(_Stage("s1", codes=(2,)))
+    # 3x VALUEERROR(2)@op3 (expected), 2x KEYERROR(5)@op4 (UNEXPECTED),
+    # plus 4 rows that never reached the device
+    EX.note_device("s1", 100, _packed((2, 3), (2, 3), (2, 3),
+                                      (5, 4), (5, 4)), fallback_rows=4)
+    r = EX.reports()["s1"]
+    assert r["rows"] == 100 and r["errs"] == 9 and r["fallback"] == 4
+    assert r["unexpected"] == 2
+    assert r["codes"][(2, 3)] == 3 and r["codes"][(5, 4)] == 2
+    assert r["codes"][(110, 0)] == 4          # PYTHON_FALLBACK bucket
+    assert r["rate"] == pytest.approx(0.09)
+    assert r["baseline"]["codes"] == [2]
+
+
+def test_note_outcomes_tier_attribution():
+    EX.note_device("s1", 50, _packed((2, 3), (101, 7)))
+    EX.note_outcomes("s1", [(101, 7)], "general")
+    EX.note_outcomes("s1", [(2, 3)], "exact-exit")
+    r = EX.reports()["s1"]
+    assert r["tiers"] == {"general": 1, "exact-exit": 1}
+    assert r["code_tier"] == {(101, "general"): 1, (2, "exact-exit"): 1}
+    assert EX.tier_mix_total() == {"exact_exit": 0.5, "general": 0.5}
+
+
+def test_stage_report_consumes_per_owner():
+    EX.note_device("s1", 100, _packed((2, 3)), owner=1)
+    EX.note_device("s1", 10, None, fallback_rows=10, owner=2)
+    EX.note_outcomes("s1", [(2, 3)], "exact-exit", owner=1)
+    EX.note_tier("s1", "general", 1, 1, 0.25, owner=1)
+    rep = EX.stage_report("s1", owner=1)
+    assert rep["rows_seen"] == 100
+    assert rep["exception_rate"] == pytest.approx(0.01)
+    assert rep["resolve_exact_rows"] == 1
+    assert rep["resolve_general_s"] == pytest.approx(0.25)
+    assert EX.stage_report("s1", owner=1) is None      # consumed
+    rep2 = EX.stage_report("s1", owner=2)              # isolated owner
+    assert rep2["rows_seen"] == 10 and rep2["exception_rate"] == 1.0
+
+
+def test_resolve_latency_lands_in_telemetry_histogram():
+    EX.note_tier("stagekey", "interpreter", 10, 10, 0.5)
+    hists = T.registry().histograms()
+    keys = [lk for (name, lk) in hists
+            if name == "excprof_resolve_seconds"]
+    assert any(dict(lk).get("tier") == "interpreter" for lk in keys)
+
+
+# ---------------------------------------------------------------------------
+# windowing + drift
+# ---------------------------------------------------------------------------
+
+def test_anchor_floors_first_window():
+    EX.configure(window_s=WIN, half_life_s=WIN)
+    EX.note_device("s1", 1000, None, fallback_rows=1)   # rate 0.001
+    _settle()
+    rep = EX.scope_report(None)
+    # clean-plan floor (no baseline registered -> tight 0.005 floor)
+    assert rep["anchor_rate"] == pytest.approx(0.005)
+    assert rep["windows"] == 1
+    assert EX.drift_score(None) == 0.0
+
+
+def test_drift_trips_and_recovers_with_health():
+    EX.configure(window_s=WIN, half_life_s=WIN)
+    EX.capture_baseline(_Stage("s1", codes=(2,)))
+
+    def clean():
+        EX.note_device("s1", 100, _packed(*([(2, 3)] * 5)))   # 5%
+        _settle()
+
+    def dirty():
+        EX.note_device("s1", 100, _packed(*([(2, 3)] * 60)))  # 60%
+        _settle()
+
+    clean()
+    clean()
+    assert not EX.respecialize_recommended()
+    assert T.health()["checks"]["exception_drift"]["state"] == T.OK
+    for _ in range(4):
+        dirty()
+        if EX.respecialize_recommended():
+            break
+    assert EX.respecialize_recommended()
+    assert EX.drift_score() >= 0.5
+    h = T.health()
+    assert h["checks"]["exception_drift"]["state"] == T.DEGRADED
+    assert "respecialization recommended" in \
+        h["checks"]["exception_drift"]["detail"]
+    for _ in range(20):
+        clean()
+        if not EX.respecialize_recommended():
+            break
+    assert not EX.respecialize_recommended()
+    assert T.health()["checks"]["exception_drift"]["state"] == T.OK
+
+
+def test_unexpected_codes_weigh_heavier_than_rate():
+    """Codes OUTSIDE the plan inventory mean the speculation itself is
+    stale: a small absolute rate of them reads as full drift while the
+    same rate of EXPECTED codes reads as none."""
+    EX.configure(window_s=WIN, half_life_s=WIN)
+    EX.capture_baseline(_Stage("s1", codes=(2,)))
+    EX.note_device("s1", 1000, _packed(*([(2, 3)] * 30)))     # 3% expected
+    _settle()
+    assert EX.drift_score() == 0.0
+    for _ in range(3):
+        # same 3% rate, but the codes are not in the inventory
+        EX.note_device("s1", 1000, _packed(*([(5, 4)] * 30)))
+        _settle()
+    assert EX.drift_score() >= 0.5
+    assert EX.respecialize_recommended()
+
+
+def test_empty_windows_decay_toward_anchor():
+    """A tenant that stops sending traffic must not pin the health state
+    degraded forever on stale evidence."""
+    EX.configure(window_s=WIN, half_life_s=WIN)
+    EX.note_device("s1", 100, None, fallback_rows=5)
+    _settle()
+    for _ in range(4):
+        EX.note_device("s1", 100, None, fallback_rows=70)
+        _settle()
+    assert EX.respecialize_recommended()
+    for _ in range(20):       # silence: EMPTY windows roll
+        _settle()
+        if not EX.respecialize_recommended():
+            break
+    assert not EX.respecialize_recommended()
+
+
+# ---------------------------------------------------------------------------
+# per-tenant scoping
+# ---------------------------------------------------------------------------
+
+def test_scope_isolation_across_threads():
+    EX.configure(window_s=WIN, half_life_s=WIN)
+
+    def tenant(name, err):
+        EX.set_scope(name)
+        try:
+            EX.note_device("s1", 100, None, fallback_rows=err)
+            EX.note_outcomes("s1", [(110, 0)] * err, "interpreter")
+        finally:
+            EX.set_scope(None)
+
+    ta = threading.Thread(target=tenant, args=("a", 90))
+    tb = threading.Thread(target=tenant, args=("b", 2))
+    ta.start(), tb.start()
+    ta.join(), tb.join()
+    assert sorted(EX.scopes()) == ["a", "b"]
+    ra, rb = EX.scope_report("a"), EX.scope_report("b")
+    assert ra["rows"] == 100 and ra["errs"] == 90
+    assert rb["rows"] == 100 and rb["errs"] == 2
+    assert ra["tier_mix"]["interpreter"] == 1.0
+    # the '' global window pools both tenants
+    rg = EX.scope_report(None)
+    assert rg["rows"] == 200 and rg["errs"] == 92
+
+
+def test_scope_drift_is_per_tenant():
+    EX.configure(window_s=WIN, half_life_s=WIN)
+    for err_a, err_b in ((5, 5), (5, 5), (80, 5), (80, 5), (80, 5)):
+        EX.set_scope("a")
+        EX.note_device("s1", 100, None, fallback_rows=err_a)
+        EX.set_scope("b")
+        EX.note_device("s1", 100, None, fallback_rows=err_b)
+        EX.set_scope(None)
+        _settle()
+    assert EX.respecialize_recommended("a")
+    assert not EX.respecialize_recommended("b")
+
+
+# ---------------------------------------------------------------------------
+# sampled deviant rows
+# ---------------------------------------------------------------------------
+
+def test_sample_rows_bounded_and_truncated():
+    EX.configure(sample_k=2)
+    for i in range(5):
+        EX.sample_row("s1", 2, ("row", i))
+    EX.sample_row("s1", 5, "x" * 500)
+    s = EX.samples()
+    assert s[("s1", 2)] == ["('row', 0)", "('row', 1)"]     # first K only
+    (long,) = s[("s1", 5)]
+    assert len(long) == 161 and long.endswith("…")
+
+
+def test_sample_row_survives_broken_repr():
+    class _Evil:
+        def __repr__(self):
+            raise RuntimeError("no repr for you")
+
+    EX.sample_row("s1", 2, _Evil())
+    assert EX.samples()[("s1", 2)] == ["<unrepresentable row>"]
+
+
+def test_sample_k_zero_disables_capture():
+    EX.configure(sample_k=0)
+    EX.sample_row("s1", 2, "payload")
+    assert EX.samples() == {}
+
+
+# ---------------------------------------------------------------------------
+# kill switch: nothing recorded, nothing allocated
+# ---------------------------------------------------------------------------
+
+def test_disabled_records_nothing_and_allocates_nothing():
+    EX.enable(False)
+    EX.capture_baseline(_Stage("s1"))
+    EX.note_device("s1", 100, None, fallback_rows=5)
+    EX.note_outcomes("s1", [(2, 3)], "general")
+    EX.note_tier("s1", "general", 5, 5, 0.1)
+    EX.sample_row("s1", 2, "row")
+    assert EX.reports() == {} and EX.baselines() == {}
+    assert EX.samples() == {} and EX.stage_report("s1") is None
+    import tracemalloc
+
+    for _ in range(64):               # warm lazy caches
+        EX.note_device("s1", 100, None, fallback_rows=5)
+    tracemalloc.start()
+    before = tracemalloc.take_snapshot()
+    for _ in range(10000):
+        EX.note_device("s1", 100, None, fallback_rows=5)
+        EX.sample_row("s1", 2, "row")
+    after = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    grown = sum(s.size_diff for s in after.compare_to(before, "lineno")
+                if s.size_diff > 0 and any(
+                    (f.filename or "").replace(os.sep, "/")
+                    .endswith("runtime/excprof.py")
+                    for f in s.traceback))
+    assert grown < 2048, \
+        f"disabled record path allocated {grown} bytes/10k calls"
+
+
+def test_env_kill_switch_wins(monkeypatch):
+    monkeypatch.setenv("TUPLEX_EXCPROF", "0")
+    EX.enable(True)                    # option says on; env must win
+    assert not EX.enabled()
+    monkeypatch.delenv("TUPLEX_EXCPROF")
+    EX.enable(True)
+    assert EX.enabled()
+
+
+# ---------------------------------------------------------------------------
+# exposition: /metrics, Metrics.as_dict, history event + dashboard, CLI
+# ---------------------------------------------------------------------------
+
+def test_prometheus_exposition_families():
+    EX.configure(window_s=WIN, half_life_s=WIN)
+    EX.capture_baseline(_Stage("stage-one", codes=(2,)))
+    EX.set_scope("ten-a")
+    EX.note_device("stage-one", 100, _packed((2, 3), (5, 4)))
+    EX.note_outcomes("stage-one", [(2, 3)], "exact-exit")
+    EX.set_scope(None)
+    _settle()
+    text = T.render_prometheus()
+    assert 'tuplex_excprof_rows_total{stage="stage-one"} 100' in text
+    assert 'tuplex_excprof_exception_rows{stage="stage-one",' \
+        'code="ValueError",op="3"} 1' in text
+    assert 'code="KeyError"' in text
+    assert 'tuplex_excprof_resolve_tier_rows{stage="stage-one",' \
+        'tier="exact-exit"} 1' in text
+    assert 'tuplex_excprof_unexpected_rows{stage="stage-one"} 1' in text
+    assert 'tuplex_excprof_drift_score{scope="ten-a"}' in text
+    assert 'tuplex_excprof_respecialize_recommended{scope="global"}' \
+        in text
+
+
+def test_metrics_asdict_exception_keys():
+    from tuplex_tpu.api.metrics import Metrics
+
+    m = Metrics()
+    m.record_stage({"rows_seen": 100, "exception_rate": 0.10,
+                    "resolve_exact_rows": 4, "resolve_general_rows": 0,
+                    "resolve_interpreter_rows": 6})
+    m.record_stage({"rows_seen": 300, "exception_rate": 0.02,
+                    "resolve_general_rows": 6})
+    d = m.as_dict()
+    # weighted: (100*0.10 + 300*0.02) / 400
+    assert d["exception_rate"] == pytest.approx(0.04)
+    assert d["resolve_tier_mix"]["exact_exit"] == pytest.approx(0.25)
+    assert d["resolve_tier_mix"]["general"] == pytest.approx(0.375)
+    assert d["resolve_tier_mix"]["interpreter"] == pytest.approx(0.375)
+    assert "drift_score" in d
+
+
+def _fake_history(tmp_path):
+    """A history file with one single-job excprof event and one serve-
+    tenant row (the two shapes the dashboard + excstats render)."""
+    events = [
+        {"job": "j1", "event": "job_start", "action": "collect",
+         "stages": ["TransformStage"], "ts": 1.0},
+        {"job": "j1", "event": "excprof", "ts": 2.0,
+         "drift": {"rows": 400, "errs": 17, "exception_rate": 0.0425,
+                   "ewma_rate": 0.04, "anchor_rate": 0.05,
+                   "drift_score": 0.0, "respecialize_recommended": 0,
+                   "windows": 3,
+                   "tier_mix": {"exact_exit": 0.8, "general": 0.2}},
+         "stages": {"deadbeef": {
+             "rows": 400, "rate": 0.0425, "fallback": 0, "unexpected": 0,
+             "codes": {"VALUEERROR#op3": 17},
+             "tiers": {"exact-exit": 16, "general": 1},
+             "baseline": {"codes": ["VALUEERROR", "TYPEERROR"],
+                          "tier": "general+interpreter",
+                          "pruned": False}}},
+         "samples": {"deadbeef": {"VALUEERROR": ["Row('--', 1)"]}}},
+        {"job": "j1", "event": "job_done", "rows": 383, "wall_s": 1.5,
+         "exception_counts": {}, "ts": 3.0},
+        {"job": "sj1", "event": "excprof", "tenant": "drifty", "ts": 4.0,
+         "rows": 1000, "errs": 520, "exception_rate": 0.52,
+         "ewma_rate": 0.5, "drift_score": 0.93,
+         "respecialize_recommended": 1, "windows": 6,
+         "tier_mix": {"interpreter": 1.0}},
+    ]
+    p = tmp_path / "tuplex_history.jsonl"
+    with open(p, "w") as fp:
+        for e in events:
+            fp.write(json.dumps(e) + "\n")
+    return str(tmp_path)
+
+
+def test_dashboard_drift_panel_renders_both_shapes(tmp_path):
+    from tuplex_tpu.history.recorder import render_report
+
+    d = _fake_history(tmp_path)
+    html = open(render_report(d)).read()
+    assert "exception plane" in html
+    assert "VALUEERROR#op3:17" in html
+    assert "Row(&#x27;--&#x27;, 1)" in html            # sample, escaped
+    assert "tenant drifty" in html
+    assert "respecialize recommended" in html          # the serve row
+    assert "VALUEERROR, TYPEERROR" in html             # expected inventory
+
+
+def test_excstats_cli(tmp_path, capsys):
+    from tuplex_tpu.__main__ import main as cli_main
+
+    d = _fake_history(tmp_path)
+    assert cli_main(["excstats", "--log-dir", d]) == 0
+    out = capsys.readouterr().out
+    assert "job j1" in out and "383 rows" in out
+    assert "VALUEERROR#op3:17" in out
+    assert "expected: VALUEERROR, TYPEERROR -> general+interpreter" in out
+    assert "sample VALUEERROR @ deadbeef: Row('--', 1)" in out
+    assert "tenant drifty" in out
+    assert "RESPECIALIZE RECOMMENDED" in out
+    # job filter + empty-dir messaging stay usable
+    assert cli_main(["excstats", "--log-dir", d, "--job", "sj"]) == 0
+    out = capsys.readouterr().out
+    assert "drifty" in out and "job j1" not in out
+    assert cli_main(["excstats", "--log-dir", d, "--job", "zz"]) == 0
+    assert "no exception-plane events" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# span-stream scoping: compile-pool threads carry the submitter's tenant
+# ---------------------------------------------------------------------------
+
+def test_pool_thread_spans_carry_submitter_stream():
+    from tuplex_tpu.exec import compilequeue as CQ
+    from tuplex_tpu.runtime import tracing as TR
+
+    was = TR.enabled()
+    TR.enable(True)
+    try:
+        TR.set_stream("tenant-x")
+        fut = pool_stream = None
+        try:
+            fut = CQ.pool().submit(
+                lambda: (TR.instant("excprof-test-span", "compile"),
+                         TR.current_stream())[1])
+            pool_stream = fut.result(timeout=10)
+        finally:
+            TR.set_stream(None)
+        assert pool_stream == "tenant-x"
+        evs = TR.events_for_stream("tenant-x")
+        assert any(e["name"] == "excprof-test-span" for e in evs)
+        # the reused worker must not leak the tag into the next task
+        assert CQ.pool().submit(TR.current_stream).result(timeout=10) \
+            is None
+    finally:
+        TR.enable(was)
+
+
+# ---------------------------------------------------------------------------
+# `.nodeser` deserialize-defect negative cache (exec/compilequeue)
+# ---------------------------------------------------------------------------
+
+def test_nodeser_marker_skips_doomed_load(tmp_path, monkeypatch):
+    import numpy as np
+
+    import jax
+    from tuplex_tpu.exec import compilequeue as CQ
+
+    monkeypatch.setenv("TUPLEX_AOT_CACHE", str(tmp_path / "aot"))
+    CQ.clear()
+    try:
+        def fn(d):
+            return {"y": d["x"] * 17}
+
+        avals = ({"x": jax.ShapeDtypeStruct((16,), np.int64)},)
+        entry = CQ.compile_traced(fn, avals)
+        (fp,) = [f for f, c in CQ._EXECS.items() if c is entry]
+        # provenance bound: a fresh IN-PROCESS build swept up by a broad
+        # async pin (note_async_defect covers every live spec) is dropped
+        # from the store but must NOT condemn its healthy on-disk
+        # artifact with a permanent marker
+        CQ.note_deserialize_defect(entry)
+        assert CQ.STATS["nodeser_marks"] == 0
+        assert fp not in CQ._EXECS
+        assert not CQ._nodeser_known(fp)
+        # reloaded from disk the entry IS a deserialized executable; when
+        # that one fails its call ("Symbols not found") the verdict
+        # persists — in-process store drops it and the content-addressed
+        # `.nodeser` marker lands on disk
+        entry = CQ.compile_traced(fn, avals)      # aot disk hit
+        CQ.note_deserialize_defect(entry)
+        assert CQ.STATS["nodeser_marks"] == 1
+        assert fp not in CQ._EXECS
+        assert os.path.exists(CQ._nodeser_marker(fp))
+        assert CQ._nodeser_known(fp)
+        # a COLD process (cleared in-memory stores) still knows: the
+        # aot-load of the doomed artifact is skipped outright and the
+        # spec compiles fresh in-process, once — no load + call-fail +
+        # recompile triple-pay
+        CQ.clear()
+        assert CQ._nodeser_known(fp)          # via the on-disk marker
+        snap = CQ.snapshot()
+        entry2 = CQ.compile_traced(fn, avals)
+        d = CQ.delta(snap)
+        assert d["nodeser_skips"] == 1
+        assert d["aot_hits"] == 0, "doomed artifact was still loaded"
+        assert d["stage_compiles"] == 1       # fresh compile, exactly one
+        out = entry2({"x": np.arange(16, dtype=np.int64)})
+        assert int(np.asarray(out["y"])[3]) == 51
+    finally:
+        CQ.clear()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 wiring of the zillow smoke (like scripts/devprof_smoke.py)
+# ---------------------------------------------------------------------------
+
+def test_excprof_smoke_zillow():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "excprof_smoke.py")],
+        capture_output=True, text=True, timeout=580,
+        env={**{k: v for k, v in os.environ.items()
+                if k != "TUPLEX_EXCPROF"}, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    assert "excprof-smoke OK" in out.stdout

@@ -9,6 +9,7 @@ TuplexShell, launched by the `tuplex` console entry point). Subcommands:
     python -m tuplex_tpu lint script.py   # plan-time UDF static analysis
     python -m tuplex_tpu compilestats script.py   # compile forecast
     python -m tuplex_tpu trace out.json   # history -> Chrome trace JSON
+    python -m tuplex_tpu excstats         # exception-plane readout
     python -m tuplex_tpu serve <root>     # multi-tenant job service
     python -m tuplex_tpu version          # print the package version
 
@@ -51,6 +52,17 @@ def main(argv=None) -> int:
     cs.add_argument("script", help="path to a python pipeline script")
     cs.add_argument("--platform", default=None,
                     help="compile-model platform (default: jax backend)")
+    ex = sub.add_parser(
+        "excstats",
+        help="exception-plane readout from the job history: per-stage x "
+             "code fallback counts vs the plan-time inventory, resolve-"
+             "tier mix, drift score + respecialize signal, sampled "
+             "deviant rows (runtime/excprof)")
+    ex.add_argument("--log-dir", default=".",
+                    help="directory holding tuplex_history.jsonl "
+                         "(tuplex.logDir; default .)")
+    ex.add_argument("--job", default=None,
+                    help="only jobs whose id starts with this prefix")
     tr = sub.add_parser(
         "trace",
         help="replay the job history as Chrome trace-event JSON "
@@ -134,6 +146,14 @@ def main(argv=None) -> int:
             return 130
         print(f"serve: {n} job(s) served")
         return 0
+    if args.cmd == "excstats":
+        from .utils.excstats import main as ex_main
+
+        try:
+            return ex_main(args.log_dir, job=args.job)
+        except OSError as e:
+            print(f"excstats: {e}", file=sys.stderr)
+            return 2
     if args.cmd == "trace":
         from .history.recorder import history_to_chrome
 

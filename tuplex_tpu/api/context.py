@@ -46,6 +46,12 @@ class Context:
         from ..runtime import devprof as _dp
 
         _dp.apply_options(self.options_store)
+        # exception-plane observability (runtime/excprof): per-code
+        # fallback attribution + drift detection; TUPLEX_EXCPROF=0 is
+        # the env kill switch that wins over everything
+        from ..runtime import excprof as _ex
+
+        _ex.apply_options(self.options_store)
         self.backend = self._make_backend()
         self.metrics = Metrics()
         from ..history import JobRecorder

@@ -95,6 +95,38 @@ class Metrics:
         return max((int(m.get("hbm_peak", 0)) for m in self.stages),
                    default=0)
 
+    def rowsSeen(self) -> int:
+        """Valid input rows the stages actually processed (the
+        exception-rate denominator; runtime/excprof rides it onto the
+        stage record — rows_out undercounts because filters drop rows)."""
+        return sum(int(m.get("rows_seen", 0)) for m in self.stages)
+
+    def exceptionRate(self) -> float:
+        """Fraction of processed rows that left the compiled fast path
+        with an exception code — INCLUDING rows a resolve tier later
+        retired (that is the rate the drift detector watches; terminal
+        unresolved rows stay separately visible as exception_rows).
+        0.0 when excprof was off or nothing ran."""
+        seen = errs = 0
+        for m in self.stages:
+            n = int(m.get("rows_seen", 0))
+            seen += n
+            errs += n * float(m.get("exception_rate", 0.0))
+        return (errs / seen) if seen else 0.0
+
+    def resolveTierMix(self) -> dict:
+        """Which resolve tier the deviant rows finally landed on, as
+        fractions: {'exact_exit': f, 'general': f, 'interpreter': f}.
+        Summed across stages from the excprof per-tier retired counts."""
+        tiers = {"exact_exit": 0, "general": 0, "interpreter": 0}
+        for m in self.stages:
+            tiers["exact_exit"] += int(m.get("resolve_exact_rows", 0))
+            tiers["general"] += int(m.get("resolve_general_rows", 0))
+            tiers["interpreter"] += int(m.get("resolve_interpreter_rows",
+                                              0))
+        total = sum(tiers.values())
+        return {k: (v / total if total else 0.0) for k, v in tiers.items()}
+
     def d2hBytes(self) -> int:
         """Device->host transfer bytes attributed per stage (the boundary
         tunnel tax the varlen wire / handoff work is judged against)."""
@@ -160,6 +192,14 @@ class Metrics:
             "stage_compiles": self.stageCompileCount(),
             "rows_out": self.totalRowsOut(),
             "exception_rows": self.totalExceptionCount,
+            # exception-plane readouts (runtime/excprof): the observed
+            # exception rate over rows actually processed, the resolve-
+            # tier mix of the deviant rows (bench JSON flattens the dict
+            # to resolve_tier_mix.* dotted keys), and the process-global
+            # drift score vs the plan-time baseline
+            "exception_rate": self.exceptionRate(),
+            "resolve_tier_mix": self.resolveTierMix(),
+            "drift_score": self._drift_score(),
             "analyzer_ms": self.analyzerTimeMs(),
             "plan_fallback_ops": self.planFallbackOps(),
             "analyzer_inferred_ops": self.analyzerInferredOps(),
@@ -173,6 +213,18 @@ class Metrics:
                          if self.counters_source is not None
                          else xferstats.as_dict()),
         }
+
+    @staticmethod
+    def _drift_score() -> float:
+        """Process-global exception-drift score (runtime/excprof EWMA vs
+        the plan-time baseline); 0.0 when excprof is off or no window
+        ever rolled."""
+        try:
+            from ..runtime import excprof
+
+            return float(excprof.drift_score(None))
+        except Exception:   # pragma: no cover - readout is best-effort
+            return 0.0
 
     def as_json(self) -> str:
         import json

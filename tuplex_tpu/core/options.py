@@ -167,6 +167,18 @@ DEFAULTS: dict[str, str] = {
                                             # slots busy and no turn
                                             # finishing for this long ->
                                             # degraded (4x -> unhealthy)
+    "tuplex.serve.driftWindowS": "10",      # exception-plane drift window
+                                            # (runtime/excprof): observed
+                                            # per-tenant exception traffic
+                                            # folds into the EWMA profile
+                                            # every this-many seconds; the
+                                            # drift score compares the
+                                            # EWMA against the tenant's
+                                            # plan-time-anchored baseline
+                                            # and trips
+                                            # respecialize_recommended one
+                                            # window after a distribution
+                                            # shift
     # --- TPU-native keys ---------------------------------------------------
     "tuplex.tpu.deviceBatchSize": "1048576",    # rows per device dispatch
     "tuplex.tpu.padBucketing": "q8",            # q8 | pow2 | exact
@@ -255,6 +267,55 @@ DEFAULTS: dict[str, str] = {
                                             # Like trace/telemetry the
                                             # gate is process-wide and
                                             # the option only turns it ON
+    "tuplex.tpu.excprof": "true",           # exception-plane observability
+                                            # (runtime/excprof.py): per-
+                                            # stage x op x code windowed
+                                            # accounting at the D2H unpack
+                                            # + resolve-tier boundaries,
+                                            # a plan-time baseline snapshot
+                                            # (analyzer inventory + resolve
+                                            # plan) with an EWMA drift
+                                            # detector, the per-tenant
+                                            # respecialize_recommended
+                                            # signal and bounded sampled
+                                            # deviant rows. Default on.
+                                            # TUPLEX_EXCPROF=0 is the env
+                                            # kill switch (wins over all):
+                                            # every record path collapses
+                                            # to one flag check, zero
+                                            # allocation (test-pinned).
+                                            # Like trace/telemetry/devprof
+                                            # the gate is process-wide and
+                                            # the option only turns it ON
+    "tuplex.tpu.excprofHalfLifeS": "30",    # EWMA half-life of the drift
+                                            # detector: how fast the
+                                            # observed exception profile
+                                            # forgets old windows. Shorter
+                                            # = trips faster on a shift
+                                            # but noisier on bursty input
+    "tuplex.tpu.excprofDriftThreshold": "0.5",  # drift_score (0..1) at
+                                            # which respecialize_
+                                            # recommended fires and the
+                                            # exception_drift health check
+                                            # reads degraded
+    "tuplex.tpu.excprofSampleRows": "3",    # deviant rows captured per
+                                            # stage x exception code
+                                            # (first K, repr-truncated to
+                                            # 160 chars) for the dashboard
+                                            # "why did this row fall off
+                                            # the fast path" panel. 0
+                                            # disables capture entirely —
+                                            # row payloads then never
+                                            # leave the exec path
+    "tuplex.tpu.excprofNormalRate": "0.05",  # exception-rate allowance
+                                            # anchoring the drift baseline
+                                            # for stages whose plan-time
+                                            # inventory EXPECTS codes; a
+                                            # code-free static verdict
+                                            # gets a tight 0.005 floor
+                                            # instead (any exception there
+                                            # is evidence the speculation
+                                            # went stale)
     "tuplex.tpu.trace": "false",            # structured span tracing
                                             # (runtime/tracing.py): nested
                                             # spans across plan/compile/

@@ -63,6 +63,7 @@ STATS: dict[str, Any] = {
     "deadline_timeouts": 0, "deadline_skips": 0,
     "subprocess_compiles": 0, "compiles_killed": 0,
     "fork_deadlocks": 0,
+    "nodeser_marks": 0, "nodeser_skips": 0,
 }
 
 _LOCK = threading.Lock()
@@ -121,17 +122,26 @@ class _DaemonPool:
 
     def _run(self) -> None:
         while True:
-            fut, fn, args, kwargs = self._q.get()
+            fut, fn, args, kwargs, stream = self._q.get()
             if not fut.set_running_or_notify_cancel():
                 continue
+            # the submitter's span-stream tag (serve: the running job's
+            # id) rides the queue item so compile/resolve-path spans
+            # recorded on this pool thread stay tenant-tagged; workers
+            # are reused, so the tag is always cleared afterwards
+            if stream is not None:
+                TR.set_stream(stream)
             try:
                 fut.set_result(fn(*args, **kwargs))
             except BaseException as e:  # noqa: BLE001 - future carries it
                 fut.set_exception(e)
+            finally:
+                if stream is not None:
+                    TR.set_stream(None)
 
     def submit(self, fn, *args, **kwargs) -> Future:
         fut: Future = Future()
-        self._q.put((fut, fn, args, kwargs))
+        self._q.put((fut, fn, args, kwargs, TR.current_stream()))
         return fut
 
 
@@ -181,6 +191,8 @@ def clear() -> None:
     with _LOCK:
         _EXECS.clear()
         _TAG.clear()
+        _NODESER.clear()        # the on-disk .nodeser markers remain
+        _DESER.clear()
         for k in STATS:
             STATS[k] = type(STATS[k])()
 
@@ -277,6 +289,76 @@ def _artifact_path(fp: str) -> Optional[str]:
 def _timeout_marker(fp: str):
     path = _artifact_path(fp)
     return None if path is None else path + ".timeout"
+
+
+_NODESER: set = set()       # fingerprints with a known deserialize defect
+_DESER: set = set()         # fps whose CURRENT _EXECS entry came from a
+                            # deserialize (AOT disk hit / fork handback) —
+                            # a fresh in-process compile discards the fp
+                            # again. Provenance bound for the permanent
+                            # .nodeser verdict: an async "Symbols not
+                            # found" pins every live spec for safety, but
+                            # only executables that actually rode the
+                            # serialized-artifact path may durably mark
+                            # their (possibly healthy) artifacts doomed
+
+
+def _nodeser_marker(fp: str):
+    path = _artifact_path(fp)
+    return None if path is None else path + ".nodeser"
+
+
+def _nodeser_known(fp: str) -> bool:
+    """True when this fingerprint's serialized executable is known to be
+    un-deserializable — it fails at LOAD, or loads but cannot RUN (both
+    faces of the XLA:CPU "Symbols not found" gap) — in this process or,
+    via the content-addressed on-disk marker, any earlier one. Cold runs
+    then skip the doomed deserialize outright and compile in-process
+    once, instead of paying load + failure + a recompile (the
+    double-compile the ROADMAP residue names)."""
+    if fp in _NODESER:
+        return True
+    m = _nodeser_marker(fp)
+    return m is not None and os.path.exists(m)
+
+
+def _note_nodeser(fp: str) -> None:
+    """Record one fingerprint's deserialize defect: the in-process set
+    plus the content-addressed on-disk ``.nodeser`` marker every later
+    process consults before paying the doomed load."""
+    with _LOCK:
+        _NODESER.add(fp)
+        STATS["nodeser_marks"] += 1
+    m = _nodeser_marker(fp)
+    if m is None:
+        return
+    try:
+        with open(m, "w") as f:
+            f.write(_platform_salt())
+    except OSError:   # pragma: no cover - marker is best-effort
+        pass
+
+
+def note_deserialize_defect(entry) -> None:
+    """Persist the deserialize-defect verdict for the executable behind
+    `entry` (the object AotJit/_CpuJit just watched fail with "Symbols
+    not found"): drop it from the in-process store — later dedup hits
+    would fail the same way — and write a ``.nodeser`` marker next to
+    the artifact so every later process skips the load. The PERMANENT
+    marker is provenance-bounded: only an entry that itself came off the
+    serialized-artifact path may condemn its artifact — a fresh
+    in-process compile swept up by a broad async pin
+    (AotJit.note_async_defect covers every live spec) is dropped from
+    the store but its perfectly good on-disk artifact stays loadable."""
+    fps: list = []
+    with _LOCK:
+        for fp, c in list(_EXECS.items()):
+            if c is entry:
+                fps.append((fp, fp in _DESER))
+                _EXECS.pop(fp, None)
+    for fp, deserialized in fps:
+        if deserialized:
+            _note_nodeser(fp)
 
 
 def _deadline_known_exceeded(fp: str) -> bool:
@@ -613,7 +695,22 @@ def _compile_in_subprocess(fp: str, lowered, deadline_s: float,
         if not (os.WIFEXITED(status) and os.WEXITSTATUS(status) == 0):
             return None
         with _FORK_GATE:   # PJRT deserialize is native: see the gate
-            return _disk_load(fp, path=path)
+            try:
+                return _disk_load(fp, path=path)
+            except Exception as e:
+                if not deserialize_defect(e):
+                    raise
+                # the child compiled fine but its serialized executable
+                # cannot deserialize back into this parent (the XLA:CPU
+                # "Symbols not found" gap at LOAD time). Persist the
+                # `.nodeser` verdict — later calls and cold processes
+                # then compile this fp in-process outright instead of
+                # re-paying fork + doomed load — and return None: the
+                # caller's in-thread fallback compiles inline, which is
+                # deadline-safe (the finished child just proved this
+                # compile terminates in time).
+                _note_nodeser(fp)
+                return None
     finally:
         if ephemeral is not None:
             try:
@@ -835,11 +932,22 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
             except Exception:   # pragma: no cover - disk best-effort
                 with _LOCK:
                     STATS["aot_errors"] += 1
+        with _LOCK:
+            _DESER.discard(fp)      # current entry is an in-process build
         return _publish(compiled)
 
     try:
         compiled = None
-        if aot_cache_enabled():
+        if aot_cache_enabled() and _nodeser_known(fp):
+            # negative cache for the deserialize-defect gap: this
+            # fingerprint's artifact loads but cannot run ("Symbols not
+            # found") — skip the doomed deserialize and compile fresh
+            # in-process, once, instead of load + call-fail + recompile
+            with _LOCK:
+                STATS["nodeser_skips"] += 1
+            TR.instant("compile:nodeser-skip", "compile",
+                       {"tag": tag[:16], "fp": fp[:12]})
+        elif aot_cache_enabled():
             try:
                 with TR.span("compile:aot-load", "compile") as _sp:
                     _sp.set("tag", tag[:16]).set("fp", fp[:12])
@@ -847,10 +955,17 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
                         compiled = _disk_load(fp)
                     _sp.set("cache",
                             "aot-hit" if compiled is not None else "miss")
-            except Exception:
+                if compiled is not None:
+                    with _LOCK:
+                        _DESER.add(fp)
+            except Exception as e:
                 compiled = None
                 with _LOCK:
                     STATS["aot_errors"] += 1
+                if deserialize_defect(e):
+                    # doomed load at the aot leg: persist the verdict so
+                    # this is the LAST process that pays it
+                    _note_nodeser(fp)
             with _LOCK:
                 STATS["aot_hits" if compiled is not None
                       else "aot_misses"] += 1
@@ -875,7 +990,10 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
                 f"compile of {fp[:12]}… previously exceeded the deadline")
         if compiled is None:
             if deadline_s and deadline_s > 0:
-                if isolation_mode() == "fork":
+                # a known deserialize defect also rules out the FORK
+                # path: its handback rides the same serialized-artifact
+                # load that cannot work for this fp
+                if isolation_mode() == "fork" and not _nodeser_known(fp):
                     # killable child: compile in a forked subprocess and
                     # hand the executable back through the on-disk
                     # artifact store; a blown deadline SIGKILLs the child
@@ -897,6 +1015,7 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
                                       n_ops)
                         with _LOCK:
                             STATS["subprocess_compiles"] += 1
+                            _DESER.add(fp)   # handback = deserialized
                         _publish(compiled)
                     # compiled None: the child died for a NON-deadline
                     # reason — fall through to the in-thread compile so
@@ -1069,13 +1188,32 @@ class AotJit:
                 raise
             # unloadable serialized executable (see deserialize_defect):
             # recompile this spec in-process via the plain jit instead of
-            # demoting the stage to the interpreter
+            # demoting the stage to the interpreter; persist the verdict
+            # so cold runs skip the doomed load (the `.nodeser` marker)
+            note_deserialize_defect(entry)
             self._by_spec[key] = _FALLBACK
             return self._plain()(*args)
 
     def _args_key(self, args):
         avals, key = _args_avals(args)
         return avals, key
+
+    def note_async_defect(self) -> bool:
+        """The deserialize defect surfaced AFTER dispatch returned: jax
+        dispatch is async, so a handback executable that loads-but-
+        cannot-run may only fail when its device work actually executes
+        — at the collect/block site, outside ``__call__``'s handler.
+        Pin every live AOT entry to the plain in-process jit and persist
+        their ``.nodeser`` verdicts. Returns True when something was
+        pinned (the caller retries its dispatch once; a second failure
+        finds nothing left to pin and degrades normally)."""
+        hit = False
+        for key, entry in list(self._by_spec.items()):
+            if entry is not None and entry is not _FALLBACK:
+                note_deserialize_defect(entry)
+                self._by_spec[key] = _FALLBACK
+                hit = True
+        return hit
 
 
 def aot_jit(fn, donate: bool = False, salt: str = "", tag: str = "",

@@ -32,6 +32,7 @@ from ..plan.physical import TransformStage
 from .compilequeue import CompileTimeout
 from ..runtime import columns as C
 from ..runtime import devprof as DP
+from ..runtime import excprof as EX
 from ..runtime import faults
 from ..runtime import tracing as TR
 from ..runtime import xferstats
@@ -149,7 +150,9 @@ class _CpuJit:
                             raise
                         # unloadable serialized executable: recompile
                         # in-process via the plain pinned jit (AotJit's
-                        # fallback, under the cpu pin)
+                        # fallback, under the cpu pin); persist the
+                        # verdict so cold runs skip the doomed load
+                        CQ.note_deserialize_defect(entry)
                         self._by_spec[key] = None
             return self._fn(*args, **kwargs)
 
@@ -533,6 +536,12 @@ class LocalBackend:
                 return res
             except _TierRestart as tr:
                 restarts += 1
+                # the re-run re-records every partition the aborted tier
+                # already processed: back out this execution's exception-
+                # plane accounting so rows_seen/exception_rate and the
+                # drift windows don't double-count
+                if EX.enabled():
+                    EX.discard_stage(stage.key(), owner=id(self))
                 # a degraded tier timing out again steps down once more;
                 # the cap is belt-and-braces (the ladder is 3 rungs)
                 tier = "interpreter" if restarts >= 3 else tr.tier
@@ -559,6 +568,12 @@ class LocalBackend:
         fl_snap = len(self.failure_log)
         metrics: dict[str, Any] = {"fast_path_s": 0.0, "slow_path_s": 0.0,
                                    "general_path_s": 0.0, "compile_s": 0.0}
+        if EX.enabled():
+            # exception-plane baseline (runtime/excprof): snapshot the
+            # plan-time code inventory + resolve-plan verdict BEFORE any
+            # row executes — the drift detector compares live windows
+            # against exactly this expectation
+            EX.capture_baseline(stage)
         device_fn = None
         in_schema = first_part.schema if first_part is not None else None
         skey = stage.key() + "/" + (in_schema.name if in_schema else "") \
@@ -639,6 +654,21 @@ class LocalBackend:
                     # failure_log, AWSLambdaBackend.cc:410-474)
                     from ..utils.logging import get_logger
 
+                    if CQ.deserialize_defect(e):
+                        # the loads-but-cannot-run gap surfaced at the
+                        # COLLECT site (async dispatch: nothing blocked
+                        # between launch and fetch, e.g. devprof off).
+                        # Pin the doomed specs + persist their .nodeser
+                        # markers now so the retry below re-dispatches on
+                        # a fresh in-process compile instead of the same
+                        # defective executable
+                        noted = getattr(device_fn, "note_async_defect",
+                                        None)
+                        if noted is not None and noted():
+                            get_logger("exec").warning(
+                                "deserialized executable failed at "
+                                "collect (%s); recompiling in-process "
+                                "before the retry", str(e)[:200])
                     self.failure_log.append({
                         "stage": skey[:16], "start_index": part.start_index,
                         "rows": part.num_rows, "attempt": 1,
@@ -793,6 +823,15 @@ class LocalBackend:
                                   owner=id(self))
             if rep:
                 metrics.update(rep)
+        except Exception:   # pragma: no cover - attribution best-effort
+            pass
+        # exception-plane accounting (runtime/excprof): rows seen, the
+        # exception rate, unexpected-code rows and the per-tier retired
+        # counts — flat numeric keys riding the same stage record
+        try:
+            exrep = EX.stage_report(stage.key(), owner=id(self))
+            if exrep:
+                metrics.update(exrep)
         except Exception:   # pragma: no cover - attribution best-effort
             pass
         # which tier this stage's rows ALL ran on (tier purity is the
@@ -1160,6 +1199,28 @@ class LocalBackend:
                 raise  # executed before: a real runtime failure
             from ..utils.logging import get_logger
 
+            from . import compilequeue as CQ
+
+            if CQ.deserialize_defect(e):
+                # the fork-handback executable LOADED but its device
+                # work failed when it actually ran — jax dispatch is
+                # async, so the "Symbols not found" gap can surface at
+                # the block/collect site, OUTSIDE AotJit.__call__'s
+                # defect handler. Pin the doomed specs to the plain
+                # in-process jit (persisting their `.nodeser` markers
+                # for cold runs) and retry this partition once on the
+                # recompiled path instead of demoting the stage to the
+                # interpreter. A second failure finds nothing left to
+                # pin and falls through to the normal degrade below.
+                noted = getattr(device_fn, "note_async_defect", None)
+                if noted is not None and noted():
+                    get_logger("exec").warning(
+                        "deserialized executable failed asynchronously "
+                        "(%s); recompiling in-process and retrying the "
+                        "dispatch", str(e)[:200])
+                    return self._dispatch_partition(
+                        part, device_fn, skey, use_comp=use_comp,
+                        stage=stage, packed=packed)
             if use_comp:
                 get_logger("exec").warning(
                     "stage trace failed under compaction (%s: %s); "
@@ -1209,6 +1270,14 @@ class LocalBackend:
         # decided BEFORE the fetch instead of re-derived per row after D2H
         rplan = stage.resolve_plan()
         bufs = rplan.new_buffers() if pending_outs is not None else None
+
+        # deferred exception-plane records (runtime/excprof): a device
+        # failure inside this attempt (e.g. the general tier's compiled
+        # re-run) aborts the whole collect and the task-failure ladder
+        # re-runs the partition — accounting must only commit for the
+        # attempt that succeeds, or the retry double-counts every row
+        # into the stage stats and the drift windows
+        ex_defer: list = []
 
         # device error evidence per fallback row: idx -> (code, operator id).
         # General-tier codes overwrite fast-path ones (supertype decode is
@@ -1307,6 +1376,14 @@ class LocalBackend:
             device_codes.update(
                 zip(err_idx.tolist(), unpack_device_codes(codes)))
             bufs.add_many(err_idx, codes)
+            if EX.enabled():
+                # exception-plane unpack accounting (runtime/excprof):
+                # the raw packed lattice carries code + operator id, so
+                # per-stage x per-op x per-code counts come vectorized
+                # off the same array the resolve buckets consumed
+                ex_defer.append((EX.note_device, (stage.key(), n, codes),
+                                 {"fallback_rows": len(part.fallback),
+                                  "owner": id(self)}))
             compiled_ok = rowvalid & keep & (err == 0)
             fold_vals = []
             while f"#fold{len(fold_vals)}" in outs:
@@ -1318,6 +1395,9 @@ class LocalBackend:
             # no normal-case rows)
             metrics["fast_path_s"] = dispatch_s
             fallback_idx.update(range(n))
+            if EX.enabled():
+                ex_defer.append((EX.note_device, (stage.key(), n, None),
+                                 {"fallback_rows": n, "owner": id(self)}))
 
         # ---- compiled general-case tier (ResolveTask resolve_f analog) ----
         # gated by the PLAN-time tier decision: when the inventory proves
@@ -1328,12 +1408,19 @@ class LocalBackend:
         if fallback_idx and pending_outs is not None \
                 and rplan.use_general and not self.interpret_only:
             t0 = time.perf_counter()
+            n_before = len(fallback_idx)
             with TR.span("resolve:general", "exec") as _sp:
-                _sp.set("rows", len(fallback_idx))
+                _sp.set("rows", n_before)
                 self._general_case_pass(stage, part, fallback_idx, resolved,
                                         device_codes, buffers=bufs)
                 _sp.set("resolved", len(resolved))
-            metrics["general_path_s"] = time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            metrics["general_path_s"] = dt
+            if EX.enabled():
+                ex_defer.append((EX.note_tier,
+                                 (stage.key(), "general", n_before,
+                                  n_before - len(fallback_idx), dt),
+                                 {"owner": id(self)}))
 
         # ---- exact device exceptions (no-resolver fast exit) --------------
         # When the stage carries no resolver/ignore, a row whose device code
@@ -1348,7 +1435,7 @@ class LocalBackend:
             if bufs is not None and not rplan.use_general:
                 # the exact-class rows sit in their plan-time buckets
                 # already — no per-row dict probe + class lookup here
-                exact = [(i, op_id, exception_name(code))
+                exact = [(i, op_id, code, exception_name(code))
                          for i, code, op_id in bufs.exact_rows()
                          if i in fallback_idx]
             else:
@@ -1361,16 +1448,28 @@ class LocalBackend:
                         continue
                     code, op_id = code_op
                     if exception_class_for_code(code) is not None:
-                        exact.append((i, op_id, exception_name(code)))
+                        exact.append((i, op_id, code,
+                                      exception_name(code)))
             # decode a handful of rows so history previews stay informative;
             # counts only need the class name
             sample = {}
             if exact:
-                sidx = [i for i, _, _ in exact[:5]]
+                sidx = [i for i, _, _, _ in exact[:5]]
                 sample = dict(zip(sidx, C.decode_rows(part, sidx)))
-            for i, op_id, name in exact:
+            for i, op_id, code, name in exact:
                 exc_by_row[i] = ExceptionRecord(op_id, name, sample.get(i))
                 fallback_idx.discard(i)
+            if EX.enabled() and exact:
+                ex_defer.append((EX.note_outcomes,
+                                 (stage.key(),
+                                  [(code, op_id)
+                                   for _, op_id, code, _ in exact],
+                                  "exact-exit"), {"owner": id(self)}))
+                for i, _op, code, _nm in exact[:5]:
+                    if i in sample:
+                        ex_defer.append((EX.sample_row,
+                                         (stage.key(), code, sample[i]),
+                                         {}))
 
         # ---- interpreter path (ResolveTask analog) ------------------------
         # one compiled closure chain per stage + bulk row decode: no per-row
@@ -1381,6 +1480,14 @@ class LocalBackend:
                 _sp.set("rows", len(fallback_idx))
                 pipeline = stage.python_pipeline(part.user_columns)
                 order = sorted(fallback_idx)
+                ex_on = EX.enabled()
+                interp_pairs: list = []     # (final code, op_id) per row
+                code_counts: dict = {}      # exc name -> n (span attr)
+                n_exc = 0
+                row_sample_budget = 16      # lock-taking sample_row calls
+                # per partition (the per stage x code K-bound lives
+                # inside excprof; this keeps a full-fallback partition
+                # from probing the lock once per row)
                 for i, row in zip(order, C.decode_rows(part, order)):
                     status, payload = pipeline(row)
                     if status == "ok":
@@ -1390,6 +1497,48 @@ class LocalBackend:
                         trace = payload[3] if len(payload) > 3 else None
                         exc_by_row[i] = ExceptionRecord(op_id, exc_name,
                                                         value, trace)
+                        n_exc += 1
+                        if ex_on:
+                            code = EX.code_for_name(exc_name)
+                            interp_pairs.append((code, op_id))
+                            if row_sample_budget > 0:
+                                row_sample_budget -= 1
+                                ex_defer.append((EX.sample_row,
+                                                 (stage.key(), code,
+                                                  value), {}))
+                            code_counts[exc_name] = \
+                                code_counts.get(exc_name, 0) + 1
+                        continue
+                    if ex_on:
+                        # retired on the interpreter (resolved or
+                        # filtered): attribute the row's ORIGINAL device
+                        # code to this tier — that is the code that fell
+                        # all the way down
+                        code, op_id = device_codes.get(
+                            i, (int(ExceptionCode.PYTHON_FALLBACK), 0))
+                        interp_pairs.append((code, op_id))
+                        if row_sample_budget > 0:
+                            # the INPUT row that fell to this tier even
+                            # though it resolved — "why did row X reach
+                            # the interpreter" from the dashboard
+                            row_sample_budget -= 1
+                            ex_defer.append((EX.sample_row,
+                                             (stage.key(), code, row), {}))
+                dt = time.perf_counter() - t0
+                if ex_on:
+                    ex_defer.append((EX.note_outcomes,
+                                     (stage.key(), interp_pairs,
+                                      "interpreter"), {"owner": id(self)}))
+                    ex_defer.append((EX.note_tier,
+                                     (stage.key(), "interpreter",
+                                      len(order), len(order) - n_exc, dt),
+                                     {"owner": id(self)}))
+                if _sp is not TR.NOOP:
+                    _sp.set("resolved", len(order) - n_exc)
+                    if code_counts:
+                        _sp.set("codes", ",".join(
+                            f"{k}:{v}" for k, v in
+                            sorted(code_counts.items())[:6]))
         exceptions = [exc_by_row[i] for i in sorted(exc_by_row)]
         metrics["slow_path_s"] = time.perf_counter() - t0
 
@@ -1428,6 +1577,11 @@ class LocalBackend:
                 stage.fold_op.id,
                 tuple(v.item() for v in fold_vals),
                 [int(r) for r in kept_rank[badmask]])
+        # this attempt produced the partition's output: commit its
+        # exception-plane records (a failure above left them unrecorded
+        # for the task-failure ladder's re-run to record afresh)
+        for fn, a, kw in ex_defer:
+            fn(*a, **kw)
         return outp, exceptions, metrics
 
     # ------------------------------------------------------------------
@@ -1453,17 +1607,22 @@ class LocalBackend:
         # fast-path code is already an exact Python exception class decoded
         # fine under the normal case — a supertype re-run reproduces the
         # same exception, so they skip straight past this tier
+        cand_info: dict[int, tuple] = {}   # idx -> (code, op_id) for the
+        # exception-plane tier attribution (runtime/excprof)
         if buffers is not None:
             # plan-time buckets: the internal-coded candidate set was
             # grouped at D2H unpack, no per-row re-classification
-            cand = sorted(i for i, _, _ in buffers.internal_rows()
-                          if i in fallback_idx and i not in part.fallback)
+            cand_info = {i: (code, op_id)
+                         for i, code, op_id in buffers.internal_rows()
+                         if i in fallback_idx and i not in part.fallback}
+            cand = sorted(cand_info)
         else:
             dc = device_codes or {}
             cand = sorted(
                 i for i in fallback_idx
                 if i not in part.fallback
                 and exception_class_for_code(dc.get(i, (0, 0))[0]) is None)
+            cand_info = {i: dc.get(i, (0, 0)) for i in cand}
         if not cand:
             return
         # a small violation set on an accelerator backend resolves on the
@@ -1537,15 +1696,22 @@ class LocalBackend:
         vals = C.partition_to_pylist(outp)
         cols = outp.user_columns
         single = len(outp.schema.types) == 1
+        retired_pairs: list = []
         for j in range(k):
             if not ok[j]:
                 continue
             i = int(idx[j])
             fallback_idx.discard(i)
+            retired_pairs.append(cand_info.get(i, (0, 0)))
             if keep[j]:
                 v = vals[j]
                 resolved[i] = Row((v,), cols) if single else Row(v, cols)
             # else: filtered out on the general path — row emits nothing
+        if retired_pairs and EX.enabled():
+            # which codes the compiled general tier RETIRED (the
+            # vectorized re-run absorbed them before the interpreter)
+            EX.note_outcomes(stage.key(), retired_pairs, "general",
+                             owner=id(self))
 
     # ------------------------------------------------------------------
     def _merge(self, stage: TransformStage, part: C.Partition,
@@ -1657,6 +1823,13 @@ def _prefetch_iter(it, depth: int):
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     stop = threading.Event()
     _END = object()
+    # inherit the consumer thread's tenant scoping onto the producer:
+    # span-stream tag (runtime/tracing) and counter scope (xferstats) are
+    # THREAD-local, so source-load spans / ingest byte counters recorded
+    # on this helper thread used to land untagged during serve — only
+    # dispatch-path events were reliably tenant-tagged
+    stream = TR.current_stream()
+    scope = xferstats.current_scope()
 
     def put(item) -> bool:
         while not stop.is_set():
@@ -1668,6 +1841,10 @@ def _prefetch_iter(it, depth: int):
         return False
 
     def produce():
+        if stream is not None:
+            TR.set_stream(stream)
+        if scope is not None:
+            xferstats.set_scope(scope)
         try:
             for item in it:
                 if not put(item):

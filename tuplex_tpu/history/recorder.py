@@ -159,9 +159,55 @@ class JobRecorder:
 
     def job_done(self, rows: int, wall_s: float, exc_counts: dict) -> None:
         self._write_job_spans()
+        self._write_excprof()
         self._write({"event": "job_done", "rows": rows,
                      "wall_s": round(wall_s, 4),
                      "exception_counts": exc_counts})
+
+    def _write_excprof(self) -> None:
+        """Embed the exception-plane readout (runtime/excprof) into the
+        history file at the job's terminal turn: per-stage x code x op
+        counts + resolve-tier mix vs the plan-time baseline, the global
+        drift readout, and the sampled deviant rows — the dashboard drift
+        panel and the `excstats` CLI read it from here. The counters are
+        live process state (cumulative across jobs sharing the process),
+        so the panel is a snapshot AT this job's end, not a per-job
+        delta; serve jobs instead get per-tenant rows from the service's
+        own terminal event."""
+        if not self.enabled:
+            return
+        try:
+            from ..core.errors import exception_name
+            from ..runtime import excprof
+
+            if not excprof.enabled():
+                return
+            reps = excprof.reports()
+            if not reps:
+                return
+            stages = {}
+            for key, r in reps.items():
+                d = {"rows": r["rows"], "rate": round(r["rate"], 4),
+                     "fallback": r["fallback"],
+                     "unexpected": r["unexpected"],
+                     "codes": {f"{exception_name(c)}#op{op}": n
+                               for (c, op), n in sorted(r["codes"].items())},
+                     "tiers": r["tiers"]}
+                base = r.get("baseline")
+                if base is not None:
+                    d["baseline"] = {
+                        "codes": [exception_name(c)
+                                  for c in base["codes"]],
+                        "tier": base["tier"], "pruned": base["pruned"]}
+                stages[key] = d
+            samples: dict = {}
+            for (key, code), caps in excprof.samples().items():
+                samples.setdefault(key, {})[exception_name(code)] = caps
+            self._write({"event": "excprof",
+                         "drift": excprof.scope_report(None),
+                         "stages": stages, "samples": samples})
+        except Exception:   # pragma: no cover - the panel is advisory
+            pass
 
     def serve_job_event(self, job_id: str, event: str, **fields) -> None:
         """Dashboard row for a JOB-SERVICE job (serve/): same event shapes
@@ -303,6 +349,67 @@ def _fmt_eng(v) -> str:
     from ..runtime.devprof import fmt_eng
 
     return fmt_eng(v)
+
+
+def _excprof_html(ev: dict) -> str:
+    """Exception-plane drift panel for one job: drift score vs the
+    plan-time baseline (bar + respecialize badge), resolve-tier mix,
+    per-stage x code counts against the expected inventory, and the
+    sampled deviant rows. Renders both shapes: the single-job recorder's
+    terminal `excprof` event (drift/stages/samples) and the job
+    service's per-tenant row (flat scope_report fields + tenant)."""
+    drift = ev.get("drift") or ev
+    score = float(drift.get("drift_score", 0.0) or 0.0)
+    resp = bool(drift.get("respecialize_recommended"))
+    rate = float(drift.get("exception_rate", 0.0) or 0.0)
+    mix = drift.get("tier_mix") or {}
+    tenant = ev.get("tenant")
+    pct = max(0.0, min(1.0, score)) * 100
+    badge = (' <span class=respbadge>respecialize recommended</span>'
+             if resp else "")
+    mix_s = ", ".join(f"{k} {v * 100:.1f}%" for k, v in sorted(mix.items())
+                      if v) or "—"
+    who = f"tenant {html.escape(str(tenant))}" if tenant else "global"
+    head = (f"exception plane — {who}: drift "
+            f"<span class=driftbar><span class=driftfill "
+            f"style=\"width:{pct:.1f}%\"></span></span> {score:.2f}"
+            f"{badge} · exc rate {rate * 100:.2f}% · tier mix {mix_s}")
+    body: list = []
+    stages = ev.get("stages") or {}
+    if stages:
+        body.append("<table class=exctab><tr><th>stage</th><th>rows</th>"
+                    "<th>exc rate</th><th>unexpected</th>"
+                    "<th>codes (observed)</th><th>expected</th>"
+                    "<th>tiers</th></tr>")
+        for key, s in sorted(stages.items()):
+            codes = ", ".join(f"{c}:{n}" for c, n in
+                              sorted((s.get("codes") or {}).items())) or "—"
+            tiers = ", ".join(f"{t}:{n}" for t, n in
+                              sorted((s.get("tiers") or {}).items())) or "—"
+            base = s.get("baseline") or {}
+            exp = ", ".join(base.get("codes") or []) or "none"
+            if base.get("tier"):
+                exp += f" → {base['tier']}"
+            unexpected = int(s.get("unexpected", 0))
+            ucls = " class=unexp" if unexpected else ""
+            body.append(
+                f"<tr><td><code>{html.escape(str(key)[:16])}</code></td>"
+                f"<td>{s.get('rows', 0)}</td>"
+                f"<td>{float(s.get('rate', 0.0)) * 100:.2f}%</td>"
+                f"<td{ucls}>{unexpected}</td>"
+                f"<td>{html.escape(codes)}</td>"
+                f"<td>{html.escape(exp)}</td>"
+                f"<td>{html.escape(tiers)}</td></tr>")
+        body.append("</table>")
+    for key, by_code in sorted((ev.get("samples") or {}).items()):
+        for code, caps in sorted(by_code.items()):
+            for r in caps:
+                body.append(
+                    f"<div class=excsample>↳ <b>{html.escape(str(code))}"
+                    f"</b> @ <code>{html.escape(str(key)[:16])}</code>: "
+                    f"{html.escape(str(r))}</div>")
+    return (f"<details class=excplane><summary>{head}</summary>"
+            f"{''.join(body)}</details>")
 
 
 _WF_CAP = 120      # bars per job (longest-first keeps the picture honest)
@@ -492,6 +599,15 @@ def _render_doc(log_dir: str, live: bool) -> str:
                 f"<b>{html.escape(str(f.get('kind', '')))}</b>: "
                 f"{html.escape(str(f.get('reason', '')))}"
                 f" ({html.escape(str(f.get('loc', '')))}){cold}</td></tr>")
+        # exception-plane drift panel (runtime/excprof): the terminal
+        # `excprof` event — the single-job recorder's full readout or
+        # the job service's per-tenant scope_report row
+        exev = next((e for e in reversed(events)
+                     if e.get("event") == "excprof"), None)
+        if exev:
+            rows_html.append(
+                f"<tr class=excp><td colspan=7>{_excprof_html(exev)}"
+                f"</td></tr>")
         # span waterfall (the 'spans' event job_done embeds when tracing
         # was on): one bar per span, offset/width proportional to the
         # job's trace window, lane color by category
@@ -518,6 +634,19 @@ def _render_doc(log_dir: str, live: bool) -> str:
  tr.wf td {{ border-bottom: none; }}
  tr.dev td {{ border-bottom: none; }}
  tr.dev summary {{ font-size: 12px; color: #456; cursor: pointer; }}
+ tr.excp td {{ border-bottom: none; }}
+ .excplane summary {{ font-size: 12px; color: #456; cursor: pointer; }}
+ table.exctab {{ width: auto; font-size: 12px; margin: .3rem 0 .3rem 1rem; }}
+ table.exctab th, table.exctab td {{ padding: .15rem .6rem; }}
+ table.exctab td.unexp {{ color: #a33; font-weight: bold; }}
+ .driftbar {{ display: inline-block; width: 80px; height: 8px;
+              background: #eee; vertical-align: middle; }}
+ .driftfill {{ display: block; height: 8px; background: #c2703a; }}
+ .respbadge {{ background: #a33; color: #fff; font-size: 11px;
+               padding: 0 .4em; border-radius: 3px; }}
+ .excsample {{ color: #765; font-size: 11px; margin-left: 1rem;
+               overflow: hidden; white-space: nowrap;
+               text-overflow: ellipsis; }}
  table.devtab {{ width: auto; font-size: 12px; margin: .3rem 0 .3rem 1rem; }}
  table.devtab th, table.devtab td {{ padding: .15rem .6rem; }}
  .rlbar {{ display: inline-block; width: 80px; height: 8px;

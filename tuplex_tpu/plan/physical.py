@@ -200,6 +200,33 @@ class TransformStage:
             codes |= rep.exception_codes()
             if rep.must_fallback:
                 codes.add(EC.PYTHON_FALLBACK)
+            # Option-typed inputs raise TypeError on the None rows
+            # wherever a compiled expression consumes them (emitter
+            # _unwrap_option: Python `None + 1` semantics) — a property
+            # of the schema MEETING the UDF, invisible to the per-UDF
+            # AST pass above. Narrowed by the column-reads analysis
+            # when it has a verdict; over-approximated to any Option
+            # column otherwise (soundness: the exception-plane drift
+            # detector treats out-of-inventory codes as stale
+            # speculation, so missing a reachable code is the worse
+            # error).
+            if EC.TYPEERROR not in codes:
+                try:
+                    sch = op.parent.schema()
+                    names = list(getattr(sch, "columns", None) or [])
+                    types = list(getattr(sch, "types", None) or [])
+                    any_opt = any(t.is_optional() for t in types)
+                    if any_opt:
+                        from .optimizer import udf_read_columns
+
+                        reads = udf_read_columns(getattr(op, attr, None))
+                        if reads is None or not names:
+                            codes.add(EC.TYPEERROR)
+                        elif {n for n, t in zip(names, types)
+                              if t.is_optional()} & set(reads):
+                            codes.add(EC.TYPEERROR)
+                except Exception:   # unknown schema: stay sound
+                    codes.add(EC.TYPEERROR)
         return sorted(codes)
 
     def speculation_pruned(self) -> bool:

@@ -1,0 +1,678 @@
+"""Exception-plane observability: per-code fallback attribution, windowed
+drift detection against the plan-time baseline, and the respecialization
+signal.
+
+Dual-mode processing is the framework's central mechanism, yet until now
+the exception plane was the one plane with no telemetry: ``exec/local``
+reduced an entire resolve pass to a single ``exception_rows`` count while
+spans, serve histograms and device cost all stop at the compiled fast
+path. Three pieces close the gap:
+
+* **windowed accounting** — the D2H unpack and the resolve-tier passes
+  (exec/local) record, per stage x operator x exception code, how many
+  rows erred, which resolve tier each code finally landed on
+  (exact-exit / general / interpreter) and how long each tier pass took
+  (``excprof_resolve_seconds{stage,tier}`` telemetry histograms).
+  Per-stage-execution accumulators are owner-scoped like devprof's
+  dispatch windows, so concurrent serve jobs sharing a stage key never
+  pool or steal each other's report.
+* **plan-time baseline + drift** — ``capture_baseline(stage)`` snapshots
+  the analyzer's exception inventory and resolve-plan verdict
+  (``TransformStage.possible_exception_codes()`` / ``resolve_plan()``):
+  which codes the plan EXPECTS, whether speculation pruned a cold arm,
+  and whether the static verdict promised a code-free stage. Observed
+  traffic folds into per-scope (per-tenant, thread-local like
+  runtime/xferstats) windows; each rolled window updates an EWMA
+  exception rate whose half-life is configurable. The drift score
+  compares the EWMA against the scope's anchor (the plan-normal era:
+  the first observed window, floored at the normal-case allowance) plus
+  an unexpected-code component — codes OUTSIDE the plan inventory weigh
+  far heavier, because they mean the speculation itself is stale, not
+  just the data dirty. ``respecialize_recommended(scope)`` fires past
+  the threshold and an ok/degraded ``exception_drift`` health check
+  rides runtime/telemetry.
+* **sampled deviant rows** — the first K rows per stage x code are kept
+  repr-truncated, so "why did row X fall to the interpreter" is
+  answerable from the dashboard without replaying the job. Bounded,
+  truncated, and dead under the kill switch: the capture obeys the same
+  privacy posture as exception previews (row payloads never leave the
+  history file the operator already owns).
+
+Disabled (``TUPLEX_EXCPROF=0`` env kill switch) every record path is one
+module-flag check — no allocation, no lock (the zero-overhead contract
+tracing/telemetry/devprof pin, test-asserted).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+# ---------------------------------------------------------------------------
+# enable gate (mirrors runtime/devprof: process-wide, env kill switch wins)
+# ---------------------------------------------------------------------------
+
+
+def _env_disabled() -> bool:
+    return os.environ.get("TUPLEX_EXCPROF", "").strip().lower() \
+        in ("0", "false", "off")
+
+
+_enabled = not _env_disabled()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(on: bool = True) -> None:
+    """Process-wide gate. TUPLEX_EXCPROF=0 wins over any option-driven
+    enable (A/B overhead timing)."""
+    global _enabled
+    _enabled = bool(on) and not _env_disabled()
+
+
+# ---------------------------------------------------------------------------
+# configuration (apply_options wires the knobs; module defaults match
+# core/options.py DEFAULTS)
+# ---------------------------------------------------------------------------
+
+#: one stage-label truncation for every exposition surface (shared
+#: discipline with devprof.STAGE_LABEL_LEN so PromQL joins line up)
+STAGE_LABEL_LEN = 16
+
+_window_s = 10.0          # tuplex.serve.driftWindowS
+_half_life_s = 30.0       # tuplex.tpu.excprofHalfLifeS
+_threshold = 0.5          # tuplex.tpu.excprofDriftThreshold
+_sample_k = 3             # tuplex.tpu.excprofSampleRows
+_normal_rate = 0.05       # tuplex.tpu.excprofNormalRate (anchor floor for
+                          # stages whose inventory expects exceptions)
+_SAMPLE_REPR_LEN = 160    # repr truncation for captured deviant rows
+_CLEAN_FLOOR = 0.005      # anchor floor when the plan promises NO codes
+_UNEXPECTED_TOL = 0.01    # EWMA unexpected-code rate reading as full drift
+_MAX_ENTRIES = 1024       # bound on every registry here
+
+
+def configure(window_s: Optional[float] = None,
+              half_life_s: Optional[float] = None,
+              threshold: Optional[float] = None,
+              sample_k: Optional[int] = None,
+              normal_rate: Optional[float] = None) -> None:
+    global _window_s, _half_life_s, _threshold, _sample_k, _normal_rate
+    if window_s is not None and window_s > 0:
+        _window_s = float(window_s)
+    if half_life_s is not None and half_life_s > 0:
+        _half_life_s = float(half_life_s)
+    if threshold is not None and threshold > 0:
+        _threshold = float(threshold)
+    if sample_k is not None and sample_k >= 0:
+        _sample_k = int(sample_k)
+    if normal_rate is not None and normal_rate >= 0:
+        _normal_rate = float(normal_rate)
+
+
+def apply_options(options) -> None:
+    """Wire the process gate + knobs from ContextOptions. Like devprof,
+    ``tuplex.tpu.excprof`` turns accounting ON, never off — the gate is
+    process-wide and another live Context/service may depend on it; the
+    only OFF switches are the env kill switch and an explicit
+    ``excprof.enable(False)``."""
+    if options.get_bool("tuplex.tpu.excprof", True):
+        enable(True)
+    configure(
+        window_s=options.get_float("tuplex.serve.driftWindowS", 0.0) or None,
+        half_life_s=options.get_float("tuplex.tpu.excprofHalfLifeS", 0.0)
+        or None,
+        threshold=options.get_float("tuplex.tpu.excprofDriftThreshold", 0.0)
+        or None,
+        sample_k=options.get_int("tuplex.tpu.excprofSampleRows", 3),
+        normal_rate=options.get_float("tuplex.tpu.excprofNormalRate", 0.0)
+        or None)
+    if _enabled:
+        _ensure_health()
+
+
+# ---------------------------------------------------------------------------
+# state
+# ---------------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+_tls = threading.local()
+
+#: stage key -> plan-time baseline {codes, tier, pruned}
+_BASE: dict[str, dict] = {}
+#: (owner, stage key) -> per-stage-execution accumulator (consumed by
+#: stage_report into the stage metrics record)
+_ACC: dict[tuple, dict] = {}
+#: stage key -> cumulative exposition snapshot (the /metrics source)
+_STAGE: dict[str, dict] = {}
+#: scope ('' = process-global) -> drift window + EWMA state
+_WIN: dict[str, dict] = {}
+#: (stage key, code) -> [repr, ...] first-K deviant rows
+_SAMPLES: dict[tuple, list] = {}
+
+_health_registered = False
+_HEALTH_OWNER = object()      # module-identity owner for telemetry checks
+
+
+def set_scope(name: Optional[str]) -> None:
+    """Attribute every record made by THIS thread to a named scope (the
+    job service sets the running job's TENANT around each scheduler
+    step — drift is a property of a tenant's traffic, not of one job).
+    None clears the scope; scopeless records land on the '' global
+    window only."""
+    _tls.scope = None if name is None else str(name)
+
+
+def current_scope() -> Optional[str]:
+    return getattr(_tls, "scope", None)
+
+
+# ---------------------------------------------------------------------------
+# plan-time baseline
+# ---------------------------------------------------------------------------
+
+
+def capture_baseline(stage) -> None:
+    """Snapshot the plan-time exception expectation for one stage: the
+    analyzer's code inventory (``possible_exception_codes``), the
+    resolve-plan tier verdict and whether branch speculation pruned a
+    cold arm. Pure plan state — capturing twice is idempotent, and the
+    snapshot survives the stage's memos being dropped."""
+    if not _enabled:
+        return
+    try:
+        key = stage.key()
+    except Exception:
+        return
+    with _LOCK:
+        if key in _BASE:
+            return
+    try:
+        rp = stage.resolve_plan()
+        base = {"codes": frozenset(int(c) for c in rp.codes),
+                "tier": rp.tier,
+                "pruned": bool(stage.speculation_pruned())}
+    except Exception:
+        base = {"codes": frozenset(), "tier": "?", "pruned": False}
+    with _LOCK:
+        while len(_BASE) >= _MAX_ENTRIES:
+            _BASE.pop(next(iter(_BASE)))
+        _BASE.setdefault(key, base)
+    _ensure_health()
+
+
+def baselines() -> dict:
+    with _LOCK:
+        return {k: dict(v) for k, v in _BASE.items()}
+
+
+# ---------------------------------------------------------------------------
+# recording (exec/local call sites)
+# ---------------------------------------------------------------------------
+
+
+def _acc(owner: int, stage: str) -> dict:
+    a = _ACC.get((owner, stage))
+    if a is None:
+        while len(_ACC) >= _MAX_ENTRIES:
+            _ACC.pop(next(iter(_ACC)))
+        a = _ACC[(owner, stage)] = {
+            "rows": 0, "errs": 0, "fallback": 0, "unexpected": 0,
+            "tiers": {}, "tier_s": {}, "codes": {}, "code_tier": {}}
+    return a
+
+
+def _stage_entry(stage: str) -> dict:
+    s = _STAGE.get(stage)
+    if s is None:
+        while len(_STAGE) >= _MAX_ENTRIES:
+            _STAGE.pop(next(iter(_STAGE)))
+        s = _STAGE[stage] = {
+            "rows": 0, "errs": 0, "fallback": 0, "unexpected": 0,
+            "codes": {}, "tiers": {}, "code_tier": {}}
+    return s
+
+
+def _window(scope: str) -> dict:
+    w = _WIN.get(scope)
+    if w is None:
+        while len(_WIN) >= _MAX_ENTRIES:
+            _WIN.pop(next(iter(_WIN)))
+        w = _WIN[scope] = {
+            "t0": time.monotonic(), "rows": 0, "errs": 0, "unexpected": 0,
+            "expect_codes": False, "ewma_rate": None, "ewma_unexpected": 0.0,
+            "anchor": None, "windows": 0,
+            "cum_rows": 0, "cum_errs": 0, "cum_tiers": {}}
+    return w
+
+
+def _roll_locked(w: dict, now: float, force: bool = False) -> None:
+    """Fold the current window into the EWMA when its span elapsed. An
+    elapsed EMPTY window decays the EWMA toward the anchor — a tenant
+    that stopped sending traffic must not pin the health state degraded
+    forever on stale evidence."""
+    dt = now - w["t0"]
+    if not force and dt < _window_s:
+        return
+    if dt <= 0:
+        dt = _window_s
+    if w["rows"] > 0:
+        rate = w["errs"] / w["rows"]
+        unexpected = w["unexpected"] / w["rows"]
+        if w["anchor"] is None:
+            # the plan-normal era: the first observed window calibrates
+            # the expected rate, floored at the configured allowance (a
+            # code-free static verdict gets the tight floor — any
+            # exception there IS evidence the speculation went stale)
+            floor = _normal_rate if w["expect_codes"] else _CLEAN_FLOOR
+            w["anchor"] = max(floor, rate)
+    elif w["ewma_rate"] is not None and w["anchor"] is not None:
+        rate = w["anchor"]
+        unexpected = 0.0
+    else:
+        w["t0"] = now
+        return
+    alpha = 1.0 - 2.0 ** (-dt / _half_life_s)
+    if w["ewma_rate"] is None:
+        w["ewma_rate"] = rate
+        w["ewma_unexpected"] = unexpected
+    else:
+        w["ewma_rate"] += alpha * (rate - w["ewma_rate"])
+        w["ewma_unexpected"] += alpha * (unexpected - w["ewma_unexpected"])
+    w["windows"] += 1
+    w["rows"] = w["errs"] = w["unexpected"] = 0
+    w["t0"] = now
+
+
+def _win_add_locked(stage: str, rows: int, errs: int,
+                    unexpected: int) -> None:
+    base = _BASE.get(stage)
+    expect = bool(base and base["codes"])
+    now = time.monotonic()
+    sc = getattr(_tls, "scope", None)
+    for name in ("",) if sc is None else ("", sc):
+        w = _window(name)
+        _roll_locked(w, now)
+        w["rows"] += rows
+        w["errs"] += errs
+        w["unexpected"] += unexpected
+        w["cum_rows"] += rows
+        w["cum_errs"] += errs
+        if expect:
+            w["expect_codes"] = True
+
+
+def note_device(stage: str, rows: int, packed_codes=None,
+                fallback_rows: int = 0, owner: int = 0) -> None:
+    """One partition's D2H unpack verdict: `rows` rows entered the
+    stage, `packed_codes` is the raw device error lattice of the rows
+    that erred (class code in the low byte, operator id above), and
+    `fallback_rows` rows never reached the device at all (input-boxed
+    fallback slots / whole-partition interpreter routing)."""
+    if not _enabled or not stage or rows < 0:
+        return
+    pairs: list = []
+    n_err = 0
+    if packed_codes is not None and len(packed_codes):
+        import numpy as np
+
+        arr = np.asarray(packed_codes)
+        uniq, counts = np.unique(arr, return_counts=True)
+        n_err = int(counts.sum())
+        pairs = [(int(v) & 0xFF, int(v) >> 8, int(c))
+                 for v, c in zip(uniq.tolist(), counts.tolist())]
+    from ..core.errors import ExceptionCode as EC
+
+    with _LOCK:
+        base = _BASE.get(stage)
+        known = base["codes"] if base else frozenset()
+        unexpected = sum(c for code, _op, c in pairs if code not in known)
+        a = _acc(owner, stage)
+        a["rows"] += rows
+        a["errs"] += n_err + fallback_rows
+        a["fallback"] += fallback_rows
+        a["unexpected"] += unexpected
+        s = _stage_entry(stage)
+        s["rows"] += rows
+        s["errs"] += n_err + fallback_rows
+        s["fallback"] += fallback_rows
+        s["unexpected"] += unexpected
+        for code, op, c in pairs:
+            k = (code, op)
+            s["codes"][k] = s["codes"].get(k, 0) + c
+            a["codes"][k] = a["codes"].get(k, 0) + c
+        if fallback_rows:
+            k = (int(EC.PYTHON_FALLBACK), 0)
+            s["codes"][k] = s["codes"].get(k, 0) + fallback_rows
+            a["codes"][k] = a["codes"].get(k, 0) + fallback_rows
+        _win_add_locked(stage, rows, n_err + fallback_rows, unexpected)
+    _ensure_health()
+
+
+def note_outcomes(stage: str, pairs, tier: str, owner: int = 0) -> None:
+    """Final per-row attribution for one resolve tier: `pairs` is a list
+    of (code, op_id) — which exception code landed on `tier`
+    ('exact-exit' / 'general' / 'interpreter')."""
+    if not _enabled or not stage or not pairs:
+        return
+    with _LOCK:
+        a = _acc(owner, stage)
+        a["tiers"][tier] = a["tiers"].get(tier, 0) + len(pairs)
+        s = _stage_entry(stage)
+        s["tiers"][tier] = s["tiers"].get(tier, 0) + len(pairs)
+        for code, _op in pairs:
+            k = (int(code), tier)
+            s["code_tier"][k] = s["code_tier"].get(k, 0) + 1
+            a["code_tier"][k] = a["code_tier"].get(k, 0) + 1
+        sc = getattr(_tls, "scope", None)
+        for name in ("",) if sc is None else ("", sc):
+            ct = _window(name)["cum_tiers"]
+            ct[tier] = ct.get(tier, 0) + len(pairs)
+
+
+def note_tier(stage: str, tier: str, rows: int, retired: int,
+              seconds: float, owner: int = 0) -> None:
+    """One resolve-tier PASS over a partition's deviant rows: `rows`
+    entered, `retired` left resolved, `seconds` of wall time — the
+    resolve latency lands in the ``excprof_resolve_seconds{stage,tier}``
+    telemetry histogram next to the serve-path latencies."""
+    if not _enabled or not stage:
+        return
+    from . import telemetry
+
+    telemetry.observe("excprof_resolve_seconds", seconds,
+                      stage=stage[:STAGE_LABEL_LEN], tier=tier)
+    with _LOCK:
+        a = _acc(owner, stage)
+        ts = a["tier_s"]
+        ts[tier] = ts.get(tier, 0.0) + float(seconds)
+
+
+def sample_row(stage: str, code: int, row) -> None:
+    """Bounded deviant-row capture: keep the FIRST K rows per
+    stage x code, repr-truncated — enough to answer "what does a row
+    that falls to this tier look like" from the dashboard, small enough
+    that a poison tenant cannot fill the process with row payloads."""
+    if not _enabled or not stage or _sample_k <= 0:
+        return
+    key = (stage, int(code))
+    with _LOCK:
+        buf = _SAMPLES.get(key)
+        if buf is None:
+            if len(_SAMPLES) >= _MAX_ENTRIES:
+                return
+            buf = _SAMPLES[key] = []
+        if len(buf) >= _sample_k:
+            return
+        try:
+            r = repr(row)
+        except Exception:
+            r = "<unrepresentable row>"
+        if len(r) > _SAMPLE_REPR_LEN:
+            r = r[:_SAMPLE_REPR_LEN] + "…"
+        buf.append(r)
+
+
+def code_for_name(exc_name: str) -> int:
+    """Map an interpreter exception class name back onto the device code
+    space ('ValueError' -> VALUEERROR); UNKNOWN for names outside it."""
+    from ..core import errors
+
+    member = errors.code_for_name(str(exc_name))
+    return int(member) if member is not None \
+        else int(errors.ExceptionCode.UNKNOWN)
+
+
+# ---------------------------------------------------------------------------
+# readouts
+# ---------------------------------------------------------------------------
+
+
+def stage_report(stage: str, owner: int = 0) -> Optional[dict]:
+    """Consume the per-execution accumulator into FLAT NUMERIC metrics
+    (they ride the stage metrics dict through Metrics.stage_breakdown
+    unchanged): rows_seen, exception_rate, unexpected_code_rows and the
+    per-tier retired-row counts."""
+    if not _enabled or not stage:
+        return None
+    with _LOCK:
+        a = _ACC.pop((owner, stage), None)
+    if a is None or a["rows"] == 0:
+        return None
+    rep = {
+        "rows_seen": a["rows"],
+        "exception_rate": a["errs"] / a["rows"],
+        "unexpected_code_rows": a["unexpected"],
+        "resolve_exact_rows": a["tiers"].get("exact-exit", 0),
+        "resolve_general_rows": a["tiers"].get("general", 0),
+        "resolve_interpreter_rows": a["tiers"].get("interpreter", 0),
+    }
+    for tier, s in a["tier_s"].items():
+        rep[f"resolve_{tier.replace('-', '_')}_s"] = s
+    return rep
+
+
+def _sub_counts(dst: dict, sub: dict) -> None:
+    for k, n in sub.items():
+        left = dst.get(k, 0) - n
+        if left > 0:
+            dst[k] = left
+        else:
+            dst.pop(k, None)
+
+
+def discard_stage(stage: str, owner: int = 0) -> None:
+    """Back out one stage execution's accounting — the _TierRestart
+    path: a blown compile deadline restarts the stage from partition 0
+    on a lower tier, so everything the aborted execution recorded would
+    double-count against the re-run's. Pending window counts and the
+    cumulative stage/scope totals are subtracted (floored at 0); window
+    spans that already folded into the EWMA stay — a bounded
+    approximation (restarts are rare and the EWMA forgets)."""
+    if not _enabled or not stage:
+        return
+    with _LOCK:
+        a = _ACC.pop((owner, stage), None)
+        if a is None:
+            return
+        s = _STAGE.get(stage)
+        if s is not None:
+            for key in ("rows", "errs", "fallback", "unexpected"):
+                s[key] = max(0, s[key] - a[key])
+            _sub_counts(s["codes"], a["codes"])
+            _sub_counts(s["tiers"], a["tiers"])
+            _sub_counts(s["code_tier"], a["code_tier"])
+        sc = getattr(_tls, "scope", None)
+        for name in ("",) if sc is None else ("", sc):
+            w = _WIN.get(name)
+            if w is None:
+                continue
+            for key, src in (("rows", "rows"), ("errs", "errs"),
+                             ("unexpected", "unexpected"),
+                             ("cum_rows", "rows"), ("cum_errs", "errs")):
+                w[key] = max(0, w[key] - a[src])
+            _sub_counts(w["cum_tiers"], a["tiers"])
+
+
+def reports() -> dict:
+    """Cumulative per-stage accounting (the /metrics exposition source):
+    {stage: {rows, errs, rate, fallback, unexpected, codes{(code,op): n},
+    tiers{tier: n}, code_tier{(code,tier): n}, baseline}}."""
+    with _LOCK:
+        out = {}
+        for k, s in _STAGE.items():
+            d = {"rows": s["rows"], "errs": s["errs"],
+                 "fallback": s["fallback"], "unexpected": s["unexpected"],
+                 "rate": (s["errs"] / s["rows"]) if s["rows"] else 0.0,
+                 "codes": dict(s["codes"]), "tiers": dict(s["tiers"]),
+                 "code_tier": dict(s["code_tier"])}
+            base = _BASE.get(k)
+            if base is not None:
+                d["baseline"] = {"codes": sorted(base["codes"]),
+                                 "tier": base["tier"],
+                                 "pruned": base["pruned"]}
+            out[k] = d
+        return out
+
+
+def samples() -> dict:
+    """{(stage, code): [repr, ...]} — the captured deviant rows."""
+    with _LOCK:
+        return {k: list(v) for k, v in _SAMPLES.items()}
+
+
+def roll(force: bool = False) -> None:
+    """Advance every scope window (tests + the chaos drift scenario force
+    a deterministic roll instead of sleeping out the wall clock)."""
+    now = time.monotonic()
+    with _LOCK:
+        for w in _WIN.values():
+            _roll_locked(w, now, force=force)
+
+
+def _score_locked(w: dict) -> float:
+    if w["ewma_rate"] is None or w["anchor"] is None:
+        return 0.0
+    excess = max(0.0, w["ewma_rate"] - w["anchor"])
+    # the configured normal-case allowance doubles as the score scale
+    # floor, so lowering the knob raises drift sensitivity consistently
+    scale = max(w["anchor"], _normal_rate)
+    s_rate = min(1.0, excess / scale)
+    s_codes = min(1.0, w["ewma_unexpected"] / _UNEXPECTED_TOL)
+    return max(s_rate, s_codes)
+
+
+def drift_score(scope: Optional[str] = None) -> float:
+    """0..1 deviation of the scope's EWMA exception profile from its
+    plan-time-anchored baseline. 0 until a full window has rolled."""
+    name = "" if scope is None else str(scope)
+    now = time.monotonic()
+    with _LOCK:
+        w = _WIN.get(name)
+        if w is None:
+            return 0.0
+        _roll_locked(w, now)
+        return _score_locked(w)
+
+
+def respecialize_recommended(scope: Optional[str] = None) -> bool:
+    """The ROADMAP adaptive-serving signal: this scope's live exception
+    profile has drifted far enough from the plan-time expectation that a
+    re-speculated (re-specialized) plan would likely beat the current
+    one — rows are leaking off the compiled fast path."""
+    return drift_score(scope) >= _threshold
+
+
+def scope_report(scope: Optional[str] = None) -> dict:
+    """One scope's full drift readout: cumulative rows/errs/tier mix plus
+    the windowed EWMA, drift score and the respecialize flag (numeric 0/1
+    so bench JSON consumers can gate on it)."""
+    name = "" if scope is None else str(scope)
+    now = time.monotonic()
+    with _LOCK:
+        w = _WIN.get(name)
+        if w is None:
+            return {"rows": 0, "errs": 0, "exception_rate": 0.0,
+                    "ewma_rate": 0.0, "drift_score": 0.0,
+                    "respecialize_recommended": 0, "windows": 0,
+                    "tier_mix": {}}
+        _roll_locked(w, now)
+        score = _score_locked(w)
+        total_t = sum(w["cum_tiers"].values())
+        mix = {t.replace("-", "_"): (n / total_t if total_t else 0.0)
+               for t, n in sorted(w["cum_tiers"].items())}
+        return {
+            "rows": w["cum_rows"], "errs": w["cum_errs"],
+            "exception_rate": (w["cum_errs"] / w["cum_rows"])
+            if w["cum_rows"] else 0.0,
+            "ewma_rate": w["ewma_rate"] or 0.0,
+            "anchor_rate": w["anchor"] if w["anchor"] is not None else 0.0,
+            "drift_score": score,
+            "respecialize_recommended": int(score >= _threshold),
+            "windows": w["windows"],
+            "tier_mix": mix,
+        }
+
+
+def scopes() -> list:
+    with _LOCK:
+        return [s for s in _WIN if s]
+
+
+def tier_mix_total() -> dict:
+    """PROCESS-GLOBAL resolve-tier mix (fractions of deviant rows retired
+    per tier) from the global window's cumulative counts. Distinct from
+    Metrics.resolveTierMix(), which recomputes the mix PER JOB from its
+    own stages' resolve_*_rows metrics — use that for job-scoped
+    readouts, this for the whole process (excstats / tests)."""
+    with _LOCK:
+        w = _WIN.get("")
+        if w is None:
+            return {}
+        total = sum(w["cum_tiers"].values())
+        return {t.replace("-", "_"): (n / total if total else 0.0)
+                for t, n in sorted(w["cum_tiers"].items())}
+
+
+# ---------------------------------------------------------------------------
+# health (runtime/telemetry ok/degraded check)
+# ---------------------------------------------------------------------------
+
+
+def _health_check():
+    from . import telemetry
+
+    worst = 0.0
+    worst_scope = ""
+    now = time.monotonic()
+    with _LOCK:
+        for name, w in _WIN.items():
+            _roll_locked(w, now)
+            s = _score_locked(w)
+            if s > worst:
+                worst, worst_scope = s, name
+    if worst >= _threshold:
+        who = f"tenant {worst_scope!r}" if worst_scope else "global traffic"
+        return (telemetry.DEGRADED,
+                f"{who} drifted from the plan-time exception baseline "
+                f"(drift_score {worst:.2f} >= {_threshold:.2f}) — "
+                f"respecialization recommended")
+    return (telemetry.OK, None)
+
+
+def _ensure_health() -> None:
+    """Register the ok/degraded exception-drift check with the telemetry
+    registry (idempotent; re-registered after registry.clear() by the
+    next apply_options/record — the local flag alone is not enough, a
+    cleared registry must not leave the drift signal silently dark)."""
+    global _health_registered
+    try:
+        from . import telemetry
+
+        if _health_registered \
+                and "exception_drift" in telemetry.registry()._checks:
+            return
+        telemetry.register_health_check("exception_drift", _health_check,
+                                        owner=_HEALTH_OWNER)
+        _health_registered = True
+    except Exception:   # pragma: no cover - telemetry import cycle safety
+        pass
+
+
+# ---------------------------------------------------------------------------
+# lifecycle (tests)
+# ---------------------------------------------------------------------------
+
+
+def clear() -> None:
+    global _health_registered
+    with _LOCK:
+        _BASE.clear()
+        _ACC.clear()
+        _STAGE.clear()
+        _WIN.clear()
+        _SAMPLES.clear()
+    _health_registered = False

@@ -661,6 +661,18 @@ class PackedStageFn:
             donate_argnums=(0,) if self._donate else (), salt="pack",
             tag=self._tag, n_ops=self._n_ops, deadline_s=self._deadline)
 
+    def note_async_defect(self) -> bool:
+        """Forward the async deserialize-defect verdict (see
+        AotJit.note_async_defect) to every per-spec AOT route this
+        packed fn built; True when any entry was pinned to the plain
+        in-process jit."""
+        hit = False
+        for fn, _cell, _traced in self._fns.values():
+            noted = getattr(fn, "note_async_defect", None)
+            if noted is not None and noted():
+                hit = True
+        return hit
+
     def __call__(self, arrays: dict):
         spec, total = _host_spec(arrays)
         extras_in = {k: v for k, v in arrays.items()

@@ -508,6 +508,67 @@ def _devprof_lines(lines: list) -> None:
                 f"{_fmt_val(v)}")
 
 
+def _excprof_lines(lines: list) -> None:
+    """Exception-plane accounting (runtime/excprof): per-stage x code x
+    operator counts, resolve-tier mix, and the per-scope drift readout
+    (EWMA vs the plan-time baseline + the respecialize signal) as
+    labeled gauges — next to the ``excprof_resolve_seconds`` histograms
+    the resolve passes record through the normal registry."""
+    try:
+        from . import excprof
+        from ..core.errors import exception_name
+    except Exception:       # pragma: no cover - import cycle safety
+        return
+    reps = excprof.reports()
+    trunc = excprof.STAGE_LABEL_LEN
+    if reps:
+        fams: dict[str, list] = {
+            "excprof_rows_total": [], "excprof_exception_rows": [],
+            "excprof_exception_rate": [], "excprof_unexpected_rows": [],
+            "excprof_resolve_tier_rows": [], "excprof_baseline_codes": []}
+        for tag, r in sorted(reps.items()):
+            st = (("stage", tag[:trunc]),)
+            fams["excprof_rows_total"].append((st, r["rows"]))
+            fams["excprof_exception_rate"].append((st, r["rate"]))
+            fams["excprof_unexpected_rows"].append((st, r["unexpected"]))
+            for (code, op), n in sorted(r["codes"].items()):
+                fams["excprof_exception_rows"].append(
+                    (st + (("code", exception_name(code)),
+                           ("op", str(op))), n))
+            for tier, n in sorted(r["tiers"].items()):
+                fams["excprof_resolve_tier_rows"].append(
+                    (st + (("tier", tier),), n))
+            base = r.get("baseline")
+            if base is not None:
+                fams["excprof_baseline_codes"].append(
+                    (st + (("tier", base["tier"]),), len(base["codes"])))
+        for fam, rows in fams.items():
+            if not rows:
+                continue
+            n = _PREFIX + fam
+            lines.append(f"# TYPE {n} gauge")
+            for lbl, v in rows:
+                lines.append(f"{n}{_fmt_labels(lbl)} {_fmt_val(v)}")
+    # per-scope drift: '' = global, others = serve tenants
+    scope_rows = []
+    for scope in [""] + excprof.scopes():
+        rep = excprof.scope_report(scope or None)
+        if not rep.get("rows") and not scope:
+            continue
+        scope_rows.append((scope, rep))
+    if scope_rows:
+        for fam, key in (("excprof_drift_score", "drift_score"),
+                         ("excprof_respecialize_recommended",
+                          "respecialize_recommended"),
+                         ("excprof_window_exception_rate", "ewma_rate")):
+            n = _PREFIX + fam
+            lines.append(f"# TYPE {n} gauge")
+            for scope, rep in scope_rows:
+                lines.append(
+                    f"{n}{_fmt_labels((('scope', scope or 'global'),))} "
+                    f"{_fmt_val(rep.get(key, 0.0))}")
+
+
 def render_prometheus(reg: Optional[Registry] = None) -> str:
     """The full scrape: registry histograms + gauges, bridged xferstats
     counter families, compile-plane stats, and the health state as
@@ -551,6 +612,7 @@ def render_prometheus(reg: Optional[Registry] = None) -> str:
 
     _compile_plane_lines(lines)
     _devprof_lines(lines)
+    _excprof_lines(lines)
 
     # health
     h = reg.health()

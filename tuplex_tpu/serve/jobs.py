@@ -195,6 +195,16 @@ class JobHandle:
     def exceptions(self) -> list:
         return list(self._rec.exceptions)
 
+    def exc_profile(self) -> dict:
+        """The TENANT's live exception-plane readout (runtime/excprof,
+        scoped like the xferstats counter families): cumulative exception
+        rate, resolve-tier mix, the EWMA-vs-baseline drift score and the
+        respecialize recommendation. Tenant-wide by design — drift is a
+        property of the tenant's traffic distribution, not of one job."""
+        from ..runtime import excprof
+
+        return excprof.scope_report(self._rec.request.tenant)
+
     def attempts(self) -> list:
         """The retry ladder's audit trail: one record per FAILED attempt
         ({attempt, error, transient, action, backoff_s, t}). Empty for a

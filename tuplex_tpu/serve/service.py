@@ -107,9 +107,10 @@ class JobService:
         self._last_reject_t = float("-inf")
         self._last_turn_done_t = time.monotonic()
         telemetry.apply_options(o)
-        from ..runtime import devprof
+        from ..runtime import devprof, excprof
 
         devprof.apply_options(o)   # serve CLI builds options Context-less
+        excprof.apply_options(o)   # exception-plane drift knobs + health
         self._register_telemetry(o)
         if autostart:
             self.start()
@@ -454,13 +455,16 @@ class JobService:
         transient failures requeue the job from stage 0 after its
         exponential backoff (the slot frees immediately — backoff never
         blocks a worker)."""
-        from ..runtime import tracing, xferstats
+        from ..runtime import excprof, tracing, xferstats
 
         done = False
         err: Optional[BaseException] = None
         retrying = False
         tracing.set_stream(rec.id)
         xferstats.set_scope(rec.id)
+        # exception-plane scope is the TENANT, not the job: drift is a
+        # property of a tenant's traffic distribution across jobs
+        excprof.set_scope(rec.request.tenant)
         t_disp0 = time.perf_counter()
         try:
             faults.maybe("serve", point="step")   # chaos checkpoint: an
@@ -474,6 +478,7 @@ class JobService:
         finally:
             tracing.set_stream(None)
             xferstats.set_scope(None)
+            excprof.set_scope(None)
         now = time.perf_counter()
         telemetry.observe("serve_dispatch_seconds", now - t_disp0,
                           tenant=rec.request.tenant)
@@ -544,6 +549,18 @@ class JobService:
             # release the registry entry (a service that lives for
             # thousands of jobs must not keep one family per job)
             rec.final_counters = xferstats.drop_scope(rec.id)
+            # exception-plane row for the dashboard drift panel: the
+            # tenant's cumulative exception rate, resolve-tier mix and
+            # the drift/respecialize readout at this job's terminal turn
+            if excprof.enabled():
+                try:
+                    exr = excprof.scope_report(rec.request.tenant)
+                    self._record_event(
+                        rec, "excprof", tenant=rec.request.tenant,
+                        **{k: v for k, v in exr.items()
+                           if isinstance(v, (int, float, dict))})
+                except Exception:   # dashboard rows are advisory
+                    pass
         # history rows land BEFORE the state flip wakes any waiter: a
         # client that sees DONE must find the job_done row already written
         if err is not None:

@@ -1,0 +1,84 @@
+"""`python -m tuplex_tpu excstats` — exception-plane readout from the
+job history.
+
+Renders the terminal ``excprof`` events (history/recorder embeds the
+runtime/excprof readout at every job's end; the job service writes one
+per-tenant row per serve job) as text: per-stage x code counts against
+the plan-time expected inventory, resolve-tier mix, the drift score vs
+the baseline with the respecialize recommendation, and the sampled
+deviant rows — the same data the dashboard drift panel draws, for a
+terminal. Reads ``<logDir>/tuplex_history.jsonl``; nothing executes.
+"""
+
+from __future__ import annotations
+
+
+def main(log_dir: str = ".", job: str | None = None) -> int:
+    from ..history.recorder import _load_jobs
+
+    jobs = _load_jobs(log_dir)      # FileNotFoundError -> caller prints
+    n_shown = 0
+    for job_id, events in jobs.items():
+        if job is not None and not str(job_id).startswith(job):
+            continue
+        exev = next((e for e in reversed(events)
+                     if e.get("event") == "excprof"), None)
+        if exev is None:
+            continue
+        n_shown += 1
+        _print_job(job_id, events, exev)
+    if n_shown == 0:
+        which = f" matching {job!r}" if job else ""
+        print(f"excstats: no exception-plane events{which} in "
+              f"{log_dir or '.'}/tuplex_history.jsonl — run a job with "
+              f"tuplex.tpu.excprof on (the default; TUPLEX_EXCPROF=0 "
+              f"disables)")
+    return 0
+
+
+def _print_job(job_id: str, events: list, exev: dict) -> None:
+    done = next((e for e in events if e.get("event") == "job_done"), {})
+    tenant = exev.get("tenant")
+    head = f"job {job_id}"
+    if tenant:
+        head += f" (tenant {tenant})"
+    if done.get("wall_s") is not None:
+        head += f" — {done.get('rows', '?')} rows, {done['wall_s']}s"
+    print(head)
+    # both event shapes: the single-job recorder nests the global
+    # readout under 'drift'; the serve row IS a flat scope_report
+    drift = exev.get("drift") or exev
+    score = float(drift.get("drift_score", 0.0) or 0.0)
+    flag = "  RESPECIALIZE RECOMMENDED" \
+        if drift.get("respecialize_recommended") else ""
+    print(f"  drift {score:.2f}{flag} · exc rate "
+          f"{float(drift.get('exception_rate', 0.0) or 0.0) * 100:.2f}% "
+          f"· {int(drift.get('windows', 0) or 0)} window(s)")
+    mix = drift.get("tier_mix") or {}
+    if any(mix.values()):
+        print("  tier mix: " + ", ".join(
+            f"{k} {v * 100:.1f}%" for k, v in sorted(mix.items()) if v))
+    for key, s in sorted((exev.get("stages") or {}).items()):
+        unexpected = int(s.get("unexpected", 0))
+        uflag = f"  unexpected={unexpected} !" if unexpected else ""
+        print(f"  stage {str(key)[:16]}  rows {s.get('rows', 0)}  "
+              f"rate {float(s.get('rate', 0.0)) * 100:.2f}%"
+              f"  fallback {s.get('fallback', 0)}{uflag}")
+        codes = s.get("codes") or {}
+        if codes:
+            print("      observed: " + ", ".join(
+                f"{c}:{n}" for c, n in sorted(codes.items())))
+        base = s.get("baseline") or {}
+        if base:
+            exp = ", ".join(base.get("codes") or []) or "none"
+            pruned = "  [cold arm pruned]" if base.get("pruned") else ""
+            print(f"      expected: {exp} -> {base.get('tier', '?')}"
+                  f"{pruned}")
+        tiers = s.get("tiers") or {}
+        if tiers:
+            print("      tiers: " + ", ".join(
+                f"{t}:{n}" for t, n in sorted(tiers.items())))
+    for key, by_code in sorted((exev.get("samples") or {}).items()):
+        for code, caps in sorted(by_code.items()):
+            for r in caps:
+                print(f"      sample {code} @ {str(key)[:16]}: {r}")
