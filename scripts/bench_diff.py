@@ -88,6 +88,25 @@ HIGHER_BETTER = {
     "drift_trip_windows": False,
     "drift_recover_windows": False,
     "respecialize_fired": True,
+    # closed-loop respecialization (serve/respec via chaos_bench's
+    # respec-* classes and scripts/respec_smoke.py): trigger-to-promote
+    # latency and the recovery window count gate like p99; promotions
+    # must not fall (1 -> 0 means the loop stopped closing); rollback /
+    # quarantine counts must not grow (a healthy candidate starting to
+    # quarantine IS the regression); the residual drift after a promote
+    # must not grow
+    "promote_s": False,
+    "respec_promotions": True,
+    "respec_rollbacks": False,
+    # "respec_quarantines" is deliberately NOT registered as a bare leaf:
+    # the poison class INJECTS its quarantines (informational there), so
+    # only the closed-loop class's two-segment form gates (leaf lookup
+    # would win over the two-segment rule if both existed)
+    "respec-drift.respec_quarantines": False,
+    "respec_trip_jobs": False,
+    "respec_promote_jobs": False,
+    "drift_after_promote": False,
+    "respec_markers": None,
     "analyzer_ms": False,
     "spread": False,
     "wall_s": False,

@@ -32,7 +32,22 @@ Fault classes:
                   (runtime/excprof) must trip respecialize_recommended
                   and the degraded `exception_drift` health state within
                   one window, and both must recover on their own once
-                  the shift reverts
+                  the shift reverts (respec OFF: this class measures the
+                  SENSOR alone)
+  respec-drift    the CLOSED LOOP (serve/respec): the same shift, but the
+                  traffic never reverts — the controller must background-
+                  compile a re-speculated candidate, canary it on the
+                  tenant's next job, hot-swap at the job boundary, and the
+                  drift score + interpreter-tier share must recover below
+                  threshold WITHOUT a restart and with every job's rows
+                  still correct
+  respec-poison   a fault-injected POISONED candidate: the first respec's
+                  compile hangs (``respec:hang-compile``) and the second's
+                  canary dispatch fails (``respec:raise-canary``) — both
+                  must be quarantined (content-addressed `.respecquar`
+                  markers, zero promotions), every job's results must stay
+                  byte-identical to the incumbent path, and health must
+                  return to ok
 
 Each class reports wall seconds, jobs ok/failed, retries and compile
 kills, and the worst + final health state. The output is one BENCH-style
@@ -122,6 +137,10 @@ def _run_thread_class(name, spec, ctx, csvs, want, state_dir,
     opts = ContextOptions(ctx.options_store.to_dict())
     if deadline is not None:
         opts.set("tuplex.tpu.compileDeadlineS", deadline)
+    # the injected-fault classes measure the FAULT machinery; a respec
+    # controller reacting to their induced exception traffic would add a
+    # nondeterministic actor (the respec-* classes exercise it on purpose)
+    opts.set("tuplex.serve.respec", False)
     svc = JobService(opts)
     t0 = time.perf_counter()
     jids = [WC.submit(root, r) for r in _build_requests(ctx, csvs, name)]
@@ -227,6 +246,10 @@ def _run_drift_class(name, ctx, state_dir, rows):
     opts = ContextOptions(ctx.options_store.to_dict())
     opts.set("tuplex.serve.driftWindowS", window_s)
     opts.set("tuplex.tpu.excprofHalfLifeS", window_s)
+    # this class measures the SENSOR alone: the closed-loop controller
+    # would re-anchor the very signal whose trip/recover latency is the
+    # metric (the respec-drift class measures the loop)
+    opts.set("tuplex.serve.respec", False)
     tenant = "drifty"
     svc = JobService(opts)
     t0 = time.perf_counter()
@@ -298,6 +321,249 @@ def _run_drift_class(name, ctx, state_dir, rows):
             "exception_rate": round(rep["exception_rate"], 4),
             "health_worst": health_shift, "health_final": final,
             "fault": "data-shift (no injected faults)"}
+
+
+def _respec_service(ctx, state_dir, name, window_s, faults_spec="",
+                    quarantine_s=600.0, compile_deadline_s=60.0):
+    """Common setup for the two closed-loop respec classes: fresh compile
+    + exception planes, short drift windows, an eager controller (no
+    debounce slack, no cooldown) so the loop runs in seconds."""
+    from tuplex_tpu.core.options import ContextOptions
+    from tuplex_tpu.exec import compilequeue as CQ
+    from tuplex_tpu.runtime import excprof
+    from tuplex_tpu.serve import JobService
+
+    os.environ["TUPLEX_AOT_CACHE"] = os.path.join(state_dir, f"aot-{name}")
+    CQ.clear()
+    CQ._TIMEOUTS.clear()
+    _set_faults(faults_spec, state_dir, name)
+    excprof.clear()
+    opts = ContextOptions(ctx.options_store.to_dict())
+    opts.set("tuplex.serve.driftWindowS", window_s)
+    opts.set("tuplex.tpu.excprofHalfLifeS", window_s)
+    opts.set("tuplex.serve.respec", True)
+    opts.set("tuplex.serve.respecCheckS", 0.05)
+    opts.set("tuplex.serve.respecDebounce", 1)
+    opts.set("tuplex.serve.respecCooldownS", 0)
+    opts.set("tuplex.serve.respecCanaryFrac", 1.0)
+    opts.set("tuplex.serve.respecCompileDeadlineS", compile_deadline_s)
+    opts.set("tuplex.serve.respecQuarantineS", quarantine_s)
+    return JobService(opts)
+
+
+def _run_respec_drift_class(name, ctx, state_dir, rows):
+    """Closed-loop acceptance: the drift class's distribution shift, but
+    the traffic NEVER reverts — recovery must come from the controller
+    re-specializing the tenant (background compile → canary → hot-swap),
+    not from the data going clean again. Gates: the drift score returns
+    below threshold and the interpreter-tier share returns to its
+    pre-shift level without a service restart, with every job's rows
+    correct for its OWN input throughout."""
+    from tuplex_tpu.models import zillow
+    from tuplex_tpu.runtime import excprof, telemetry
+    from tuplex_tpu.serve import request_from_dataset
+
+    clean = os.path.join(state_dir, f"{name}-clean.csv")
+    zillow.generate_csv(clean, rows, seed=11)
+    shifted = _shift_csv(clean, os.path.join(state_dir,
+                                             f"{name}-shifted.csv"))
+    want_clean = zillow.run_reference_python(clean)
+    want_shift = zillow.run_reference_python(shifted)
+    window_s = 0.4
+    svc = _respec_service(ctx, state_dir, name, window_s)
+    tenant = "drifty-loop"
+    t0 = time.perf_counter()
+    n_jobs = [0]
+
+    def run_one(path, want):
+        h = svc.submit(request_from_dataset(
+            zillow.build_pipeline(ctx.csv(path)),
+            name=f"{name}-j{n_jobs[0]}", tenant=tenant))
+        n_jobs[0] += 1
+        assert h.wait(1200) == "done", (h.name, h.state, h.error)
+        assert h.result() == want, f"{name}: wrong rows (job {h.name})"
+        return h
+
+    def settle():
+        time.sleep(window_s * 1.2)
+        excprof.roll()
+
+    try:
+        # phase A — plan-normal era: calibrate the anchor
+        run_one(clean, want_clean)
+        settle()
+        run_one(clean, want_clean)
+        settle()
+        interp_before = excprof.scope_report(tenant)["tier_mix"].get(
+            "interpreter", 0.0)
+        # phase B — the shift, permanently: drive until the signal trips
+        trip_jobs = 0
+        for _ in range(8):
+            run_one(shifted, want_shift)
+            settle()
+            trip_jobs += 1
+            if excprof.respecialize_recommended(tenant):
+                break
+        assert excprof.respecialize_recommended(tenant), \
+            f"{name}: drift never tripped"
+        peak = excprof.drift_score(tenant)
+        # phase C — keep the shifted traffic flowing; the controller must
+        # re-specialize and promote WITHOUT any revert or restart
+        promote_jobs = 0
+        rep = svc.respec.tenant_report(tenant)
+        for _ in range(40):
+            run_one(shifted, want_shift)
+            settle()
+            promote_jobs += 1
+            rep = svc.respec.tenant_report(tenant)
+            if rep["promotions"] >= 1:
+                break
+        assert rep["promotions"] >= 1, \
+            f"{name}: respec never promoted ({rep})"
+        promote_ev = next((e for e in rep["history"]
+                           if e["phase"] == "promote"), {})
+        # phase D — the loop is closed: the score must sit below the
+        # threshold on the SAME shifted traffic, and health returns to ok
+        recover_windows = 0
+        for _ in range(20):
+            run_one(shifted, want_shift)
+            settle()
+            recover_windows += 1
+            if not excprof.respecialize_recommended(tenant):
+                break
+        score_after = excprof.drift_score(tenant)
+        assert not excprof.respecialize_recommended(tenant), \
+            f"{name}: drift did not recover after promotion " \
+            f"(score {score_after:.2f})"
+        interp_after = excprof.scope_report(tenant)["tier_mix"].get(
+            "interpreter", 0.0)
+        assert interp_after <= interp_before + 0.05, \
+            f"{name}: interpreter-tier share grew " \
+            f"({interp_before:.3f} -> {interp_after:.3f})"
+        final = telemetry.health()["state"] \
+            if telemetry.enabled() else "ok"
+        assert final == "ok", f"{name}: health did not recover ({final})"
+    finally:
+        svc.close()
+        _set_faults("", state_dir, name)
+    wall = time.perf_counter() - t0
+    return {"wall_s": round(wall, 3), "jobs": n_jobs[0],
+            "jobs_ok": n_jobs[0], "jobs_failed_clean": 0, "retries": 0,
+            "respec_promotions": rep["promotions"],
+            "respec_quarantines": rep["quarantines"],
+            "respec_rollbacks": rep["rollbacks"],
+            "promote_s": promote_ev.get("promote_s", 0.0),
+            "respec_trip_jobs": trip_jobs,
+            "respec_promote_jobs": promote_jobs,
+            "drift_recover_windows": recover_windows,
+            "drift_peak": round(peak, 3),
+            "drift_after_promote": round(score_after, 3),
+            "tier_mix": {"interpreter": round(interp_after, 4)},
+            "health_final": final,
+            "fault": "data-shift, never reverted (closed loop)"}
+
+
+def _run_respec_poison_class(name, ctx, state_dir, rows):
+    """Poisoned-candidate acceptance: the first candidate's compile hangs
+    (killed by the controller's compile watchdog), the second's canary
+    dispatch raises — BOTH quarantine, nothing promotes, every job's
+    results stay byte-identical to the incumbent path (the canary job's
+    output comes from the incumbent by construction), and health returns
+    to ok once the traffic goes clean again."""
+    from tuplex_tpu.models import zillow
+    from tuplex_tpu.runtime import excprof, telemetry
+    from tuplex_tpu.serve import request_from_dataset
+
+    clean = os.path.join(state_dir, f"{name}-clean.csv")
+    zillow.generate_csv(clean, rows, seed=11)
+    shifted = _shift_csv(clean, os.path.join(state_dir,
+                                             f"{name}-shifted.csv"))
+    want_clean = zillow.run_reference_python(clean)
+    want_shift = zillow.run_reference_python(shifted)
+    window_s = 0.4
+    # the hang outlives the compile deadline by far (the watchdog must
+    # kill-quarantine it, not wait it out); the deadline still leaves a
+    # healthy candidate 2 room for its one real background compile
+    svc = _respec_service(
+        ctx, state_dir, name, window_s,
+        faults_spec=("respec:hang-compile:once:delay=120,"
+                     "respec:raise-canary:once:kind=det"),
+        quarantine_s=0.2, compile_deadline_s=8.0)
+    tenant = "poisoned"
+    t0 = time.perf_counter()
+    n_jobs = [0]
+
+    def run_one(path, want):
+        h = svc.submit(request_from_dataset(
+            zillow.build_pipeline(ctx.csv(path)),
+            name=f"{name}-j{n_jobs[0]}", tenant=tenant))
+        n_jobs[0] += 1
+        assert h.wait(1200) == "done", (h.name, h.state, h.error)
+        assert h.result() == want, \
+            f"{name}: job {h.name} rows differ from the incumbent path"
+        return h
+
+    def settle():
+        time.sleep(window_s * 1.2)
+        excprof.roll()
+
+    try:
+        run_one(clean, want_clean)
+        settle()
+        run_one(clean, want_clean)
+        settle()
+        # shifted traffic: trips drift, and every respec attempt is
+        # poisoned — first by the compile hang, then by the canary fault
+        rep = svc.respec.tenant_report(tenant)
+        for _ in range(60):
+            run_one(shifted, want_shift)
+            settle()
+            rep = svc.respec.tenant_report(tenant)
+            if rep["quarantines"] >= 2:
+                break
+        assert rep["quarantines"] >= 2, \
+            f"{name}: expected both poisoned candidates quarantined " \
+            f"({rep})"
+        assert rep["promotions"] == 0, \
+            f"{name}: a poisoned candidate was promoted ({rep})"
+        canary_fail = any(
+            "canary" in str(e.get("reason", ""))
+            for e in rep["history"] if e["phase"] == "quarantine")
+        assert canary_fail, \
+            f"{name}: no quarantine records the canary fault ({rep})"
+        # pause further triggers (the operator action after a double
+        # quarantine): the revert phase below measures the SENSOR and
+        # health decay, not a third candidate racing the clean traffic
+        svc.respec.debounce_n = 1 << 30
+        # content-addressed quarantine markers on disk (flap protection
+        # survives the process)
+        aot_dir = os.environ.get("TUPLEX_AOT_CACHE", "")
+        markers = [f for f in os.listdir(aot_dir)
+                   if f.endswith(".respecquar")] if aot_dir else []
+        assert markers, f"{name}: no .respecquar marker written"
+        # revert: clean traffic — the sensor decays, nothing is stuck,
+        # health (exception_drift AND the respec check) returns to ok
+        for _ in range(30):
+            run_one(clean, want_clean)
+            settle()
+            if not excprof.respecialize_recommended(tenant):
+                break
+        final = telemetry.health()["state"] \
+            if telemetry.enabled() else "ok"
+        assert final == "ok", f"{name}: health did not return to ok " \
+            f"({telemetry.health() if telemetry.enabled() else final})"
+    finally:
+        svc.close()
+        _set_faults("", state_dir, name)
+    wall = time.perf_counter() - t0
+    return {"wall_s": round(wall, 3), "jobs": n_jobs[0],
+            "jobs_ok": n_jobs[0], "jobs_failed_clean": 0, "retries": 0,
+            "respec_promotions": rep["promotions"],
+            "respec_quarantines": rep["quarantines"],
+            "respec_rollbacks": rep["rollbacks"],
+            "respec_markers": len(markers),
+            "health_final": final,
+            "fault": "respec:hang-compile + respec:raise-canary"}
 
 
 def _run_crash_class(name, ctx, csvs, want, state_dir, conf_path):
@@ -430,6 +696,16 @@ def main(argv=None) -> int:
               file=sys.stderr, flush=True)
         classes["drift"] = _run_drift_class("drift", ctx, state_dir,
                                             args.rows)
+        # the closed-loop classes (serve/respec) also run without the
+        # tight smoke deadline: candidate compiles must live
+        print("[chaos] class respec-drift (closed-loop self-healing)",
+              file=sys.stderr, flush=True)
+        classes["respec-drift"] = _run_respec_drift_class(
+            "respec-drift", ctx, state_dir, args.rows)
+        print("[chaos] class respec-poison (poisoned candidate)",
+              file=sys.stderr, flush=True)
+        classes["respec-poison"] = _run_respec_poison_class(
+            "respec-poison", ctx, state_dir, args.rows)
         if not args.smoke:
             print("[chaos] class serve-crash (subprocess)",
                   file=sys.stderr, flush=True)
@@ -437,11 +713,13 @@ def main(argv=None) -> int:
                 "serve-crash", ctx, csvs, want, state_dir, conf_path)
 
         base = classes["baseline"]["wall_s"]
-        # the drift class's wall is dominated by its deliberate window
-        # sleeps + fresh compiles, not a fault path — it reports its own
-        # trip/recover latencies instead of gating the worst-class wall
+        # the drift/respec classes' walls are dominated by deliberate
+        # window sleeps + fresh compiles, not a fault path — they report
+        # their own trip/promote/recover latencies instead of gating the
+        # worst-class wall
         worst = max(v["wall_s"] for k, v in classes.items()
-                    if k not in ("baseline", "drift"))
+                    if k not in ("baseline", "drift", "respec-drift",
+                                 "respec-poison"))
         result = {
             "metric": "chaos_zillow_worst_class_wall_s",
             "value": worst,
@@ -472,6 +750,10 @@ def main(argv=None) -> int:
             "serve-retry class never retried"
         assert classes["drift"]["respecialize_fired"] == 1, \
             "drift class never recommended respecialization"
+        assert classes["respec-drift"]["respec_promotions"] >= 1, \
+            "respec-drift class never promoted a candidate"
+        assert classes["respec-poison"]["respec_quarantines"] >= 2, \
+            "respec-poison class failed to quarantine both candidates"
         print("chaos-bench OK", file=sys.stderr)
     return 0
 

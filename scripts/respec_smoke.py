@@ -1,0 +1,195 @@
+#!/usr/bin/env python3
+"""Tier-1 smoke for the closed respecialization loop (serve/respec).
+
+Synthetic drift on the zillow workload: one tenant's "facts and
+features" cell breaks on half its rows mid-run and NEVER reverts. The
+self-healing contract under test, end to end and in seconds:
+
+  1. the exception-plane EWMA (runtime/excprof) trips
+     ``respecialize_recommended`` for the tenant;
+  2. the controller builds a re-speculated candidate from the LIVE
+     observed code distribution and compiles it on the BACKGROUND lane
+     (zero foreground compile-pool slots);
+  3. the tenant's next job canaries the candidate and the service
+     hot-swaps at the job boundary;
+  4. the drift score returns below ``excprofDriftThreshold`` — on the
+     same shifted traffic, without a restart — and every job's rows stay
+     correct for its own input throughout;
+  5. the lifecycle is observable: ``serve_respec_*`` counters in the
+     Prometheus exposition and a promote event in the tenant history.
+
+Prints one BENCH-style JSON line (``scripts/bench_diff.py`` gates
+``promote_s`` / ``drift_after_promote`` / ``respec_promotions``).
+
+    python scripts/respec_smoke.py
+    python scripts/respec_smoke.py --rows 400 --out RESPEC.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))          # run from anywhere
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="closed-loop respecialization smoke (zillow drift)")
+    ap.add_argument("--rows", type=int, default=160)
+    ap.add_argument("--window", type=float, default=0.3,
+                    help="drift window seconds (drives the wall clock)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    state = tempfile.mkdtemp(prefix="tpx-respec-smoke-")
+    prev_aot = os.environ.get("TUPLEX_AOT_CACHE")
+    os.environ["TUPLEX_AOT_CACHE"] = os.path.join(state, "aot")
+    try:
+        import tuplex_tpu
+        from tuplex_tpu.core.options import ContextOptions
+        from tuplex_tpu.exec import compilequeue as CQ
+        from tuplex_tpu.models import zillow
+        from tuplex_tpu.runtime import excprof, telemetry
+        from tuplex_tpu.serve import JobService, request_from_dataset
+
+        CQ.clear()
+        excprof.clear()
+        clean = os.path.join(state, "clean.csv")
+        zillow.generate_csv(clean, args.rows, seed=11)
+        import csv as _csv
+
+        shifted = os.path.join(state, "shifted.csv")
+        with open(clean, newline="") as fin, \
+                open(shifted, "w", newline="") as fout:
+            r = _csv.DictReader(fin)
+            w = _csv.DictWriter(fout, fieldnames=zillow.COLUMNS)
+            w.writeheader()
+            for i, row in enumerate(r):
+                if i % 2 == 0:
+                    row["facts and features"] = "-- , contact agent"
+                w.writerow(row)
+        want_clean = zillow.run_reference_python(clean)
+        want_shift = zillow.run_reference_python(shifted)
+
+        ctx = tuplex_tpu.Context(
+            {"tuplex.scratchDir": os.path.join(state, "scratch")})
+        opts = ContextOptions(ctx.options_store.to_dict())
+        win = args.window
+        opts.set("tuplex.serve.driftWindowS", win)
+        opts.set("tuplex.tpu.excprofHalfLifeS", win)
+        opts.set("tuplex.serve.respecCheckS", 0.05)
+        opts.set("tuplex.serve.respecDebounce", 1)
+        opts.set("tuplex.serve.respecCooldownS", 0)
+        opts.set("tuplex.serve.respecCanaryFrac", 1.0)
+        opts.set("tuplex.serve.respecCompileDeadlineS", 120)
+        svc = JobService(opts)
+        assert svc.respec is not None, "respec controller not running " \
+            "(tuplex.serve.respec defaulted off?)"
+        tenant = "smoke-drifty"
+        fg_snap = CQ.snapshot()
+        t0 = time.perf_counter()
+        n_jobs = [0]
+
+        def run_one(path, want):
+            h = svc.submit(request_from_dataset(
+                zillow.build_pipeline(ctx.csv(path)),
+                name=f"smoke-j{n_jobs[0]}", tenant=tenant))
+            n_jobs[0] += 1
+            assert h.wait(900) == "done", (h.state, h.error)
+            assert h.result() == want, "wrong rows (results must stay " \
+                "on the incumbent path until promotion, and correct after)"
+
+        def settle():
+            time.sleep(win * 1.2)
+            excprof.roll()
+
+        try:
+            run_one(clean, want_clean)
+            settle()
+            run_one(clean, want_clean)
+            settle()
+            assert not excprof.respecialize_recommended(tenant), \
+                "tripped on clean traffic"
+            # the shift — permanent; drive until the loop closes
+            trip_jobs = 0
+            for _ in range(8):
+                run_one(shifted, want_shift)
+                settle()
+                trip_jobs += 1
+                if excprof.respecialize_recommended(tenant):
+                    break
+            assert excprof.respecialize_recommended(tenant), \
+                "drift never tripped"
+            rep = svc.respec.tenant_report(tenant)
+            for _ in range(40):
+                run_one(shifted, want_shift)
+                settle()
+                rep = svc.respec.tenant_report(tenant)
+                if rep["promotions"] >= 1:
+                    break
+            assert rep["promotions"] >= 1, \
+                f"respec never promoted: {rep}"
+            for _ in range(20):
+                run_one(shifted, want_shift)
+                settle()
+                if not excprof.respecialize_recommended(tenant):
+                    break
+            score = excprof.drift_score(tenant)
+            assert not excprof.respecialize_recommended(tenant), \
+                f"drift did not clear after promotion (score {score:.2f})"
+            # background-lane isolation: the candidate compile(s) rode
+            # the background pool, never a foreground slot
+            delta = CQ.delta(fg_snap)
+            assert delta.get("background_compiles", 0) >= 1, \
+                "candidate compile never used the background lane"
+            promote_ev = next((e for e in rep["history"]
+                               if e["phase"] == "promote"), {})
+            # exposition parity: the lifecycle counters are scrapeable
+            if telemetry.enabled():
+                prom = telemetry.render_prometheus()
+                assert "tuplex_serve_respec_promotions_total" in prom, \
+                    "serve_respec_promotions missing from /metrics"
+                assert "tuplex_serve_respec_triggered_total" in prom
+        finally:
+            svc.close()
+            ctx.close()
+        wall = time.perf_counter() - t0
+        result = {
+            "metric": "respec_smoke_promote_s",
+            "value": promote_ev.get("promote_s", 0.0),
+            "unit": "s",
+            "rows": args.rows,
+            "jobs": n_jobs[0],
+            "respec_trip_jobs": trip_jobs,
+            "respec_promotions": rep["promotions"],
+            "respec_quarantines": rep["quarantines"],
+            "respec_rollbacks": rep["rollbacks"],
+            "promote_s": promote_ev.get("promote_s", 0.0),
+            "drift_after_promote": round(score, 4),
+            "background_compiles": delta.get("background_compiles", 0),
+            "wall_s": round(wall, 3),
+        }
+        line = json.dumps(result)
+        print(line, flush=True)
+        if args.out:
+            with open(args.out, "w") as fp:
+                fp.write(line + "\n")
+        print("respec-smoke OK", file=sys.stderr)
+        return 0
+    finally:
+        if prev_aot is None:
+            os.environ.pop("TUPLEX_AOT_CACHE", None)
+        else:
+            os.environ["TUPLEX_AOT_CACHE"] = prev_aot
+        shutil.rmtree(state, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

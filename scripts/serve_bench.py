@@ -108,6 +108,11 @@ def main(argv=None) -> int:
                     help="zillow rows per job input")
     ap.add_argument("--slots", type=int, default=1,
                     help="tuplex.serve.slots (in-flight dispatches)")
+    ap.add_argument("--respec", choices=("on", "off"), default="on",
+                    help="tuplex.serve.respec for the A/B required by "
+                         "the self-healing acceptance: p99 with the "
+                         "respec controller active must be within noise "
+                         "of respec-off")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny tier-1 CI variant (3 jobs x 200 rows)")
     ap.add_argument("--out", default=None,
@@ -136,6 +141,7 @@ def main(argv=None) -> int:
             "tuplex.scratchDir": os.path.join(d, "scratch"),
             "tuplex.serve.slots": args.slots,
             "tuplex.serve.queueDepth": max(64, 2 * args.jobs),
+            "tuplex.serve.respec": args.respec == "on",
         })
         svc = JobService(ctx.options_store)
 

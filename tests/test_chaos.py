@@ -12,7 +12,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def test_chaos_bench_smoke():
     """All smoke fault classes (compile hang -> killed child, dispatch
-    flake -> partition ladder, serve step fault -> retry ladder) deliver
+    flake -> partition ladder, serve step fault -> retry ladder, plus
+    the closed-loop respec-drift / respec-poison scenarios) deliver
     correct results from every job and leave health at ok."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("TUPLEX_FAULTS", None)
@@ -20,7 +21,7 @@ def test_chaos_bench_smoke():
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts", "chaos_bench.py"),
          "--smoke", "--deadline", "2"],
-        capture_output=True, text=True, timeout=540, env=env, cwd=REPO)
+        capture_output=True, text=True, timeout=1200, env=env, cwd=REPO)
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
     line = [ln for ln in r.stdout.splitlines() if ln.startswith("{")][-1]
     result = json.loads(line)
@@ -34,6 +35,11 @@ def test_chaos_bench_smoke():
             (name, cls)
         assert cls["health_final"] == "ok", (name, cls)
     assert classes["serve-retry"]["retries"] >= 1
+    # the closed loop: respec promoted under permanent drift, and the
+    # poisoned candidates were quarantined without a single promotion
+    assert classes["respec-drift"]["respec_promotions"] >= 1
+    assert classes["respec-poison"]["respec_quarantines"] >= 2
+    assert classes["respec-poison"]["respec_promotions"] == 0
     assert "chaos-bench OK" in r.stderr
 
 

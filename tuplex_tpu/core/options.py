@@ -179,6 +179,53 @@ DEFAULTS: dict[str, str] = {
                                             # respecialize_recommended one
                                             # window after a distribution
                                             # shift
+    "tuplex.serve.respec": "true",          # closed-loop self-healing
+                                            # (serve/respec.py): when a
+                                            # tenant's exception-plane
+                                            # drift trips respecialize_
+                                            # recommended (runtime/
+                                            # excprof), re-speculate its
+                                            # plan from the LIVE observed
+                                            # code distribution, compile
+                                            # the candidate on the
+                                            # background compile lane,
+                                            # canary it on the tenant's
+                                            # next job, and hot-swap at a
+                                            # job boundary (the incumbent
+                                            # stays the fallback rung in
+                                            # exec/local's tier-restart
+                                            # ladder). false = sense only
+                                            # (the PR-13 behavior)
+    "tuplex.serve.respecCheckS": "1",       # seconds between controller
+                                            # drift polls per tenant
+    "tuplex.serve.respecDebounce": "2",     # consecutive polls a tenant
+                                            # must stay respecialize-
+                                            # recommended before a
+                                            # candidate build starts (one
+                                            # noisy window must not spend
+                                            # a background compile)
+    "tuplex.serve.respecCooldownS": "120",  # minimum seconds between
+                                            # respecialization attempts
+                                            # for one tenant (promote or
+                                            # abandon both arm it)
+    "tuplex.serve.respecCanaryFrac": "0.25",  # fraction of the canary
+                                            # job's partitions shadow-
+                                            # executed on the candidate
+                                            # per stage (>=1 partition;
+                                            # the job's OWN results always
+                                            # come from the incumbent)
+    "tuplex.serve.respecCompileDeadlineS": "120",  # ceiling on the whole
+                                            # candidate compile phase; a
+                                            # candidate that cannot
+                                            # compile in time is
+                                            # quarantined, never promoted
+    "tuplex.serve.respecQuarantineS": "300",  # base cooldown after a
+                                            # quarantined candidate; the
+                                            # SAME candidate signature
+                                            # (content-addressed
+                                            # `.respecquar` marker)
+                                            # doubles it per repeat so a
+                                            # poisoned respec cannot flap
     # --- TPU-native keys ---------------------------------------------------
     "tuplex.tpu.deviceBatchSize": "1048576",    # rows per device dispatch
     "tuplex.tpu.padBucketing": "q8",            # q8 | pow2 | exact

@@ -64,6 +64,7 @@ STATS: dict[str, Any] = {
     "subprocess_compiles": 0, "compiles_killed": 0,
     "fork_deadlocks": 0,
     "nodeser_marks": 0, "nodeser_skips": 0,
+    "background_compiles": 0,
 }
 
 _LOCK = threading.Lock()
@@ -77,6 +78,8 @@ _PENDING: dict[str, Future] = {}     # fingerprint -> in-flight compile
 _PENDING_T: dict[str, float] = {}    # fingerprint -> compile start (monotonic)
 _TAG: dict[str, list] = {}           # tag -> [seconds, count] (unconsumed)
 _POOL: Optional["_DaemonPool"] = None
+_BG_POOL: Optional["_DaemonPool"] = None   # low-priority background lane
+_BG_TLS = threading.local()          # background_lane() thread flag
 
 
 def _mem_capacity() -> int:
@@ -113,11 +116,11 @@ class _DaemonPool:
     never outlive the job that asked for it: daemon workers die with the
     process, and pending queue items are simply dropped."""
 
-    def __init__(self, workers: int):
+    def __init__(self, workers: int, name: str = "tpx-compile"):
         self._q: "queue.Queue" = queue.Queue()
         for i in range(workers):
             t = threading.Thread(target=self._run, daemon=True,
-                                 name=f"tpx-compile-{i}")
+                                 name=f"{name}-{i}")
             t.start()
 
     def _run(self) -> None:
@@ -166,11 +169,13 @@ def pending_info() -> dict:
     with _LOCK:
         oldest = min(_PENDING_T.values(), default=None)
         queued = _POOL._q.qsize() if _POOL is not None else 0
+        bg_queued = _BG_POOL._q.qsize() if _BG_POOL is not None else 0
         return {
             "inflight": len(_PENDING),
             "inflight_oldest_age_seconds":
                 (now - oldest) if oldest is not None else 0.0,
             "pool_queued": queued,
+            "background_queued": bg_queued,
         }
 
 
@@ -203,6 +208,55 @@ def pool() -> "_DaemonPool":
         if _POOL is None:
             _POOL = _DaemonPool(_workers())
         return _POOL
+
+
+# ---------------------------------------------------------------------------
+# the background compile lane (serve/respec candidate compiles)
+# ---------------------------------------------------------------------------
+# Speculative RE-specialization compiles must never slow a paying job:
+# they ride a separate low-priority pool (one daemon worker by default,
+# TUPLEX_BG_COMPILE_WORKERS) so a foreground dispatch never finds its
+# compile-queue slot occupied by a background candidate, and the
+# foreground pool's queue never has a candidate ahead of a job's stage.
+# The lanes still SHARE the content-addressed stores and the in-flight
+# table: a foreground request for a fingerprint the background lane is
+# already compiling joins that future instead of compiling twice — the
+# one way background work may interact with foreground, because it only
+# ever makes the foreground FASTER.
+
+
+class background_lane:
+    """Context manager: ``submit_compile`` calls made by this thread
+    while inside route to the background pool. The flag is thread-local
+    and does not propagate into the pool job itself (nested submits from
+    a bg worker would deadlock a one-worker lane)."""
+
+    def __enter__(self):
+        _BG_TLS.active = getattr(_BG_TLS, "active", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _BG_TLS.active = max(0, getattr(_BG_TLS, "active", 1) - 1)
+        return False
+
+
+def background_active() -> bool:
+    return bool(getattr(_BG_TLS, "active", 0))
+
+
+def _bg_workers() -> int:
+    try:
+        return max(1, int(os.environ.get("TUPLEX_BG_COMPILE_WORKERS", "1")))
+    except ValueError:
+        return 1
+
+
+def bg_pool() -> "_DaemonPool":
+    global _BG_POOL
+    with _LOCK:
+        if _BG_POOL is None:
+            _BG_POOL = _DaemonPool(_bg_workers(), name="tpx-bgcompile")
+        return _BG_POOL
 
 
 def _workers() -> int:
@@ -286,6 +340,78 @@ def _artifact_path(fp: str) -> Optional[str]:
     return os.path.join(d, fp + ".aot")
 
 
+# ---------------------------------------------------------------------------
+# condemnation markers (one helper for every negative-cache verdict)
+# ---------------------------------------------------------------------------
+# A marker is a small JSON verdict file next to (or content-addressed
+# like) an AOT artifact: `.timeout` (compile blew the deadline),
+# `.nodeser` (serialized executable cannot deserialize/run) and the
+# serve plane's `.respecquar` (quarantined re-specialization candidate,
+# serve/respec.py). All three used to be ad-hoc bare files; the shared
+# helper records PROVENANCE — which defect class condemned the artifact,
+# on which platform, when and why — and ``read_marker`` only honors a
+# marker whose recorded kind matches the suffix it was found under, so a
+# healthy artifact can never be condemned by a different defect class
+# (a torn write, a buggy writer, a copied file). Markers written by
+# earlier builds (bare platform text) still count for their own suffix.
+
+MARKER_KINDS = ("timeout", "nodeser", "respecquar")
+
+
+def marker_path(base_path: str, kind: str) -> str:
+    return base_path + "." + kind
+
+
+def write_marker(base_path: Optional[str], kind: str, reason: str = "",
+                 **prov) -> Optional[str]:
+    """Persist one condemnation verdict (atomic; best-effort by the
+    negative-cache contract). Returns the marker path or None when there
+    is nowhere to write (no cache dir)."""
+    if base_path is None:
+        return None
+    import json
+
+    rec = {"kind": kind, "platform": _platform_salt(),
+           "created": time.time(), "reason": str(reason)[:400]}
+    rec.update(prov)
+    path = marker_path(base_path, kind)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
+        return path
+    except OSError:   # pragma: no cover - marker is best-effort
+        return None
+
+
+def read_marker(base_path: Optional[str], kind: str) -> Optional[dict]:
+    """The verdict at ``base_path + '.' + kind``, or None when absent OR
+    when the file's recorded kind contradicts the suffix (a different
+    defect class must never condemn this artifact through a mislabeled
+    file)."""
+    if base_path is None:
+        return None
+    import json
+
+    path = marker_path(base_path, kind)
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        if not os.path.exists(path):
+            return None
+        # legacy marker (bare platform-salt text from earlier builds) or
+        # torn write: the suffix it sits under still scopes it to ITS
+        # kind, so it stands for that kind alone
+        return {"kind": kind, "legacy": True}
+    if not isinstance(rec, dict):
+        return {"kind": kind, "legacy": True}
+    if rec.get("kind") not in (None, kind):
+        return None
+    return rec
+
+
 def _timeout_marker(fp: str):
     path = _artifact_path(fp)
     return None if path is None else path + ".timeout"
@@ -318,8 +444,7 @@ def _nodeser_known(fp: str) -> bool:
     double-compile the ROADMAP residue names)."""
     if fp in _NODESER:
         return True
-    m = _nodeser_marker(fp)
-    return m is not None and os.path.exists(m)
+    return read_marker(_artifact_path(fp), "nodeser") is not None
 
 
 def _note_nodeser(fp: str) -> None:
@@ -329,14 +454,9 @@ def _note_nodeser(fp: str) -> None:
     with _LOCK:
         _NODESER.add(fp)
         STATS["nodeser_marks"] += 1
-    m = _nodeser_marker(fp)
-    if m is None:
-        return
-    try:
-        with open(m, "w") as f:
-            f.write(_platform_salt())
-    except OSError:   # pragma: no cover - marker is best-effort
-        pass
+    write_marker(_artifact_path(fp), "nodeser",
+                 reason="serialized executable cannot deserialize/run "
+                        "(XLA 'Symbols not found' gap)", fp=fp)
 
 
 def note_deserialize_defect(entry) -> None:
@@ -367,20 +487,13 @@ def _deadline_known_exceeded(fp: str) -> bool:
     SUCCESSFUL compile wins: the artifact is checked before the marker."""
     if fp in _TIMEOUTS:
         return True
-    m = _timeout_marker(fp)
-    return m is not None and os.path.exists(m)
+    return read_marker(_artifact_path(fp), "timeout") is not None
 
 
 def _note_deadline_exceeded(fp: str) -> None:
     _TIMEOUTS.add(fp)
-    m = _timeout_marker(fp)
-    if m is None:
-        return
-    try:
-        with open(m, "w") as f:
-            f.write(_platform_salt())
-    except OSError:   # pragma: no cover - marker is best-effort
-        pass
+    write_marker(_artifact_path(fp), "timeout",
+                 reason="stage compile exceeded the deadline", fp=fp)
 
 
 def _artifact_meta() -> dict:
@@ -1068,11 +1181,18 @@ def submit_compile(fn, args: tuple, donate_argnums=(), salt: str = "",
                    deadline_s=None) -> Future:
     """Queue a compile on the pool (ahead-of-time / overlapped with
     execution). Foreground dispatches of the same fingerprint join the
-    in-flight future instead of compiling again."""
+    in-flight future instead of compiling again. Inside a
+    ``background_lane()`` the compile lands on the separate low-priority
+    background pool instead — candidate re-specialization compiles never
+    occupy a foreground slot or queue ahead of a job's stage compile."""
+    bg = background_active()
     with _LOCK:
         STATS["pool_jobs"] += 1
+        if bg:
+            STATS["background_compiles"] += 1
+    target = bg_pool() if bg else pool()
     if not TR.enabled():
-        return pool().submit(compile_traced, fn, args,
+        return target.submit(compile_traced, fn, args,
                              donate_argnums=donate_argnums, salt=salt,
                              tag=tag, n_ops=n_ops, deadline_s=deadline_s)
 
@@ -1083,12 +1203,13 @@ def submit_compile(fn, args: tuple, donate_argnums=(), salt: str = "",
         # pool's queue pressure — record it as a real interval so a plan
         # whose compiles serialize behind each other shows the backlog
         TR.complete("compile:pool-queue-wait", "compile", t_sub,
-                    TR.now_us() - t_sub, {"tag": tag[:16]})
+                    TR.now_us() - t_sub,
+                    {"tag": tag[:16], "lane": "bg" if bg else "fg"})
         return compile_traced(fn, args, donate_argnums=donate_argnums,
                               salt=salt, tag=tag, n_ops=n_ops,
                               deadline_s=deadline_s)
 
-    return pool().submit(_pool_job)
+    return target.submit(_pool_job)
 
 
 # ---------------------------------------------------------------------------

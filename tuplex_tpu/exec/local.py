@@ -348,6 +348,22 @@ class LocalBackend:
         data-dependent: pipeline breakers, filters/limits (output row
         count), compacted outputs, host-repacked wire layouts."""
         from ..compiler import stagefn as SF
+
+        try:
+            avals = SF.partition_avals(first_part, self.bucket_mode)
+            schema = first_part.schema
+        except Exception:
+            return []
+        return self._precompile_avals(stages, avals, schema)
+
+    def _precompile_avals(self, stages, avals, schema):
+        """The aval-driven half of :meth:`_precompile_driver`, callable
+        without a live partition: the respecialization controller
+        (serve/respec) stores each tenant's stage-0 dispatch avals and
+        replays them here — inside a compilequeue ``background_lane()``
+        — to compile a candidate stage set ahead of its canary with zero
+        foreground partitions in hand."""
+        from ..compiler import stagefn as SF
         from ..plan import logical as L
         from ..plan.physical import TransformStage, consumer_kind
         from ..runtime.jaxcfg import (device_handoff_enabled,
@@ -356,11 +372,6 @@ class LocalBackend:
         from . import compilequeue as CQ
 
         futs: list = []
-        try:
-            avals = SF.partition_avals(first_part, self.bucket_mode)
-            schema = first_part.schema
-        except Exception:
-            return futs
         donate = donation_enabled() and self.options.get_bool(
             "tuplex.tpu.donateBuffers", True)
         for si, stage in enumerate(stages):
@@ -539,14 +550,49 @@ class LocalBackend:
                 # the re-run re-records every partition the aborted tier
                 # already processed: back out this execution's exception-
                 # plane accounting so rows_seen/exception_rate and the
-                # drift windows don't double-count
+                # drift windows don't double-count (BEFORE any overlay
+                # revert below — the discard must hit the key the aborted
+                # execution recorded under)
                 if EX.enabled():
                     EX.discard_stage(stage.key(), owner=id(self))
+                from ..utils.logging import get_logger
+
+                # re-specialization fallback rung (serve/respec): a stage
+                # running under a promoted candidate overlay whose
+                # compile blows the deadline falls back onto the RETAINED
+                # INCUMBENT configuration first — same 'device' tier,
+                # previous plan generation, restarted from partition 0 so
+                # rows are never split across plan generations mid-stage
+                # (the PR-8 tier-purity invariant, extended to
+                # generations). The controller is told so it quarantines
+                # the candidate and demotes the tenant for future jobs.
+                rev = getattr(stage, "_respec_revert", None)
+                if rev is not None:
+                    for k, v in rev.items():
+                        setattr(stage, k, v)
+                    stage._respec_revert = None
+                    for memo in ("_resolve_plan_memo",):
+                        if hasattr(stage, memo):
+                            try:
+                                delattr(stage, memo)
+                            except AttributeError:
+                                pass
+                    notify = getattr(stage, "_respec_notify", None)
+                    if notify is not None:
+                        try:
+                            notify(tr.cause)
+                        except Exception:   # controller is advisory here
+                            pass
+                    tier = "device"
+                    get_logger("exec").warning(
+                        "stage %s failed under its re-specialized "
+                        "generation (%s); restarting the whole stage on "
+                        "the retained incumbent (restart %d)",
+                        stage.key()[:12], tr.cause, restarts)
+                    continue
                 # a degraded tier timing out again steps down once more;
                 # the cap is belt-and-braces (the ladder is 3 rungs)
                 tier = "interpreter" if restarts >= 3 else tr.tier
-                from ..utils.logging import get_logger
-
                 get_logger("exec").warning(
                     "stage %s compile deadline (%s); restarting the "
                     "whole stage on the %s tier (restart %d)",

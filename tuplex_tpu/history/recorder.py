@@ -217,6 +217,16 @@ class JobRecorder:
         cursor."""
         self._write({**fields, "event": event, "job": str(job_id)})
 
+    def respec_event(self, tenant: str, phase: str, **fields) -> None:
+        """Re-specialization lifecycle row (serve/respec): one record per
+        per-tenant transition — trigger / candidate-ready / canary-start
+        / promote / quarantine / rollback — keyed by a synthetic
+        per-tenant job id so the dashboard renders each tenant's plan-
+        generation history as its own timeline."""
+        self._write({**fields, "event": "respec", "phase": str(phase),
+                     "tenant": str(tenant),
+                     "job": f"respec:{tenant}"})
+
     def _write_job_spans(self) -> None:
         """Embed this job's span slice (runtime/tracing, when enabled) into
         the history file — the dashboard waterfall and the `trace` CLI
@@ -365,8 +375,20 @@ def _excprof_html(ev: dict) -> str:
     mix = drift.get("tier_mix") or {}
     tenant = ev.get("tenant")
     pct = max(0.0, min(1.0, score)) * 100
-    badge = (' <span class=respbadge>respecialize recommended</span>'
-             if resp else "")
+    # the respecialize badge is a LIFECYCLE now (serve/respec): when the
+    # service's controller annotated this row, show where the tenant is
+    # in drift → candidate → canary → promote/quarantine instead of the
+    # bare recommendation
+    rstate = ev.get("respec_state")
+    rgen = ev.get("respec_generation")
+    if rstate and (rstate != "idle" or resp):
+        label = f"respec: {rstate}"
+        if rgen:
+            label += f" (gen {rgen})"
+        badge = f' <span class=respbadge>{html.escape(label)}</span>'
+    else:
+        badge = (' <span class=respbadge>respecialize recommended</span>'
+                 if resp else "")
     mix_s = ", ".join(f"{k} {v * 100:.1f}%" for k, v in sorted(mix.items())
                       if v) or "—"
     who = f"tenant {html.escape(str(tenant))}" if tenant else "global"
@@ -491,6 +513,28 @@ def _render_doc(log_dir: str, live: bool) -> str:
 
     rows_html = []
     for job_id, events in jobs.items():
+        if job_id.startswith("respec:"):
+            # re-specialization lifecycle lane (serve/respec): the
+            # tenant's plan-generation history as one timeline row —
+            # drift trigger → candidate → canary → promote/quarantine
+            revs = [e for e in events if e.get("event") == "respec"]
+            if revs:
+                tenant = revs[0].get("tenant", job_id[len("respec:"):])
+                steps = []
+                for e in revs[-16:]:
+                    s = str(e.get("phase", "?"))
+                    if e.get("gen") is not None:
+                        s += f" g{e['gen']}"
+                    if e.get("reason"):
+                        s += f" ({html.escape(str(e['reason'])[:60])})"
+                    steps.append(html.escape(s) if "(" not in s else s)
+                last = revs[-1]
+                rows_html.append(
+                    f"<tr class=respec><td colspan=7>⟳ respec lifecycle"
+                    f" — tenant <code>{html.escape(str(tenant))}</code>"
+                    f" [{html.escape(str(last.get('phase', '?')))}]: "
+                    f"{' → '.join(steps)}</td></tr>")
+            continue
         done = next((e for e in events if e["event"] == "job_done"), {})
         stages = [e for e in events if e["event"] == "stage"]
         start = next((e for e in events if e["event"] == "job_start"), {})
@@ -644,6 +688,7 @@ def _render_doc(log_dir: str, live: bool) -> str:
  .driftfill {{ display: block; height: 8px; background: #c2703a; }}
  .respbadge {{ background: #a33; color: #fff; font-size: 11px;
                padding: 0 .4em; border-radius: 3px; }}
+ tr.respec td {{ color: #375; font-size: 12px; background: #f4faf4; }}
  .excsample {{ color: #765; font-size: 11px; margin-left: 1rem;
                overflow: hidden; white-space: nowrap;
                text-overflow: ellipsis; }}

@@ -150,6 +150,18 @@ class TransformStage:
                               # into this stage's device fn (plan_stages)
     speculate_branches = True  # prune if/else arms the sample never took
                               # (tuplex.optimizer.speculateBranches)
+    extra_expected_codes = ()  # re-specialization overlay (serve/respec):
+                              # exception codes OBSERVED in live traffic
+                              # folded into this stage's plan inventory —
+                              # the re-speculated plan EXPECTS them, so
+                              # they widen the resolve-buffer preallocation
+                              # and the excprof baseline instead of reading
+                              # as out-of-inventory drift forever
+    respec_salt = ""          # per-tenant plan-generation salt (respec
+                              # overlay): distinct stage.key() per
+                              # generation so baselines/executable-cache
+                              # entries never alias across generations or
+                              # across tenants at different generations
 
     @property
     def has_resolvers(self) -> bool:
@@ -186,6 +198,11 @@ class TransformStage:
         from ..core.errors import ExceptionCode as EC
 
         codes: set = set()
+        for c in self.extra_expected_codes or ():
+            try:        # live-observed codes adopted by re-specialization
+                codes.add(EC(int(c)))
+            except ValueError:
+                continue   # unknown device code: nothing to preallocate
         if self.force_interpret:
             codes.add(EC.PYTHON_FALLBACK)
         for op in self.ops:
@@ -414,6 +431,16 @@ class TransformStage:
         emitter)."""
         h = hashlib.sha256()
         h.update(self.input_schema.name.encode())
+        if self.respec_salt:
+            # per-generation key: a re-specialized stage must not share
+            # baselines / jit-cache entries with its incumbent (the XLA
+            # executable still dedups content-addressed in compilequeue,
+            # so identical jaxprs cost one compile regardless)
+            h.update(b"respec:")
+            h.update(str(self.respec_salt).encode())
+        if self.extra_expected_codes:
+            h.update(repr(tuple(sorted(
+                int(c) for c in self.extra_expected_codes))).encode())
         for op in self.ops:
             h.update(_op_identity(op).encode())
         if self.fold_op is not None:

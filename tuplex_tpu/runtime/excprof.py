@@ -170,6 +170,74 @@ def current_scope() -> Optional[str]:
     return getattr(_tls, "scope", None)
 
 
+class suppressed:
+    """Context manager: every record made by THIS thread while inside is
+    dropped (note_device/note_outcomes/note_tier/sample_row become
+    no-ops). The respecialization canary (serve/respec) shadow-executes
+    a candidate stage over rows the incumbent already accounted — its
+    rows must hit neither the tenant's drift window nor the stage
+    totals, or the canary itself would read as drift."""
+
+    def __enter__(self):
+        _tls.suppress = getattr(_tls, "suppress", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _tls.suppress = max(0, getattr(_tls, "suppress", 1) - 1)
+        return False
+
+
+def _suppressed() -> bool:
+    return bool(getattr(_tls, "suppress", 0))
+
+
+def drop_scope(scope) -> Optional[dict]:
+    """Release one scope's drift window/EWMA state and return its final
+    cumulative snapshot (or None if the scope never recorded). The job
+    service calls this when a tenant's last retained record is evicted —
+    per-tenant windows/anchors otherwise live for the life of the
+    process, an unbounded leak under a churning tenant population (the
+    xferstats counter families already had the same retirement hook).
+    The '' global window is never dropped this way."""
+    name = str(scope) if scope is not None else ""
+    if not name:
+        return None
+    with _LOCK:
+        w = _WIN.pop(name, None)
+        return dict(w) if w is not None else None
+
+
+def reanchor(scope, rate: Optional[float] = None) -> None:
+    """Adopt the scope's LIVE exception profile as its new plan-normal
+    anchor — the promotion half of the respecialization loop
+    (serve/respec): the re-speculated plan was specialized FOR the
+    observed distribution, so that distribution is its normal case. The
+    pending window folds first, then anchor and EWMA both move to the
+    observed rate (floored like a first-window calibration) and the
+    unexpected-code EWMA clears (the candidate's widened inventory
+    expects those codes now). No-op for a scope that never recorded."""
+    name = "" if scope is None else str(scope)
+    now = time.monotonic()
+    with _LOCK:
+        w = _WIN.get(name)
+        if w is None:
+            return
+        # the pending (not yet rolled) window is the FRESHEST evidence of
+        # the live rate — the EWMA may still be converging toward it, and
+        # an anchor below the true steady-state rate would re-trip on the
+        # very traffic the new plan was specialized for
+        pend = (w["errs"] / w["rows"]) if w["rows"] > 0 else 0.0
+        _roll_locked(w, now, force=True)
+        r = max(float(rate) if rate is not None else 0.0,
+                w["ewma_rate"] or 0.0, pend)
+        if r <= 0.0 and w["ewma_rate"] is None:
+            return
+        floor = _normal_rate if w["expect_codes"] else _CLEAN_FLOOR
+        w["anchor"] = max(floor, r)
+        w["ewma_rate"] = w["anchor"]
+        w["ewma_unexpected"] = 0.0
+
+
 # ---------------------------------------------------------------------------
 # plan-time baseline
 # ---------------------------------------------------------------------------
@@ -312,7 +380,7 @@ def note_device(stage: str, rows: int, packed_codes=None,
     that erred (class code in the low byte, operator id above), and
     `fallback_rows` rows never reached the device at all (input-boxed
     fallback slots / whole-partition interpreter routing)."""
-    if not _enabled or not stage or rows < 0:
+    if not _enabled or not stage or rows < 0 or _suppressed():
         return
     pairs: list = []
     n_err = 0
@@ -356,7 +424,7 @@ def note_outcomes(stage: str, pairs, tier: str, owner: int = 0) -> None:
     """Final per-row attribution for one resolve tier: `pairs` is a list
     of (code, op_id) — which exception code landed on `tier`
     ('exact-exit' / 'general' / 'interpreter')."""
-    if not _enabled or not stage or not pairs:
+    if not _enabled or not stage or not pairs or _suppressed():
         return
     with _LOCK:
         a = _acc(owner, stage)
@@ -379,7 +447,7 @@ def note_tier(stage: str, tier: str, rows: int, retired: int,
     entered, `retired` left resolved, `seconds` of wall time — the
     resolve latency lands in the ``excprof_resolve_seconds{stage,tier}``
     telemetry histogram next to the serve-path latencies."""
-    if not _enabled or not stage:
+    if not _enabled or not stage or _suppressed():
         return
     from . import telemetry
 
@@ -396,7 +464,7 @@ def sample_row(stage: str, code: int, row) -> None:
     stage x code, repr-truncated — enough to answer "what does a row
     that falls to this tier look like" from the dashboard, small enough
     that a poison tenant cannot fill the process with row payloads."""
-    if not _enabled or not stage or _sample_k <= 0:
+    if not _enabled or not stage or _sample_k <= 0 or _suppressed():
         return
     key = (stage, int(code))
     with _LOCK:

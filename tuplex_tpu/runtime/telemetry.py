@@ -276,6 +276,14 @@ class Registry:
         with self._lock:
             self._gauges[(name, _label_key(labels))] = (owner, value)
 
+    def remove_gauge(self, name: str, **labels) -> None:
+        """Unregister one gauge series (e.g. a retired serve tenant's
+        per-tenant gauge — without this, a churning tenant population
+        accumulates one dead callback per tenant ever seen, each
+        exported as a stale sample on every scrape)."""
+        with self._lock:
+            self._gauges.pop((name, _label_key(labels)), None)
+
     def gauge_samples(self) -> list[tuple[str, tuple, float]]:
         with self._lock:
             items = list(self._gauges.items())
@@ -355,6 +363,10 @@ def set_gauge(name: str, value, owner=None, **labels) -> None:
     if not _enabled:
         return
     _REG.set_gauge(name, value, owner=owner, **labels)
+
+
+def remove_gauge(name: str, **labels) -> None:
+    _REG.remove_gauge(name, **labels)
 
 
 def register_health_check(name: str, fn: Callable, owner=None) -> None:

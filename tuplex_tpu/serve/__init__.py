@@ -8,6 +8,9 @@ Public surface:
 * ``JobHandle`` — caller-side state/result/metrics view.
 * ``client`` — the scratch-dir wire protocol + the
   ``python -m tuplex_tpu serve`` loop (serve/client.py).
+* ``RespecController`` — closed-loop self-healing (serve/respec.py):
+  background re-specialization keyed off the exception-plane drift
+  signal, canary validation, guarded hot-swap, automatic rollback.
 * ``Context.submit(ds)`` (api/context.py) is the one-liner entry point.
 
 Observability: the service feeds per-tenant latency histograms, queue/
@@ -20,10 +23,11 @@ scraped via ``--metrics-port`` (/metrics + /healthz), the periodic
 from .jobs import (CANCELLED, DONE, FAILED, QUEUED, REJECTED, RUNNING,
                    JobFailed, JobHandle, JobRejected, JobRequest,
                    QueueFull, request_from_dataset)
+from .respec import RespecController
 from .service import JobService
 
 __all__ = [
     "JobService", "JobRequest", "JobHandle", "JobRejected", "JobFailed",
-    "QueueFull", "request_from_dataset", "QUEUED", "RUNNING", "DONE",
-    "FAILED", "REJECTED", "CANCELLED",
+    "QueueFull", "request_from_dataset", "RespecController", "QUEUED",
+    "RUNNING", "DONE", "FAILED", "REJECTED", "CANCELLED",
 ]

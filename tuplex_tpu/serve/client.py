@@ -361,6 +361,27 @@ def service_loop(root: str, options=None, *, poll_s: float = 0.1,
     # once, poison jobs are failed cleanly
     finished, n_requeued, n_poisoned = _recover_inbox(
         inbox, svc.retry_count)
+    # crash-recovery observability: the requeue/poison outcomes used to
+    # exist only in the per-job journals — export them as counters (the
+    # xferstats bridge puts `tuplex_serve_recovered_jobs_total` /
+    # `tuplex_serve_poison_jobs_total` on /metrics) and as a health-check
+    # detail so the /healthz payload states what the last restart did
+    from ..runtime import xferstats
+
+    if n_requeued:
+        xferstats.bump("serve_recovered_jobs", n_requeued, tag="requeued")
+    if n_poisoned:
+        xferstats.bump("serve_poison_jobs", n_poisoned, tag="poisoned")
+    if telemetry.enabled():
+        recovery_detail = (
+            f"last start over this root: {n_requeued} in-flight job(s) "
+            f"requeued, {n_poisoned} poison job(s) failed cleanly, "
+            f"{len(finished)} finished response(s) kept"
+            if (n_requeued or n_poisoned)
+            else "no crash recovery needed at start")
+        telemetry.register_health_check(
+            "serve_recovery",
+            lambda d=recovery_detail: (telemetry.OK, d), owner=svc)
     served = 0
     last_activity = time.monotonic()
     log.info("job service listening on %s (slots=%d, depth=%d)%s",

@@ -339,6 +339,14 @@ class _JobRunner:
         self.stages = [self._rebuild(e) for e in self.entries]
         if not self.stages:
             raise TuplexException("job has no stages")
+        # re-specialization hot-swap (serve/respec): the record carries
+        # the plan generation PINNED AT ADMISSION — applied here, at
+        # every rebuild (retries included), so one job never mixes plan
+        # generations and a promotion mid-flight only affects jobs
+        # admitted after the swap
+        ctrl = getattr(record, "_respec_ctrl", None)
+        if ctrl is not None:
+            ctrl.overlay_job(self)
         self.si = 0
         self.partitions: Any = []
 
@@ -371,6 +379,7 @@ class _JobRunner:
 
         stage = self.stages[self.si]
         entry = self.entries[self.si]
+        ctrl = getattr(self.record, "_respec_ctrl", None)
         if self.si == 0 or entry.get("indir") \
                 or getattr(stage, "source", None) is not None:
             self.partitions = self._load_input(entry, stage)
@@ -383,9 +392,36 @@ class _JobRunner:
                         pre(self.stages, self.partitions)
                     except Exception:
                         pass
+                if ctrl is not None:
+                    # aval hint for background candidate compiles: the
+                    # stage-0 dispatch shapes, a few ShapeDtypeStructs —
+                    # never a partition reference (that would pin memory)
+                    try:
+                        from ..compiler import stagefn as SF
+
+                        first = self.partitions[0] \
+                            if isinstance(self.partitions, list) \
+                            and self.partitions else None
+                        if first is not None:
+                            ctrl.note_input(
+                                self.record.request.tenant,
+                                SF.partition_avals(
+                                    first, self.backend.bucket_mode),
+                                first.schema)
+                    except Exception:   # hint is best-effort
+                        pass
         consumer = consumer_kind(self.stages, self.si)
+        canary_inputs = self.partitions \
+            if ctrl is not None \
+            and getattr(self.record, "respec_canary", None) is not None \
+            else None
         res = self.backend.execute_any(stage, self.partitions, self.ctx,
                                        intermediate=consumer)
+        if canary_inputs is not None:
+            # canary: shadow-execute the candidate generation on a
+            # bounded fraction of the SAME inputs; the job's results
+            # below stay 100% incumbent (never mixed across generations)
+            ctrl.canary_stage(self, self.si, stage, canary_inputs, res)
         self.partitions = res.partitions
         self.record.metrics.record_stage(res.metrics)
         self.record.exceptions.extend(res.exceptions)

@@ -1,0 +1,966 @@
+"""Closed-loop re-specialization: sense drift, rebuild, canary, hot-swap.
+
+PR 13 landed the SENSING half of adaptive serving: ``runtime/excprof``
+watches each tenant's live exception traffic against the plan-time
+baseline and fires ``respecialize_recommended`` when the distribution
+drifts. Until now nothing acted on it — a tenant whose data drifted just
+decayed into the resolve tiers forever. This module is the ACTING half,
+a per-tenant state machine the job service owns:
+
+* **trigger** — a controller thread polls the drift signal (debounced:
+  ``tuplex.serve.respecDebounce`` consecutive recommendations; per-tenant
+  ``respecCooldownS`` between attempts), so one noisy window never spends
+  a background compile.
+* **re-speculate from LIVE evidence** — the candidate plan is rebuilt
+  from the tenant's last request spec, but specialized for the traffic
+  the service actually OBSERVED rather than the stale plan-time sample:
+  exception codes seen live fold into the stage inventory
+  (``TransformStage.extra_expected_codes`` — they widen the resolve
+  preallocation and the drift baseline instead of reading as
+  out-of-inventory drift forever), and a stage whose pruned cold arm is
+  provably being hit (observed NORMALCASEVIOLATION traffic + captured
+  deviant-row samples) is re-compiled WITHOUT branch speculation so
+  those rows return to the compiled path. Every candidate stage carries
+  a per-generation ``respec_salt`` so baselines and jit-cache entries
+  never alias across generations (the XLA executable itself still dedups
+  content-addressed in exec/compilequeue).
+* **background compile** — candidate stages compile via the compile
+  queue's ``background_lane()``: a separate low-priority pool, so a
+  foreground job's compile never finds its slot occupied by a candidate.
+  The whole phase is bounded by ``respecCompileDeadlineS``; a candidate
+  that cannot compile in time is quarantined, never promoted.
+* **canary** — the tenant's next job shadow-executes the candidate on a
+  bounded fraction of its partitions (``respecCanaryFrac``), cross-checks
+  output row counts and exception counts against the incumbent run of
+  the SAME partitions, and the job's own results always come from the
+  incumbent. Canary rows are excprof-suppressed — the probe must not
+  read as drift.
+* **promote / rollback** — a passing canary hot-swaps the tenant's
+  active overlay atomically at the job boundary (jobs admitted AFTER the
+  swap rebuild under the new generation; in-flight jobs keep the
+  generation pinned at admission), and re-anchors the tenant's excprof
+  window to the observed distribution — the re-specialized plan's normal
+  case IS the live traffic, so the drift score recovers without a
+  restart. The incumbent is retained as a fallback rung: a promoted
+  candidate that fails at run time (blown compile deadline at dispatch)
+  restarts the whole stage on the incumbent configuration
+  (exec/local ``_TierRestart`` — rows are never split across plan
+  generations mid-stage) and the tenant is demoted for future jobs.
+* **quarantine** — a failed/regressing candidate writes a
+  content-addressed ``.respecquar`` marker (the unified compilequeue
+  marker helper, provenance-stamped) keyed by the candidate's SIGNATURE
+  (incumbent stage keys + overlay content, generation-independent), with
+  an exponential cooldown — a poisoned respec cannot flap.
+
+Observability rides along: ``serve_respec_*`` counters (xferstats →
+/metrics), per-tenant generation gauges, a ``respec`` health check
+(degraded while self-healing is blocked: drift-recommended but
+quarantined, or a candidate stuck compiling), ``respec:compile /
+canary / promote / rollback`` spans, and per-tenant lifecycle events in
+the history recorder (the dashboard's "respecialize recommended" badge
+becomes a lifecycle). ``runtime/faults`` checkpoints sit in the
+candidate compile (``respec:…-compile``) and the canary dispatch
+(``respec:…-canary``) so ``scripts/chaos_bench.py`` can prove the
+rollback story end to end.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+from ..runtime import excprof, faults, telemetry
+from ..runtime import tracing as TR
+from ..runtime import xferstats
+from ..utils.logging import get_logger
+
+log = get_logger("tuplex_tpu.serve.respec")
+
+#: candidate lifecycle states
+COMPILING = "compiling"
+READY = "ready"
+CANARY = "canary"
+
+_HISTORY_CAP = 64
+
+
+class _TenantState:
+    """Controller-internal per-tenant record (all mutation under the
+    controller lock)."""
+
+    __slots__ = ("tenant", "gen", "overlay", "prev_overlay", "candidate",
+                 "last_entries", "last_options", "avals", "schema",
+                 "debounce", "cooldown_until", "quar", "history",
+                 "promotions", "quarantines", "rollbacks")
+
+    def __init__(self, tenant: str):
+        self.tenant = tenant
+        self.gen = 0                   # active plan generation
+        self.overlay: Optional[dict] = None       # active overlay (gen>0)
+        self.prev_overlay: Optional[dict] = None  # incumbent, for rollback
+        self.candidate: Optional[dict] = None
+        self.last_entries: Optional[list] = None  # wire-safe stage entries
+        self.last_options: dict = {}
+        self.avals = None              # stage-0 dispatch avals (hint)
+        self.schema = None
+        self.debounce = 0
+        self.cooldown_until = 0.0
+        self.quar: dict = {}           # sig -> (count, last epoch secs):
+                                       # the in-process quarantine record
+                                       # must carry its own timestamp —
+                                       # with no cache dir there is no
+                                       # marker to date the backoff from,
+                                       # and an undated quarantine would
+                                       # never expire
+        self.history: deque = deque(maxlen=_HISTORY_CAP)
+        self.promotions = 0
+        self.quarantines = 0
+        self.rollbacks = 0
+
+
+class RespecController:
+    """See module docstring. One instance per JobService; every public
+    method is safe to call from scheduler/worker threads."""
+
+    def __init__(self, service, options):
+        self.service = service
+        o = options
+        self.check_s = max(0.01, o.get_float("tuplex.serve.respecCheckS",
+                                             1.0))
+        self.debounce_n = max(1, o.get_int("tuplex.serve.respecDebounce",
+                                           2))
+        self.cooldown_s = max(0.0, o.get_float(
+            "tuplex.serve.respecCooldownS", 120.0))
+        self.canary_frac = min(1.0, max(0.0, o.get_float(
+            "tuplex.serve.respecCanaryFrac", 0.25)))
+        self.compile_deadline_s = max(0.1, o.get_float(
+            "tuplex.serve.respecCompileDeadlineS", 120.0))
+        self.quarantine_s = max(0.0, o.get_float(
+            "tuplex.serve.respecQuarantineS", 300.0))
+        self._lock = threading.Lock()
+        self._states: dict[str, _TenantState] = {}
+        self._backend = None           # lazy LocalBackend for bg compiles
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="tpx-respec")
+        self._register_telemetry()
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    # telemetry
+    # ------------------------------------------------------------------
+    def _register_telemetry(self) -> None:
+        if not telemetry.enabled():
+            return
+        telemetry.set_gauge(
+            "serve_respec_candidates",
+            lambda: sum(1 for s in list(self._states.values())
+                        if s.candidate is not None), owner=self)
+        telemetry.set_gauge(
+            "serve_respec_promoted_tenants",
+            lambda: sum(1 for s in list(self._states.values())
+                        if s.gen > 0), owner=self)
+        telemetry.register_health_check("respec", self._health_check,
+                                        owner=self)
+
+    def _health_check(self):
+        """Self-healing health: degraded while the loop is BLOCKED — a
+        tenant the drift detector wants re-specialized sits in a
+        quarantine cooldown (we cannot heal it), or a candidate compile
+        has run past twice its deadline (stuck background lane)."""
+        now = time.monotonic()
+        blocked: list = []
+        stuck: list = []
+        with self._lock:
+            states = list(self._states.items())
+        for tenant, st in states:
+            cand = st.candidate
+            if cand is not None and cand["state"] == COMPILING \
+                    and now - cand["t_start"] > 2 * self.compile_deadline_s:
+                stuck.append(tenant)
+            if st.quar and now < st.cooldown_until:
+                try:
+                    if excprof.respecialize_recommended(tenant):
+                        blocked.append(tenant)
+                except Exception:
+                    pass
+        if stuck:
+            return (telemetry.DEGRADED,
+                    f"candidate compile stuck past "
+                    f"{2 * self.compile_deadline_s:.0f}s for "
+                    f"tenant(s) {', '.join(sorted(stuck))}")
+        if blocked:
+            return (telemetry.DEGRADED,
+                    f"tenant(s) {', '.join(sorted(blocked))} drifted but "
+                    f"their respecialization is quarantined "
+                    f"(self-healing blocked)")
+        return (telemetry.OK, None)
+
+    def _gauge_tenant(self, tenant: str) -> None:
+        if not telemetry.enabled():
+            return
+        telemetry.set_gauge(
+            "serve_respec_generation",
+            lambda t=tenant: self._gen_of(t), owner=self, tenant=tenant)
+
+    def _gen_of(self, tenant: str) -> int:
+        st = self._states.get(tenant)
+        return st.gen if st is not None else 0
+
+    def _event(self, tenant: str, phase: str, **fields) -> None:
+        """One lifecycle transition: history deque + recorder row +
+        tenant log line (the dashboard renders the deque per tenant)."""
+        st = self._states.get(tenant)
+        if st is not None:
+            st.history.append({"t": time.time(), "phase": phase,
+                               **fields})
+        r = getattr(self.service, "recorder", None)
+        if r is not None and getattr(r, "enabled", False):
+            try:
+                r.respec_event(tenant, phase, **fields)
+            except Exception:   # dashboard rows are advisory
+                pass
+
+    # ------------------------------------------------------------------
+    # service integration points
+    # ------------------------------------------------------------------
+    def _state(self, tenant: str, create: bool = True) \
+            -> Optional[_TenantState]:
+        with self._lock:
+            st = self._states.get(tenant)
+            if st is None and create:
+                st = self._states[tenant] = _TenantState(tenant)
+                self._gauge_tenant(tenant)
+            return st
+
+    def pin(self, record) -> None:
+        """Pin the tenant's ACTIVE plan generation onto the job record
+        BEFORE its runner is built: the overlay object travels with the
+        record, so retries rebuild under the same generation and a
+        promotion mid-job only affects jobs admitted after the swap
+        (the hot-swap atomicity contract)."""
+        st = self._state(record.request.tenant)
+        with self._lock:
+            record.respec_gen = st.gen
+            record.respec_overlay = st.overlay
+        record._respec_ctrl = self
+
+    def note_admitted(self, record) -> None:
+        """Post-admission hook: remember the tenant's latest wire-safe
+        request (the respeculation substrate) and, when a validated
+        candidate is waiting, claim THIS job as its canary."""
+        req = record.request
+        st = self._state(req.tenant)
+        with self._lock:
+            if req.wire_safe():
+                st.last_entries = list(req.stages)
+                st.last_options = dict(req.options or {})
+            cand = st.candidate
+            if cand is not None and cand["state"] == READY \
+                    and cand.get("canary_job") is None:
+                cand["canary_job"] = record.id
+                cand["state"] = CANARY
+                record.respec_canary = cand
+        if getattr(record, "respec_canary", None) is not None:
+            xferstats.bump("serve_respec_canaries", 1, tag=req.tenant)
+            TR.instant("respec:canary-claim", "respec",
+                       {"tenant": req.tenant, "job": record.id,
+                        "gen": record.respec_canary["gen"]})
+            self._event(req.tenant, "canary-start",
+                        gen=record.respec_canary["gen"], job=record.id)
+            log.info("respec[%s]: job %s canaries candidate gen %d",
+                     req.tenant, record.id,
+                     record.respec_canary["gen"])
+
+    def note_input(self, tenant: str, avals, schema) -> None:
+        """Stage-0 dispatch avals of a live job (tiny ShapeDtypeStructs):
+        the background compile replays them through the backend's
+        precompile walk so the candidate executables are warm before the
+        canary ever dispatches."""
+        st = self._state(tenant)
+        with self._lock:
+            st.avals = avals
+            st.schema = schema
+
+    def note_tenant_retired(self, tenant: str) -> None:
+        """The service evicted the tenant's last retained record: drop
+        the controller state (a returning tenant recalibrates from
+        scratch, consistent with its excprof window being dropped). The
+        on-disk quarantine markers persist — flap protection survives
+        tenant churn and process restarts."""
+        with self._lock:
+            dropped = self._states.pop(tenant, None)
+        if dropped is not None:
+            # the per-tenant generation gauge dies with the state: a
+            # churning tenant population must not accumulate one dead
+            # gauge per tenant ever seen (the same leak class the
+            # excprof drop_scope satellite fixes)
+            telemetry.remove_gauge("serve_respec_generation",
+                                   tenant=tenant)
+
+    def stop(self) -> None:
+        self._stop.set()
+        telemetry.drop_owner(self)
+        self._thread.join(timeout=2.0)
+
+    # ------------------------------------------------------------------
+    # overlay plumbing (runner side)
+    # ------------------------------------------------------------------
+    def overlay_job(self, runner) -> None:
+        """Apply the record's pinned overlay to a freshly rebuilt stage
+        list (called from _JobRunner.__init__ — admission time AND retry
+        rebuilds, so one job never mixes plan generations)."""
+        record = runner.record
+        ov = getattr(record, "respec_overlay", None)
+        if not ov:
+            return
+        tenant = record.request.tenant
+        notify = self._make_notify(tenant, ov)
+        for si, stage in enumerate(runner.stages):
+            entry = runner.entries[si] if si < len(runner.entries) else {}
+            if isinstance(entry, dict) and "spec" in entry:
+                apply_overlay_to_stage(stage, ov, si, notify=notify)
+
+    def _make_notify(self, tenant: str, overlay: dict):
+        def _notify(cause):
+            self.note_runtime_failure(tenant, overlay, cause)
+        return _notify
+
+    def note_runtime_failure(self, tenant: str, overlay: dict,
+                             cause) -> None:
+        """The exec/local fallback rung fired: a stage running under
+        `overlay` failed at run time and already restarted on the
+        retained incumbent. Demote the tenant (future jobs rebuild on
+        the incumbent) and quarantine the candidate signature."""
+        st = self._state(tenant, create=False)
+        demoted = False
+        with self._lock:
+            if st is not None and st.overlay is not None \
+                    and st.overlay.get("gen") == overlay.get("gen"):
+                st.overlay = st.prev_overlay
+                st.prev_overlay = None
+                st.gen += 1      # generations only move forward — the
+                st.rollbacks += 1  # rollback IS a new (incumbent-shaped)
+                demoted = True     # generation, never an alias of gen N
+        if not demoted:
+            return
+        xferstats.bump("serve_respec_rollbacks", 1, tag=tenant)
+        TR.instant("respec:rollback", "respec",
+                   {"tenant": tenant, "gen": overlay.get("gen"),
+                    "cause": str(cause)[:120]})
+        self._event(tenant, "rollback", gen=overlay.get("gen"),
+                    cause=str(cause)[:200])
+        log.warning("respec[%s]: generation %s failed at run time (%s); "
+                    "rolled back onto the incumbent",
+                    tenant, overlay.get("gen"), cause)
+        self._quarantine_sig(tenant, overlay.get("sig", ""),
+                             f"runtime failure after promotion: {cause}")
+
+    # ------------------------------------------------------------------
+    # the controller loop
+    # ------------------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.wait(self.check_s):
+            try:
+                self._tick()
+            except Exception:   # pragma: no cover - loop must survive
+                log.exception("respec tick failed")
+
+    def _tick(self) -> None:
+        if not excprof.enabled():
+            return
+        now = time.monotonic()
+        with self._lock:
+            states = list(self._states.items())
+        for tenant, st in states:
+            cand = st.candidate
+            if cand is not None:
+                # compile watchdog: a candidate stuck in its compile
+                # phase past the deadline is quarantined here even if
+                # the build thread itself is wedged (an injected hang,
+                # a pathological trace) — the tick is the guarantee
+                if cand["state"] == COMPILING \
+                        and now - cand["t_start"] > self.compile_deadline_s:
+                    self._quarantine(tenant, cand,
+                                     f"candidate compile exceeded "
+                                     f"{self.compile_deadline_s:g}s")
+                continue
+            if st.last_entries is None or now < st.cooldown_until:
+                continue
+            try:
+                recommended = excprof.respecialize_recommended(tenant)
+            except Exception:
+                recommended = False
+            with self._lock:
+                st.debounce = st.debounce + 1 if recommended else 0
+                fire = st.debounce >= self.debounce_n
+                if fire:
+                    st.debounce = 0
+                    st.candidate = {
+                        "gen": st.gen + 1, "state": COMPILING,
+                        "t_start": now, "t_trigger": now,
+                        "overlay": None, "sig": "", "checks": [],
+                        "failed": None, "canary_job": None}
+                    cand = st.candidate
+            if fire:
+                xferstats.bump("serve_respec_triggered", 1, tag=tenant)
+                TR.instant("respec:trigger", "respec",
+                           {"tenant": tenant, "gen": cand["gen"],
+                            "drift": round(excprof.drift_score(tenant),
+                                           3)})
+                self._event(tenant, "trigger", gen=cand["gen"],
+                            drift=round(excprof.drift_score(tenant), 3))
+                log.info("respec[%s]: drift tripped — building candidate "
+                         "generation %d", tenant, cand["gen"])
+                t = threading.Thread(
+                    target=self._build_candidate, args=(tenant, cand),
+                    daemon=True, name=f"tpx-respec-build-{tenant[:12]}")
+                t.start()
+
+    # ------------------------------------------------------------------
+    # candidate construction + background compile
+    # ------------------------------------------------------------------
+    def _job_options(self, st: _TenantState):
+        from ..core.options import ContextOptions
+
+        opts = ContextOptions(self.service.options.to_dict())
+        if st.last_options:
+            opts.update(st.last_options)
+        opts.set("tuplex.backend", "local")
+        opts.set("tuplex.webui.enable", False)
+        return opts
+
+    def _rebuild(self, entries, options, overlay: Optional[dict]):
+        """Spec entries -> TransformStage list, with `overlay` applied
+        (the same rebuild path every job runner uses, so stage keys —
+        deterministic stage-local op ids — match the live jobs')."""
+        from ..exec.serverless import rebuild_stage
+
+        stages = []
+        for si, entry in enumerate(entries):
+            if not isinstance(entry, dict) or "spec" not in entry:
+                stages.append(None)
+                continue
+            stage = rebuild_stage(entry["spec"], options,
+                                  files=entry.get("files"))
+            if overlay:
+                apply_overlay_to_stage(stage, overlay, si)
+            stages.append(stage)
+        return stages
+
+    def _derive_overlay(self, st: _TenantState, inc_stages,
+                        gen: int) -> dict:
+        """Re-speculate from the LIVE evidence: the observed per-stage
+        code distribution (excprof cumulative stage reports under the
+        incumbent keys) and the captured deviant-row samples decide, per
+        stage, (a) which observed codes the new plan should EXPECT and
+        (b) whether branch speculation went stale (observed
+        NORMALCASEVIOLATION traffic on a speculation-pruned stage →
+        compile the cold arms back in).
+
+        Bounded approximation: the cumulative stage reports aggregate by
+        stage KEY, and at generation 0 isomorphic tenants share keys
+        (the per-generation salt only diverges after a first promotion)
+        — so another tenant's traffic can widen this candidate's
+        inventory or force its de-speculation. Both stay CORRECT
+        (expecting extra codes widens preallocation; un-pruning costs
+        only specialization), and a candidate is only ever built for a
+        tenant whose OWN window tripped drift; per-tenant per-stage code
+        accounting in excprof would remove the approximation."""
+        from ..core.errors import ExceptionCode as EC
+
+        observed = excprof.reports()
+        samples = excprof.samples()
+        scope = excprof.scope_report(st.tenant)
+        overlay: dict = {
+            "gen": gen, "tenant": st.tenant,
+            "salt": f"{st.tenant}:g{gen}",
+            "anchor_rate": float(scope.get("ewma_rate") or 0.0),
+            "stages": {},
+        }
+        for si, stage in enumerate(inc_stages):
+            if stage is None:
+                continue
+            rep = observed.get(stage.key())
+            if not rep:
+                continue
+            obs_codes = sorted({int(code) for (code, _op)
+                                in rep.get("codes", {})})
+            try:
+                base = {int(c) for c in stage.possible_exception_codes()}
+            except Exception:
+                base = set()
+            cfg: dict = {}
+            extra = [c for c in obs_codes if c not in base]
+            if extra:
+                cfg["extra_codes"] = extra
+            if int(EC.NORMALCASEVIOLATION) in obs_codes:
+                try:
+                    pruned = stage.speculation_pruned()
+                except Exception:
+                    pruned = False
+                if pruned:
+                    # deviant-row samples captured for the violation are
+                    # the evidence the cold arm is live traffic now, not
+                    # a one-off — either way the non-speculating compile
+                    # is the safe respeculation
+                    cfg["speculate"] = False
+                    cfg["ncv_samples"] = len(samples.get(
+                        (stage.key(), int(EC.NORMALCASEVIOLATION)), []))
+            if cfg:
+                overlay["stages"][si] = cfg
+        return overlay
+
+    @staticmethod
+    def _signature(inc_stages, overlay: dict) -> str:
+        """Generation-INDEPENDENT content address of a candidate: the
+        incumbent stage keys it grew from + the overlay's structural
+        content. The same poisoned respeculation re-derived later (gen
+        3, gen 4, …) hashes identically, so its quarantine marker keeps
+        matching — no flapping."""
+        h = hashlib.sha256()
+        h.update(str(overlay.get("tenant", "")).encode())
+        for stage in inc_stages:
+            if stage is not None:
+                h.update(stage.key().encode())
+        for si in sorted(overlay.get("stages", {})):
+            cfg = overlay["stages"][si]
+            h.update(f"{si}:{sorted(cfg.get('extra_codes', []))}"
+                     f":{cfg.get('speculate')}".encode())
+        return h.hexdigest()[:24]
+
+    def _quar_base(self, sig: str) -> Optional[str]:
+        from ..runtime.jaxcfg import aot_cache_dir
+
+        d = aot_cache_dir()
+        if not d:
+            return None
+        import os
+
+        return os.path.join(d, f"respec-{sig}")
+
+    def _quarantined_until(self, st: _TenantState, sig: str) -> float:
+        """Expiry (epoch seconds) of a candidate signature's quarantine,
+        from the in-process (count, stamped-at) record and/or the
+        cross-process ``.respecquar`` marker — whichever is later. 0.0
+        when never quarantined."""
+        from ..exec import compilequeue as CQ
+
+        rec = CQ.read_marker(self._quar_base(sig), "respecquar")
+        local = st.quar.get(sig)
+        if rec is None and local is None:
+            return 0.0
+        count = max(local[0] if local else 0,
+                    int(rec.get("count", 1)) if rec else 0)
+        created = max(local[1] if local else 0.0,
+                      float(rec.get("created", 0.0)) if rec else 0.0)
+        if created <= 0.0:
+            return 0.0          # undatable verdict: never block forever
+        backoff = self.quarantine_s * (2 ** max(0, count - 1))
+        return created + backoff
+
+    def _build_candidate(self, tenant: str, cand: dict) -> None:
+        from ..exec import compilequeue as CQ
+
+        st = self._state(tenant, create=False)
+        if st is None:
+            return
+        try:
+            with TR.span("respec:compile", "respec") as sp:
+                sp.set("tenant", tenant[:16]).set("gen", cand["gen"])
+                # chaos checkpoint: an injected hang here is a wedged
+                # candidate build — the tick watchdog quarantines it at
+                # the compile deadline while this thread sleeps it off
+                faults.maybe("respec", point="compile")
+                with self._lock:
+                    if st.candidate is not cand or cand["failed"]:
+                        return   # the tick watchdog quarantined us while
+                                 # we were wedged — do no further work
+                    entries = list(st.last_entries or [])
+                    active = st.overlay
+                    avals, schema = st.avals, st.schema
+                opts = self._job_options(st)
+                inc_stages = self._rebuild(entries, opts, active)
+                overlay = self._derive_overlay(st, inc_stages,
+                                               cand["gen"])
+                sig = self._signature(inc_stages, overlay)
+                overlay["sig"] = sig
+                cand["overlay"] = overlay
+                cand["sig"] = sig
+                sp.set("sig", sig[:12])
+                until = self._quarantined_until(st, sig)
+                if time.time() < until:
+                    self._abandon(tenant, cand,
+                                  f"candidate {sig[:12]} is quarantined "
+                                  f"for {until - time.time():.0f}s more")
+                    return
+                cand_stages = self._rebuild(entries, opts, overlay)
+                n_compiled = self._compile_stages(cand_stages, avals,
+                                                  schema, cand)
+                sp.set("stages", sum(1 for s in cand_stages
+                                     if s is not None))
+                sp.set("compiled", n_compiled)
+            with self._lock:
+                if st.candidate is not cand or cand["failed"]:
+                    return      # watchdog quarantined us mid-build
+                cand["state"] = READY
+                cand["t_ready"] = time.monotonic()
+            xferstats.bump("serve_respec_compiles", 1, tag=tenant)
+            self._event(tenant, "candidate-ready", gen=cand["gen"],
+                        sig=cand["sig"][:12], compiled=n_compiled)
+            log.info("respec[%s]: candidate gen %d ready (%d background "
+                     "compile(s)); awaiting canary", tenant,
+                     cand["gen"], n_compiled)
+        except Exception as e:   # noqa: BLE001 - any failure quarantines
+            self._quarantine(tenant, cand,
+                             f"candidate build failed: "
+                             f"{type(e).__name__}: {e}")
+
+    def _compile_stages(self, cand_stages, avals, schema,
+                        cand: dict) -> int:
+        """Compile the candidate stage set on the BACKGROUND lane and
+        wait (bounded by what is left of the compile deadline). Without
+        an aval hint the stages are trace-validated only — the first
+        canary dispatch compiles them, still content-addressed."""
+        from ..exec import compilequeue as CQ
+
+        live = [s for s in cand_stages if s is not None]
+        if not live:
+            raise RuntimeError("no spec-rebuilt stage to respecialize")
+        if avals is None or schema is None:
+            for s in live:      # no hint: validate the builds trace-side
+                s.build_device_fn(schema if schema is not None else None)
+            return 0
+        backend = self._bg_backend()
+        with CQ.background_lane():
+            futs = backend._precompile_avals(cand_stages, avals, schema)
+        deadline = cand["t_start"] + self.compile_deadline_s
+        for f in futs:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise CQ.CompileTimeout(
+                    f"candidate compile phase exceeded "
+                    f"{self.compile_deadline_s:g}s")
+            f.result(timeout=left)      # raises the compile's own error
+        return len(futs)
+
+    def _bg_backend(self):
+        if self._backend is None:
+            from ..exec.local import LocalBackend
+
+            self._backend = LocalBackend(self._job_options(
+                _TenantState("")))
+        return self._backend
+
+    # ------------------------------------------------------------------
+    # canary (called from _JobRunner.step, on the scheduler thread)
+    # ------------------------------------------------------------------
+    def canary_stage(self, runner, si: int, stage, inputs,
+                     incumbent_res) -> None:
+        """Shadow-execute the candidate's stage `si` on a bounded
+        fraction of the SAME input partitions the incumbent just
+        processed, and cross-check exception count + output row count.
+        The job's own results are untouched (they came from the
+        incumbent); excprof recording is suppressed so probe rows never
+        read as tenant drift."""
+        record = runner.record
+        cand = getattr(record, "respec_canary", None)
+        if cand is None or cand.get("failed") \
+                or cand.get("state") != CANARY:
+            return
+        entry = runner.entries[si] if si < len(runner.entries) else {}
+        if not isinstance(entry, dict) or "spec" not in entry:
+            return              # live stages cannot be respecialized
+        if not isinstance(inputs, list) or not inputs:
+            return
+        if any(getattr(p, "device_batch", None) is not None
+               for p in inputs):
+            # device-resident handoff views are one-shot and their
+            # buffers may be donated by the incumbent dispatch that just
+            # consumed them — a shadow re-execution here could read dead
+            # device memory and quarantine a HEALTHY candidate. Host-
+            # backed partitions re-stage from host leaves (the same
+            # contract the tier-restart replay relies on); these don't.
+            return
+        tenant = record.request.tenant
+        try:
+            overlay = cand["overlay"]
+            cache = getattr(record, "_respec_canary_stages", None)
+            if cache is None:
+                cache = record._respec_canary_stages = {}
+            cstage = cache.get(si)
+            if cstage is None:
+                from ..exec.serverless import rebuild_stage
+
+                cstage = rebuild_stage(entry["spec"], runner.options,
+                                       files=entry.get("files"))
+                apply_overlay_to_stage(cstage, overlay, si)
+                cache[si] = cstage
+            k = max(1, int(math.ceil(self.canary_frac * len(inputs))))
+            k = min(k, len(inputs))
+            sub = inputs[:k]
+            with TR.span("respec:canary", "respec") as sp:
+                sp.set("tenant", tenant[:16]).set("gen", cand["gen"])
+                sp.set("stage", si).set("partitions", k)
+                with excprof.suppressed():
+                    faults.maybe("respec", point="canary")
+                    cres = runner.backend.execute_any(cstage, sub,
+                                                      runner.ctx)
+                    if k == len(inputs):
+                        base_rows = incumbent_res.metrics.get("rows_out",
+                                                              0)
+                        base_exc = len(incumbent_res.exceptions)
+                    else:
+                        ires = runner.backend.execute_any(stage, sub,
+                                                          runner.ctx)
+                        base_rows = ires.metrics.get("rows_out", 0)
+                        base_exc = len(ires.exceptions)
+                crows = cres.metrics.get("rows_out", 0)
+                cexc = len(cres.exceptions)
+                ok = (crows == base_rows and cexc <= base_exc)
+                if getattr(cstage, "_respec_revert", None) is None:
+                    # the tier ladder's fallback rung fired DURING the
+                    # shadow run: the "candidate" result above is really
+                    # the incumbent re-run (the candidate could not even
+                    # compile) — an incumbent-vs-incumbent comparison
+                    # must never pass the canary
+                    ok = False
+                    cand["failed"] = (
+                        f"candidate fell back to the incumbent during "
+                        f"its own canary at stage {si} (compile "
+                        f"deadline) — nothing canary-able to promote")
+                sp.set("ok", int(ok))
+            cand["checks"].append(
+                {"stage": si, "partitions": k, "rows": crows,
+                 "rows_incumbent": base_rows, "exceptions": cexc,
+                 "exceptions_incumbent": base_exc, "ok": ok})
+            if not ok:
+                cand["failed"] = (
+                    f"canary mismatch at stage {si}: candidate "
+                    f"{crows} rows / {cexc} exception(s) vs incumbent "
+                    f"{base_rows} / {base_exc}")
+        except Exception as e:   # noqa: BLE001 - canary failure is data
+            cand["checks"].append({"stage": si, "ok": False,
+                                   "error": f"{type(e).__name__}: {e}"})
+            cand["failed"] = (f"canary dispatch failed at stage {si}: "
+                              f"{type(e).__name__}: {e}")
+
+    def finish_job(self, record, ok: bool) -> None:
+        """Job-boundary verdict for a canary job: promote a candidate
+        whose every stage cross-check passed on a successful job;
+        quarantine anything else. Jobs that never touched a canary are
+        no-ops."""
+        cand = getattr(record, "respec_canary", None)
+        if cand is None:
+            return
+        record.respec_canary = None
+        tenant = record.request.tenant
+        st = self._state(tenant, create=False)
+        if st is None:
+            return
+        with self._lock:
+            if st.candidate is not cand:
+                return          # already quarantined (watchdog raced us)
+        if ok and not cand.get("failed") and cand["checks"] \
+                and all(c.get("ok") for c in cand["checks"]):
+            self._promote(tenant, st, cand)
+        elif ok and not cand.get("failed") and not cand["checks"]:
+            # the claimed job had no canary-able stage execution (e.g.
+            # every stage rode live): release the claim for the next job
+            with self._lock:
+                cand["state"] = READY
+                cand["canary_job"] = None
+            self._event(tenant, "canary-skipped", gen=cand["gen"])
+        else:
+            reason = cand.get("failed") or \
+                ("canary job failed" if not ok else "canary checks failed")
+            self._quarantine(tenant, cand, reason)
+
+    # ------------------------------------------------------------------
+    # promote / quarantine
+    # ------------------------------------------------------------------
+    def _promote(self, tenant: str, st: _TenantState, cand: dict) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if st.candidate is not cand:
+                return
+            st.prev_overlay = st.overlay
+            st.overlay = cand["overlay"]
+            st.gen = cand["gen"]
+            st.candidate = None
+            st.cooldown_until = now + self.cooldown_s
+            st.debounce = 0
+            st.promotions += 1
+        # the re-specialized plan's normal case IS the observed traffic:
+        # re-anchor the tenant's drift window (and the process-global
+        # one — its expectation moved with the tenant's) so the score
+        # recovers without waiting out the EWMA, and WITHOUT a restart.
+        # Bounded approximation on the GLOBAL window: adopting the
+        # current global rate can also absorb another still-drifting
+        # tenant's contribution, quieting the global-scope gauge early —
+        # but never the health signal, because the exception_drift check
+        # takes the WORST score across ALL windows and that tenant's own
+        # window keeps tripping until it is healed too.
+        excprof.reanchor(tenant, rate=cand["overlay"].get("anchor_rate"))
+        excprof.reanchor(None)
+        promote_s = now - cand["t_trigger"]
+        xferstats.bump("serve_respec_promotions", 1, tag=tenant)
+        telemetry.observe("serve_respec_promote_seconds", promote_s,
+                          tenant=tenant)
+        TR.instant("respec:promote", "respec",
+                   {"tenant": tenant, "gen": cand["gen"],
+                    "promote_s": round(promote_s, 3),
+                    "checks": len(cand["checks"])})
+        self._event(tenant, "promote", gen=cand["gen"],
+                    sig=cand["sig"][:12],
+                    promote_s=round(promote_s, 3),
+                    checks=len(cand["checks"]))
+        log.info("respec[%s]: promoted generation %d after %d canary "
+                 "check(s) (%.2fs trigger-to-promote); incumbent "
+                 "retained as the fallback rung",
+                 tenant, cand["gen"], len(cand["checks"]), promote_s)
+
+    def _abandon(self, tenant: str, cand: dict, reason: str) -> None:
+        """Drop a candidate WITHOUT a new quarantine mark (it is already
+        quarantined — re-marking would double the backoff per check)."""
+        st = self._state(tenant, create=False)
+        if st is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if st.candidate is cand:
+                st.candidate = None
+            until = self._quarantined_until(st, cand.get("sig", ""))
+            st.cooldown_until = max(
+                st.cooldown_until,
+                now + max(self.cooldown_s, until - time.time()))
+        self._event(tenant, "abandoned", gen=cand["gen"], reason=reason)
+        log.info("respec[%s]: %s", tenant, reason)
+
+    def _quarantine(self, tenant: str, cand: dict, reason: str) -> None:
+        from ..exec import compilequeue as CQ
+
+        st = self._state(tenant, create=False)
+        if st is None:
+            return
+        sig = cand.get("sig", "")
+        now = time.monotonic()
+        with self._lock:
+            if st.candidate is cand:
+                st.candidate = None
+            elif cand.get("state") == "quarantined":
+                return          # double fire (watchdog + build thread)
+            cand["state"] = "quarantined"
+            cand["failed"] = cand.get("failed") or reason
+            prev = st.quar.get(sig) if sig else None
+            count = (prev[0] if prev else 0) + 1
+            if sig:
+                st.quar[sig] = (count, time.time())
+            st.quarantines += 1
+            backoff = self.quarantine_s * (2 ** max(0, count - 1))
+            st.cooldown_until = max(st.cooldown_until, now + backoff)
+            st.debounce = 0
+        if sig:
+            CQ.write_marker(self._quar_base(sig), "respecquar",
+                            reason=reason, tenant=tenant,
+                            gen=cand.get("gen"), count=count,
+                            backoff_s=backoff)
+        xferstats.bump("serve_respec_quarantined", 1, tag=tenant)
+        TR.instant("respec:rollback", "respec",
+                   {"tenant": tenant, "gen": cand.get("gen"),
+                    "reason": reason[:120], "quarantine_s": backoff})
+        self._event(tenant, "quarantine", gen=cand.get("gen"),
+                    sig=sig[:12], reason=reason[:200],
+                    backoff_s=backoff)
+        log.warning("respec[%s]: candidate gen %s quarantined (%s); "
+                    "cooldown %.0fs", tenant, cand.get("gen"), reason,
+                    backoff)
+
+    def _quarantine_sig(self, tenant: str, sig: str, reason: str) -> None:
+        """Quarantine by signature alone (post-promotion rollback: there
+        is no candidate object anymore, the overlay WAS active)."""
+        from ..exec import compilequeue as CQ
+
+        st = self._state(tenant, create=False)
+        if st is None or not sig:
+            return
+        now = time.monotonic()
+        with self._lock:
+            prev = st.quar.get(sig)
+            count = (prev[0] if prev else 0) + 1
+            st.quar[sig] = (count, time.time())
+            st.quarantines += 1
+            backoff = self.quarantine_s * (2 ** max(0, count - 1))
+            st.cooldown_until = max(st.cooldown_until, now + backoff)
+        CQ.write_marker(self._quar_base(sig), "respecquar",
+                        reason=reason, tenant=tenant, count=count,
+                        backoff_s=backoff)
+        xferstats.bump("serve_respec_quarantined", 1, tag=tenant)
+
+    # ------------------------------------------------------------------
+    # readouts
+    # ------------------------------------------------------------------
+    def tenant_report(self, tenant: str) -> dict:
+        """One tenant's lifecycle readout (dashboard/excprof event rows +
+        tests): generation, candidate state, counts, bounded history."""
+        st = self._state(tenant, create=False)
+        if st is None:
+            return {"generation": 0, "state": "idle", "promotions": 0,
+                    "quarantines": 0, "rollbacks": 0, "history": []}
+        with self._lock:
+            cand = st.candidate
+            return {
+                "generation": st.gen,
+                "state": cand["state"] if cand is not None else
+                ("promoted" if st.overlay is not None else "idle"),
+                "candidate_gen": cand["gen"] if cand is not None else None,
+                "promotions": st.promotions,
+                "quarantines": st.quarantines,
+                "rollbacks": st.rollbacks,
+                "history": list(st.history),
+            }
+
+
+# ---------------------------------------------------------------------------
+# overlay application (stage side — also used by exec/local's revert)
+# ---------------------------------------------------------------------------
+
+def apply_overlay_to_stage(stage, overlay: dict, si: int,
+                           notify=None) -> None:
+    """Mutate one freshly rebuilt TransformStage to its re-specialized
+    generation: the per-generation key salt, the live-observed expected
+    codes and (where the respeculation decided so) the non-speculating
+    compile. The ORIGINAL values are retained on the stage
+    (``_respec_revert``) — exec/local's tier ladder restores them, whole
+    stage from partition 0, if the generation fails at run time."""
+    revert = {
+        "respec_salt": stage.respec_salt,
+        "extra_expected_codes": stage.extra_expected_codes,
+        "speculate_branches": stage.speculate_branches,
+    }
+    stage.respec_salt = overlay.get("salt", "")
+    cfg = (overlay.get("stages") or {}).get(si) \
+        or (overlay.get("stages") or {}).get(str(si)) or {}
+    if cfg.get("extra_codes"):
+        stage.extra_expected_codes = tuple(
+            sorted(set(int(c) for c in cfg["extra_codes"])))
+    if cfg.get("speculate") is not None:
+        stage.speculate_branches = bool(cfg["speculate"])
+    for memo in ("_resolve_plan_memo",):
+        if hasattr(stage, memo):
+            try:
+                delattr(stage, memo)
+            except AttributeError:
+                pass
+    stage.respec_generation = int(overlay.get("gen", 0))
+    stage._respec_revert = revert
+    if notify is not None:
+        stage._respec_notify = notify
+
+
+__all__ = ["RespecController", "apply_overlay_to_stage",
+           "COMPILING", "READY", "CANARY"]

@@ -112,6 +112,13 @@ class JobService:
         devprof.apply_options(o)   # serve CLI builds options Context-less
         excprof.apply_options(o)   # exception-plane drift knobs + health
         self._register_telemetry(o)
+        # closed-loop self-healing (serve/respec): watch each tenant's
+        # drift signal, re-speculate in the background, canary, hot-swap
+        self.respec = None
+        if o.get_bool("tuplex.serve.respec", True) and excprof.enabled():
+            from .respec import RespecController
+
+            self.respec = RespecController(self, o)
         if autostart:
             self.start()
 
@@ -246,6 +253,8 @@ class JobService:
             self._open = 0
             self._cond.notify_all()
         telemetry.drop_owner(self)   # gauges/checks close over this object
+        if self.respec is not None:
+            self.respec.stop()
         for t in self._threads:
             t.join(timeout=timeout)
         # a worker outliving its join timeout may still be mid-step: in
@@ -293,6 +302,11 @@ class JobService:
         weight = request.weight if request.weight \
             else self.tenant_weights.get(request.tenant, 1)
         rec = JobRecord(request, weight)
+        if self.respec is not None:
+            # pin the tenant's ACTIVE plan generation before the runner
+            # builds: a promotion that lands mid-admission (or between
+            # retries) must not change THIS job's generation
+            self.respec.pin(rec)
         wait_s = self.admission_timeout_s if timeout is None else timeout
         t_admit0 = time.monotonic()
         deadline = t_admit0 + max(0.0, wait_s)
@@ -339,6 +353,11 @@ class JobService:
                 raise JobRejected(
                     f"job rejected at admission: "
                     f"{type(e).__name__}: {e}") from e
+        if self.respec is not None:
+            # post-admission: remember the wire-safe request (the
+            # respeculation substrate) and claim the canary if a
+            # validated candidate is waiting for this tenant
+            self.respec.note_admitted(rec)
         telemetry.observe("serve_admission_wait_seconds",
                           time.monotonic() - t_admit0,
                           tenant=request.tenant)
@@ -521,6 +540,15 @@ class JobService:
                 self._cond.notify_all()
             return
         if err is not None or done:
+            if self.respec is not None:
+                # job boundary = canary verdict boundary: promote or
+                # quarantine the candidate this job carried (no-op for
+                # non-canary jobs)
+                try:
+                    self.respec.finish_job(rec, ok=(done
+                                                    and err is None))
+                except Exception:   # controller must never fail a job
+                    log.exception("respec finish_job failed")
             try:
                 rec.runner.cleanup()
             except Exception:
@@ -555,10 +583,19 @@ class JobService:
             if excprof.enabled():
                 try:
                     exr = excprof.scope_report(rec.request.tenant)
+                    if self.respec is not None:
+                        # the "respecialize recommended" badge becomes a
+                        # lifecycle: the tenant's generation + candidate
+                        # state ride the drift panel row
+                        rr = self.respec.tenant_report(rec.request.tenant)
+                        exr["respec_generation"] = rr["generation"]
+                        exr["respec_state"] = rr["state"]
+                        exr["respec_promotions"] = rr["promotions"]
+                        exr["respec_quarantines"] = rr["quarantines"]
                     self._record_event(
                         rec, "excprof", tenant=rec.request.tenant,
                         **{k: v for k, v in exr.items()
-                           if isinstance(v, (int, float, dict))})
+                           if isinstance(v, (int, float, str, dict))})
                 except Exception:   # dashboard rows are advisory
                     pass
         # history rows land BEFORE the state flip wakes any waiter: a
@@ -588,6 +625,7 @@ class JobService:
             log.info("job %s done: %d rows, %d turn(s), %.3fs",
                      rec.id, len(rec.result_rows or []),
                      rec.stats["turns"] + 1, wall)
+        retired_tenants: set = set()
         with self._cond:
             self._turn += 1
             self._busy -= 1
@@ -613,8 +651,16 @@ class JobService:
                 # keeps its own record alive regardless; only the
                 # service-wide pin is released
                 self._terminal.append(rec.id)
+                evicted: set = set()
                 while len(self._terminal) > self.retain_jobs:
-                    self._records.pop(self._terminal.popleft(), None)
+                    old = self._records.pop(self._terminal.popleft(),
+                                            None)
+                    if old is not None:
+                        evicted.add(old.request.tenant)
+                if evicted:
+                    live = {r.request.tenant
+                            for r in self._records.values()}
+                    retired_tenants = evicted - live
             else:
                 # deficit-weighted RR: a tenant with weight w keeps the
                 # slot for w consecutive stage dispatches, then yields
@@ -626,3 +672,14 @@ class JobService:
                     rec.burst = 0
                     self._ready.append(rec)
             self._cond.notify_all()
+        if retired_tenants:
+            # tenant retirement: the service no longer holds ANY record
+            # for these tenants — release their per-tenant exception-
+            # plane drift windows (runtime/excprof grows one window per
+            # scope forever otherwise: the long-lived-serve state leak
+            # under a churning tenant population) and the respec
+            # controller state (quarantine markers persist on disk)
+            for t in retired_tenants:
+                excprof.drop_scope(t)
+                if self.respec is not None:
+                    self.respec.note_tenant_retired(t)
