@@ -118,8 +118,16 @@ HIGHER_BETTER = {
     "jobs_ok": True,
     "jobs_failed_clean": None,       # informational (spec-dependent)
     "retries": None,                 # informational (spec-dependent)
-    "compiles_killed": None,         # informational (spec-dependent)
-    "deadline_timeouts": None,
+    # static vetting (compiler/graphlint): a killed compile is a vetting
+    # MISS — every wedge must be caught before submission, so
+    # compiles_killed growing is a regression, while hazards_avoided may
+    # grow (each one is a deadline+SIGKILL cycle that never happened).
+    # graphlint_ms is the analysis cost and must not creep.
+    "compiles_killed": False,
+    "deadline_timeouts": False,
+    "hazards_avoided": True,
+    "hazards_found": None,           # informational (workload-dependent)
+    "graphlint_ms": False,
     "crash_requeues": None,
 }
 

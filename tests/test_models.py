@@ -65,12 +65,13 @@ def test_flights_pipeline(ctx, tmp_path):
 def test_flights_airport_wedge_killed_and_degraded(tmp_path):
     """Pin the flights airport build-side XLA:CPU wedge (ROADMAP item c:
     3 ops, 2.2k eqns, >20 min / >120 GB at ANY batch size) as a repro
-    that now PASSES: with the default-on compile deadline tightened to
-    60 s, a wedging build-side compile is SIGKILLed in its forked child
-    and the stage degrades to one slower tier — the pipeline completes,
-    bounded, with reference-exact results, instead of hanging. On a jax
-    build whose XLA:CPU does not wedge, the compiles simply finish and
-    the same assertions hold on the compiled path."""
+    that now passes WITHOUT a single compile kill: graphlint's
+    ``wide-str-compaction`` rule vets both wedging stages (the airport
+    build side at plan time, the probe-side mega-segment at submission
+    time) and pre-degrades them to the interpreter before any compile
+    is launched. The deadline killer stays armed as a backstop but must
+    never fire — ``compiles_killed`` growing here is a regression, not
+    a coping mechanism."""
     import time
 
     import tuplex_tpu
@@ -113,12 +114,12 @@ def test_flights_airport_wedge_killed_and_degraded(tmp_path):
             else:
                 assert a == b, (flights.OUTPUT_COLS[ci], a, b)
     d = CQ.delta(snap)
-    if d["deadline_timeouts"]:
-        # the wedge fired: every timed-out compile was KILLED (fork mode),
-        # nothing left burning for the health watchdog
-        if CQ.isolation_mode() == "fork":
-            assert d["compiles_killed"] >= 1
-        assert CQ.pending_info()["inflight"] == 0
+    # static vetting must intercept every wedge BEFORE the deadline
+    # killer ever has something to kill
+    assert d["compiles_killed"] == 0, d
+    assert d["deadline_timeouts"] == 0, d
+    assert d["hazards_avoided"] >= 1, d
+    assert CQ.pending_info()["inflight"] == 0
     ctx.close()
 
 

@@ -14,12 +14,16 @@ TuplexShell, launched by the `tuplex` console entry point). Subcommands:
     python -m tuplex_tpu version          # print the package version
 
 `lint` runs the compiler's static analyzer (compiler/analyzer.py) over every
-UDF the script hands to DataSet methods — purely syntactic, the script is
-never imported or executed — and prints per-UDF fallback, exception-site,
-purity, and static-type findings with file:line locations, plus
-dead-resolver warnings (a resolve()/ignore() targeting an error the
-guarded UDF provably cannot raise). `--strict` exits non-zero when any
-fallback finding or dead resolver exists.
+UDF the script hands to DataSet methods — purely syntactic — and prints
+per-UDF fallback, exception-site, purity, and static-type findings with
+file:line locations, plus dead-resolver warnings (a resolve()/ignore()
+targeting an error the guarded UDF provably cannot raise). It then imports
+the script with actions stubbed (compilestats harness: no stage executes,
+nothing compiles) and prints a jaxpr findings section — every
+compiler/graphlint verdict from plan-time stage vetting (compile-wedge
+rules, dtype creep, broadcast blowup, static peak-memory). `--strict`
+exits non-zero when any fallback finding, dead resolver, or
+wedge-severity jaxpr finding exists.
 
 `compilestats` imports the script with actions stubbed out (no stage
 executes, nothing compiles), plans each action, and prints per-stage op
@@ -110,10 +114,24 @@ def main(argv=None) -> int:
         from .compiler.analyzer import lint_file
 
         try:
-            return lint_file(args.script, strict=args.strict)
+            rc = lint_file(args.script, strict=args.strict)
         except OSError as e:
             print(f"lint: {e}", file=sys.stderr)
             return 2
+        # jaxpr findings section (compiler/graphlint): unlike the UDF
+        # lint above this must IMPORT the script (actions stubbed, same
+        # harness as compilestats — nothing executes or compiles); an
+        # unimportable script degrades to the syntactic report alone
+        try:
+            from .utils.compilestats import lint_jaxprs
+
+            _, n_wedge = lint_jaxprs(args.script)
+            if args.strict and n_wedge:
+                rc = rc or 1
+        except Exception as e:
+            print(f"lint: jaxpr section skipped "
+                  f"({type(e).__name__}: {e})", file=sys.stderr)
+        return rc
     if args.cmd == "compilestats":
         from .utils.compilestats import main as cs_main
 

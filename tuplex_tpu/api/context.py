@@ -52,6 +52,12 @@ class Context:
         from ..runtime import excprof as _ex
 
         _ex.apply_options(self.options_store)
+        # jaxpr-plane static vetting (compiler/graphlint): pre-submission
+        # compile-hazard analysis; TUPLEX_GRAPHLINT=0 is the env kill
+        # switch that wins over everything
+        from ..compiler import graphlint as _gl
+
+        _gl.apply_options(self.options_store)
         self.backend = self._make_backend()
         self.metrics = Metrics()
         from ..history import JobRecorder

@@ -334,6 +334,45 @@ DEFAULTS: dict[str, str] = {
                                             # Like trace/telemetry/devprof
                                             # the gate is process-wide and
                                             # the option only turns it ON
+    "tuplex.tpu.graphlint": "true",         # jaxpr-plane static analysis
+                                            # (compiler/graphlint.py):
+                                            # every stage jaxpr is vetted
+                                            # BEFORE submission to XLA —
+                                            # eqn census, static peak-
+                                            # memory bound, dtype-creep /
+                                            # broadcast-blowup lint, and
+                                            # named compile-hazard rules
+                                            # (the wide-str-compaction
+                                            # XLA:CPU wedge). A wedge (or
+                                            # a score past the threshold
+                                            # below) pre-degrades at plan
+                                            # time or vetoes at compile
+                                            # time (CompileHazard rides
+                                            # the normal tier ladder), so
+                                            # pathological stages never
+                                            # burn a deadline + SIGKILL.
+                                            # Default on. TUPLEX_
+                                            # GRAPHLINT=0 is the env kill
+                                            # switch (wins over all):
+                                            # every hook collapses to one
+                                            # flag check, zero allocation
+                                            # (test-pinned). Like devprof
+                                            # the gate is process-wide
+                                            # and the option only ever
+                                            # turns it ON
+    "tuplex.tpu.hazardThreshold": "60",     # hazard-score veto line in
+                                            # predicted compile SECONDS
+                                            # (graphlint's construct-
+                                            # weighted census). 60 s sits
+                                            # 2.6x above the worst clean
+                                            # bundled stage (22.9 s), so
+                                            # by default only a wedge-
+                                            # severity finding crosses
+                                            # it; <= 0 disables the score
+                                            # veto (wedge rules still
+                                            # veto). Also the per-segment
+                                            # budget when a hazard score
+                                            # forces a stage split
     "tuplex.tpu.excprofHalfLifeS": "30",    # EWMA half-life of the drift
                                             # detector: how fast the
                                             # observed exception profile

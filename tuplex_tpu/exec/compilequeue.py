@@ -65,6 +65,13 @@ STATS: dict[str, Any] = {
     "fork_deadlocks": 0,
     "nodeser_marks": 0, "nodeser_skips": 0,
     "background_compiles": 0,
+    # pre-submission jaxpr vetting (compiler/graphlint): hazards_found =
+    # fresh vetoes from a live analysis, hazards_avoided = every compile
+    # the vet plane spared XLA (fresh vetoes + `.hazard` marker skips +
+    # plan-time pre-degrades). compiles_killed staying at 0 while
+    # hazards_avoided grows is the whole point: the wedge becomes a
+    # prediction, not a survival story.
+    "graphlint_ms": 0.0, "hazards_found": 0, "hazards_avoided": 0,
 }
 
 _LOCK = threading.Lock()
@@ -97,6 +104,19 @@ class CompileTimeout(Exception):
     exec/local's tier ladder) instead of wedging the job on a
     pathological XLA compile (observed: a 3-op / 2.2k-eqn string stage
     that XLA:CPU chews >20 min and >120 GB on)."""
+
+
+class CompileHazard(CompileTimeout):
+    """Static vetting (compiler/graphlint) vetoed this stage's compile
+    BEFORE submission: the jaxpr matches a wedge-severity rule (or
+    scores past ``tuplex.tpu.hazardThreshold``), so handing it to XLA
+    would predictably burn the deadline and a SIGKILL. Subclassing
+    CompileTimeout is deliberate — the veto rides the exact same
+    whole-stage tier ladder (host-CPU compile → interpreter) the killed
+    compile would have landed on, minus the kill. Unlike a plain
+    CompileTimeout it must propagate even with the deadline disabled:
+    falling back to an unbounded plain jit would re-introduce the very
+    hang the veto predicts."""
 
 
 _TIMEOUTS: set = set()               # fingerprints that timed out (process)
@@ -345,9 +365,12 @@ def _artifact_path(fp: str) -> Optional[str]:
 # ---------------------------------------------------------------------------
 # A marker is a small JSON verdict file next to (or content-addressed
 # like) an AOT artifact: `.timeout` (compile blew the deadline),
-# `.nodeser` (serialized executable cannot deserialize/run) and the
+# `.nodeser` (serialized executable cannot deserialize/run), the
 # serve plane's `.respecquar` (quarantined re-specialization candidate,
-# serve/respec.py). All three used to be ad-hoc bare files; the shared
+# serve/respec.py) and `.hazard` (static vetting vetoed the compile
+# BEFORE submission — compiler/graphlint — so later processes skip the
+# analysis AND the compile). The first three used to be ad-hoc bare
+# files; the shared
 # helper records PROVENANCE — which defect class condemned the artifact,
 # on which platform, when and why — and ``read_marker`` only honors a
 # marker whose recorded kind matches the suffix it was found under, so a
@@ -355,7 +378,7 @@ def _artifact_path(fp: str) -> Optional[str]:
 # (a torn write, a buggy writer, a copied file). Markers written by
 # earlier builds (bare platform text) still count for their own suffix.
 
-MARKER_KINDS = ("timeout", "nodeser", "respecquar")
+MARKER_KINDS = ("timeout", "nodeser", "respecquar", "hazard")
 
 
 def marker_path(base_path: str, kind: str) -> str:
@@ -494,6 +517,93 @@ def _note_deadline_exceeded(fp: str) -> None:
     _TIMEOUTS.add(fp)
     write_marker(_artifact_path(fp), "timeout",
                  reason="stage compile exceeded the deadline", fp=fp)
+
+
+_HAZARDS: dict = {}          # fingerprint -> rule (this process)
+_GL_TAG: dict = {}           # tag -> [lint_ms, hazards_found, hazards_avoided]
+
+
+def _gl_tag_add(tag: str, ms: float = 0.0, found: int = 0,
+                avoided: int = 0) -> None:
+    with _LOCK:
+        rec = _GL_TAG.setdefault(tag, [0.0, 0, 0])
+        rec[0] += ms
+        rec[1] += found
+        rec[2] += avoided
+
+
+def consume_graphlint(tag: str) -> tuple[float, int, int]:
+    """Take (and reset) the static-vetting cost and hazard counts
+    attributed to `tag` — the per-stage graphlint metrics, same
+    attribution discipline as consume_tag()."""
+    with _LOCK:
+        ms, found, avoided = _GL_TAG.pop(tag, (0.0, 0, 0))
+        return ms, found, avoided
+
+
+def _graphlint_vet(traced, fp: str, tag: str, n_ops: int):
+    """Pre-submission jaxpr vetting: runs compiler/graphlint over the
+    REAL traced stage fn (the packed wrapper for packed dispatches —
+    exactly what XLA would be handed) once per fingerprint. A wedge
+    finding or a hazard score past ``tuplex.tpu.hazardThreshold`` writes
+    the content-addressed ``.hazard`` marker and raises CompileHazard so
+    the stage degrades tier-by-tier WITHOUT ever submitting the doomed
+    compile. Returns the GraphReport (or None when the gate is off) for
+    census-tagged tuner feedback. Called only when no artifact exists —
+    an executable that compiled fine before outranks any static verdict,
+    same contract as the `.timeout` negative cache."""
+    from ..compiler import graphlint as GL
+
+    if not GL.enabled():
+        return None
+    rule = _HAZARDS.get(fp)
+    rec = None
+    if rule is None:
+        rec = read_marker(_artifact_path(fp), "hazard")
+        if rec is not None:
+            rule = rec.get("rule", "hazard")
+    if rule is not None:
+        with _LOCK:
+            STATS["hazards_avoided"] += 1
+        _gl_tag_add(tag, avoided=1)
+        TR.instant("compile:hazard-skip", "compile",
+                   {"tag": tag[:16], "fp": fp[:12], "rule": rule})
+        raise CompileHazard(
+            f"stage jaxpr previously vetoed by static vetting "
+            f"(rule {rule}, {fp[:12]}…)")
+    import jax
+
+    report = GL.analyze(traced.jaxpr, n_ops=max(n_ops, 1),
+                        platform=jax.default_backend())
+    if report is None:
+        return None
+    with _LOCK:
+        STATS["graphlint_ms"] += report.elapsed_ms
+    _gl_tag_add(tag, ms=report.elapsed_ms)
+    threshold = GL.hazard_threshold()
+    if report.wedge or (threshold > 0
+                        and report.hazard_score > threshold):
+        rule = next((f.rule for f in report.findings
+                     if f.severity == "wedge"), "hazard-threshold")
+        detail = "; ".join(f.line() for f in report.findings
+                           if f.severity == "wedge") or (
+            f"hazard score {report.hazard_score:.1f}s > "
+            f"threshold {threshold:.0f}s")
+        _HAZARDS[fp] = rule
+        write_marker(_artifact_path(fp), "hazard", reason=detail, fp=fp,
+                     rule=rule, score=float(min(report.hazard_score,
+                                                1e9)),
+                     n_eqns=report.n_eqns, n_ops=report.n_ops)
+        with _LOCK:
+            STATS["hazards_found"] += 1
+            STATS["hazards_avoided"] += 1
+        _gl_tag_add(tag, found=1, avoided=1)
+        TR.instant("compile:hazard-veto", "compile",
+                   {"tag": tag[:16], "fp": fp[:12], "rule": rule,
+                    "n_eqns": report.n_eqns})
+        raise CompileHazard(
+            f"static vetting vetoed the stage compile ({rule}: {detail})")
+    return report
 
 
 def _artifact_meta() -> dict:
@@ -882,7 +992,8 @@ def _note_devprof(tag: str, fp: str, compiled) -> None:
         pass
 
 
-def _note_compile(tag: str, dt: float, n_ops: int) -> None:
+def _note_compile(tag: str, dt: float, n_ops: int,
+                  families: Optional[dict] = None) -> None:
     with _LOCK:
         STATS["stage_compiles"] += 1
         STATS["compile_s"] += dt
@@ -894,7 +1005,10 @@ def _note_compile(tag: str, dt: float, n_ops: int) -> None:
         try:     # feed the measured point into the stage-split tuner curve
             from ..plan.splittuner import model_for
 
-            model_for().record_compile(n_ops, dt)
+            # `families` (graphlint's primitive-family census of the
+            # vetted jaxpr) rides along so the tuner can fit per-family
+            # compile-cost terms alongside the op-count power law
+            model_for().record_compile(n_ops, dt, families=families)
         except Exception:   # pragma: no cover - the model is best-effort
             pass
 
@@ -1012,6 +1126,8 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
         except Exception:
             continue    # their attempt failed; try to own it ourselves
 
+    gl_report = None        # graphlint report of the vetted trace, if any
+
     def _publish(compiled):
         """Store a finished executable process-wide (+ disk happened in
         the job). Runs even when the waiting dispatch already gave up —
@@ -1037,7 +1153,8 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
             _sp.set("tag", tag[:16]).set("n_ops", n_ops) \
                .set("cache", "miss").set("fp", fp[:12])
             compiled = _compile_with_watchdog(lowered, n_ops)
-        _note_compile(tag, time.perf_counter() - t0, n_ops)
+        _note_compile(tag, time.perf_counter() - t0, n_ops,
+                      families=gl_report.families if gl_report else None)
         if aot_cache_enabled():
             try:
                 with _FORK_GATE:   # native serialize: see the gate
@@ -1102,6 +1219,13 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
             raise CompileTimeout(
                 f"compile of {fp[:12]}… previously exceeded the deadline")
         if compiled is None:
+            # pre-submission static vetting (compiler/graphlint): runs on
+            # every jaxpr XLA has never successfully compiled (an existing
+            # artifact or in-process hit never reaches here). A veto
+            # raises CompileHazard — same tier ladder as a killed
+            # compile, zero kills.
+            gl_report = _graphlint_vet(traced, fp, tag, n_ops)
+        if compiled is None:
             if deadline_s and deadline_s > 0:
                 # a known deserialize defect also rules out the FORK
                 # path: its handback rides the same serialized-artifact
@@ -1125,7 +1249,9 @@ def compile_traced(fn, args: tuple, donate_argnums=(), salt: str = "",
                             fp, lowered, deadline_s, n_ops)
                     if compiled is not None:
                         _note_compile(tag, time.perf_counter() - t0,
-                                      n_ops)
+                                      n_ops,
+                                      families=gl_report.families
+                                      if gl_report else None)
                         with _LOCK:
                             STATS["subprocess_compiles"] += 1
                             _DESER.add(fp)   # handback = deserialized

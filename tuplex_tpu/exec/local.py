@@ -119,6 +119,11 @@ class _CpuJit:
                                       deadline_s=self._deadline)
         except CQ._AotUnsupported:
             entry = None
+        except CQ.CompileHazard:
+            # a static veto predicts the hang itself — the plain pinned
+            # jit below is exactly the unbounded compile it forbids, so
+            # it must propagate even with the deadline off
+            raise
         except CQ.CompileTimeout:
             if self._deadline > 0:
                 raise
@@ -857,6 +862,22 @@ class LocalBackend:
         cs, cn = _cq.consume_tag(stage.key())
         metrics["compile_s"] += cs
         metrics["stage_compiles"] = cn
+        # static-vetting attribution (compiler/graphlint): lint cost and
+        # hazard verdicts for THIS stage — submission-time vetoes via the
+        # queue's per-tag ledger, plan-time pre-degrades via the report
+        # the planner left on the stage itself
+        gl_ms, gl_found, gl_avoided = _cq.consume_graphlint(stage.key())
+        rep = getattr(stage, "graph_report", None)
+        if rep is not None:
+            gl_ms += rep.elapsed_ms
+        if getattr(stage, "hazard_rule", None):
+            gl_found += 1
+            gl_avoided += 1
+            metrics["hazard_rule"] = stage.hazard_rule
+        if gl_ms or gl_found:
+            metrics["graphlint_ms"] = round(gl_ms, 3)
+            metrics["hazards_found"] = gl_found
+            metrics["hazards_avoided"] = gl_avoided
         # device-plane cost attribution (runtime/devprof): measured device
         # seconds, XLA flops/bytes/peak-memory and the roofline fraction
         # for THIS stage's dispatches, flat numeric keys riding the same

@@ -124,6 +124,14 @@ class JobRecorder:
                 rec["resolve_tier"] = stage.resolve_plan().tier
             except Exception:   # pragma: no cover - advisory surface
                 pass
+            # plan-time static-vetting verdict (compiler/graphlint): the
+            # hazard score — and, for a vetoed wedge, WHICH rule fired —
+            # visible before the stage runs a single row
+            rep = getattr(stage, "graph_report", None)
+            if rep is not None:
+                rec["hazard_score"] = round(min(rep.hazard_score, 1e9), 2)
+            if getattr(stage, "hazard_rule", None):
+                rec["hazard_rule"] = stage.hazard_rule
         self._write(rec)
         self._last_progress = 0.0
 
@@ -625,6 +633,25 @@ def _render_doc(log_dir: str, live: bool) -> str:
                 f"<tr class=dev><td colspan=7><details><summary>device "
                 f"utilization — {len(dev)} stage(s)</summary>"
                 f"{''.join(cells)}</details></td></tr>")
+        # static-vetting verdicts (compiler/graphlint metrics riding the
+        # stage record): lint cost and the hazards found/avoided per
+        # stage — a vetoed wedge shows up HERE, not as a compile kill
+        for e in stages:
+            m = e["metrics"]
+            if not (m.get("hazards_found") or m.get("hazards_avoided")
+                    or m.get("graphlint_ms")):
+                continue
+            rule = m.get("hazard_rule", "")
+            desc = (f"graphlint {m.get('graphlint_ms', 0):.1f} ms — "
+                    f"{int(m.get('hazards_found', 0))} hazard(s) found, "
+                    f"{int(m.get('hazards_avoided', 0))} compile(s) "
+                    f"avoided")
+            if rule:
+                desc += f" (rule {rule})"
+            rows_html.append(
+                f"<tr class=lint><td colspan=7>⚠ stage {e.get('no', '?')}"
+                f" [{html.escape(str(e.get('kind', '')))}]: "
+                f"{html.escape(desc)}</td></tr>")
         for e in stages:
             for s in e.get("exception_sample", []):
                 rows_html.append(

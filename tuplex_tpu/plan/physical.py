@@ -1070,7 +1070,11 @@ def _plan_stages_impl(sink: L.LogicalOperator, options=None):
     for st in stages:
         if isinstance(st, TransformStage):
             for seg in segment_stage(st):
-                out.extend(_split_oversize(seg, options))
+                # pre-submission jaxpr vetting (compiler/graphlint):
+                # wedge-severity findings pre-degrade HERE, hazard
+                # scores and the static memory bound steer the split
+                rep = _vet_stage(seg, options)
+                out.extend(_split_oversize(seg, options, report=rep))
         else:
             out.append(st)
     # fuse pattern-fold aggregates into the preceding transform stage's
@@ -1345,7 +1349,146 @@ def _op_compiles_uncached(op: L.LogicalOperator,
         return False
 
 
-def _split_oversize(stage: TransformStage, options) -> list:
+def _vet_stage(stage: TransformStage, options) -> object:
+    """Plan-time jaxpr vetting (compiler/graphlint): trace the stage at
+    the probe shapes, attach the GraphReport, and PRE-DEGRADE statically
+    known compile-wedges to the interpreter before the compile plane
+    ever sees them. The flights airport build side is the load-bearing
+    case: its jaxpr matches the ``wide-str-compaction`` rule (round-17
+    bisection — see compiler/graphlint), so instead of burning a 300 s
+    deadline + SIGKILL + tier restart, the stage plans straight onto the
+    tier it would have ended up on anyway. The veto is recorded as a
+    content-addressed ``.hazard`` marker (stage-fingerprint keyed) so
+    lint/explain/compilestats — and any later process planning the same
+    stage — can see WHY without re-tracing. Returns the report (None
+    when the gate is off or the stage isn't traceable)."""
+    from ..compiler import graphlint as GL
+
+    if not GL.enabled() or stage.force_interpret or not stage.ops:
+        return None
+    if not _vet_relevant(stage, options):
+        return None
+    # memo key: the jit-cache key (op identities + schema + speculation
+    # state) — cheap to compute, and by the same argument as the jit
+    # cache it determines the traced jaxpr (backend is fixed per
+    # process, jaxcfg)
+    mk = None
+    try:
+        mk = stage.key()
+    except Exception:
+        pass
+    if mk is not None:
+        hit, report = GL.vet_memo_get(mk)
+        if hit:
+            stage.graph_report = report
+            if report is not None and report.wedge:
+                _apply_wedge_degrade(stage, report)
+            return report
+    from ..runtime import tracing as TR
+
+    with TR.span("plan:graphlint", "plan") as _sp:
+        report = GL.analyze_stage(stage)
+        if _sp is not TR.NOOP and report is not None:
+            _sp.set("eqns", report.n_eqns) \
+               .set("hazard", round(min(report.hazard_score, 1e9), 2)) \
+               .set("wedge", bool(report.wedge))
+    stage.graph_report = report
+    if mk is not None:
+        GL.vet_memo_put(mk, report)
+    if report is None or not report.wedge:
+        return report
+    _apply_wedge_degrade(stage, report)
+    return report
+
+
+#: probe-trace admission for _vet_stage: below ALL of these a stage can
+#: neither wedge nor want construct-steered splitting nor threaten the
+#: memory budget, so the ~300 ms trace is skipped outright
+_VET_MIN_OPS = 16              # split steering only matters on big fusions
+_VET_TIGHT_BUDGET = 32 << 20   # static peak check only bites tiny budgets
+
+
+def _vet_relevant(stage: TransformStage, options) -> bool:
+    """Is the probe trace worth its cost for this stage? Plan-time
+    vetting pays a full ``make_jaxpr`` per stage; for stages that cannot
+    plausibly wedge (fewer string columns on BOTH schema edges than the
+    rule's floor), cannot want a construct-steered split (too few ops),
+    and cannot threaten a tight executor budget, skip it. The compile
+    plane still vets the real traced jaxpr at submission, so the hard
+    no-wedge-submits guarantee does not depend on this heuristic."""
+    from ..compiler import graphlint as GL
+
+    if len(stage.ops) >= _VET_MIN_OPS:
+        return True
+    if options is not None and options.get_size(
+            "tuplex.executorMemory", 1 << 30) < _VET_TIGHT_BUDGET:
+        return True
+    need = GL.WEDGE_MIN_STR_BUFS
+    return (_schema_has_str_cols(stage.input_schema, need)
+            or _schema_has_str_cols(stage.output_schema, need))
+
+
+def _schema_has_str_cols(schema, need: int) -> bool:
+    """>= `need` string leaves in a RowType (the wedge's row-buffer axis,
+    counted without tracing)."""
+    from ..runtime.columns import flatten_type
+
+    n = 0
+    for ci, ct in enumerate(getattr(schema, "types", ()) or ()):
+        for path, lt in flatten_type(ct, str(ci)):
+            if path.endswith("#opt"):
+                continue
+            base = lt.without_option() if lt.is_optional() else lt
+            if base is T.STR:
+                n += 1
+                if n >= need:
+                    return True
+    return False
+
+
+def _apply_wedge_degrade(stage: TransformStage, report) -> None:
+    """Pre-degrade a statically known compile-wedge to the interpreter
+    and record why (stats, content-addressed ``.hazard`` marker, log).
+    The marker address is the compile-plane fingerprint — expensive (it
+    traces), but only ever paid for actual wedges."""
+    from ..exec import compilequeue as CQ
+    from ..utils.logging import get_logger
+
+    rule = next(f.rule for f in report.findings if f.severity == "wedge")
+    stage.force_interpret = True
+    stage.hazard_rule = rule
+    detail = "; ".join(f.line() for f in report.findings
+                       if f.severity == "wedge")
+    with CQ._LOCK:
+        CQ.STATS["hazards_found"] += 1
+        CQ.STATS["hazards_avoided"] += 1
+    try:
+        fp = stage_fingerprint_prevet(stage)
+        if fp is not None:
+            CQ.write_marker(CQ._artifact_path(fp), "hazard",
+                            reason=detail, fp=fp, rule=rule,
+                            plane="plan")
+    except Exception:   # pragma: no cover - provenance is best-effort
+        pass
+    get_logger("plan").warning(
+        "graphlint: stage %s pre-degraded to the interpreter (%s)",
+        ",".join(type(o).__name__ for o in stage.ops), detail)
+
+
+def stage_fingerprint_prevet(stage: TransformStage):
+    """stage_fingerprint ignoring a vet-applied force_interpret pin (the
+    `.hazard` marker must land at the address the compile plane WOULD
+    have used)."""
+    pinned = stage.force_interpret
+    try:
+        stage.force_interpret = False
+        return stage_fingerprint(stage)
+    finally:
+        stage.force_interpret = pinned
+
+
+def _split_oversize(stage: TransformStage, options,
+                    report=None) -> list:
     """Split a very large fused stage into balanced sub-stages on
     accelerator backends. Remote TPU compiles scale superlinearly with
     graph size (the 43-operator flights stage took >20 min in one
@@ -1366,6 +1509,8 @@ def _split_oversize(stage: TransformStage, options) -> list:
         max_ops = options.get_int("tuplex.tpu.maxStageOps", -1)
     n = len(stage.ops)
     dec = None
+    if report is None:
+        report = getattr(stage, "graph_report", None)
     if max_ops < 0:       # auto: ask the tuner
         from ..runtime.jaxcfg import jax
 
@@ -1375,6 +1520,19 @@ def _split_oversize(stage: TransformStage, options) -> list:
         budget = options.get_float(
             "tuplex.tpu.compileBudgetS", 480.0) if options is not None \
             else 480.0
+        # a hazard score past the veto line re-plans with graphlint's
+        # per-op construct costs: the budget becomes the threshold PER
+        # SEGMENT, and chunk boundaries balance hazard cost, so the
+        # split isolates the hazardous span instead of balancing op
+        # counts (the compile plane would otherwise veto the whole
+        # stage, satellite: "split around the hazardous eqn span")
+        hazard_budget = None
+        if report is not None and not report.wedge and n > 1:
+            from ..compiler import graphlint as GL
+
+            threshold = GL.hazard_threshold()
+            if threshold > 0 and report.hazard_score > threshold:
+                hazard_budget = threshold
         # CPU prefers fusion (boundaries are real memcpys, compiles are
         # usually cheap) and splits ONLY when the predicted compile blows
         # the budget — flights' 43-op mega-fusion ran >20 min at >120 GB
@@ -1383,8 +1541,13 @@ def _split_oversize(stage: TransformStage, options) -> list:
         from ..runtime import tracing as TR
 
         with TR.span("plan:split-tune", "plan") as _sp:
-            dec = ST.plan_split(n, budget, ST.model_for(),
-                                prefer_fusion=on_cpu)
+            if hazard_budget is not None:
+                dec = ST.plan_split(n, hazard_budget, ST.model_for(),
+                                    prefer_fusion=on_cpu,
+                                    op_costs=report.op_costs())
+            else:
+                dec = ST.plan_split(n, budget, ST.model_for(),
+                                    prefer_fusion=on_cpu)
             if _sp is not TR.NOOP:
                 # the tuner's verdict rides the span so a trace shows WHY
                 # a plan split (or degraded) without digging through logs
@@ -1409,6 +1572,39 @@ def _split_oversize(stage: TransformStage, options) -> list:
             # on CPU a degrade verdict has nowhere cheaper to go — take
             # the least-bad split and proceed
             max_ops = dec.per if dec.k > 1 else 0
+    # static peak-memory vetting (compiler/graphlint): a stage whose
+    # intermediates STATICALLY exceed the MemoryManager budget at the
+    # runtime batch size must not reach the device — it would OOM-spill
+    # (or hard-fail) after compiling. Splitting shrinks the live set
+    # proportionally to the op share; a single op that alone blows the
+    # budget degrades to the interpreter, which streams rows instead of
+    # materializing columnar intermediates.
+    if report is not None and options is not None \
+            and not stage.force_interpret and report.peak_bytes > 0:
+        mem_budget = options.get_size("tuplex.executorMemory", 1 << 30)
+        psize = options.get_size("tuplex.partitionSize", 4 << 20)
+        est_rows = psize // max(report.input_row_bytes, 1) \
+            if report.input_row_bytes > 0 else report.traced_rows
+        peak = report.peak_bytes_at(est_rows)
+        if mem_budget > 0 and peak > mem_budget:
+            from ..compiler import graphlint as GL
+            from ..utils.logging import get_logger
+
+            fit = (n * mem_budget) // peak
+            if fit >= 1 and n > 1:
+                max_ops = int(fit) if max_ops <= 0 \
+                    else min(max_ops, int(fit))
+                remedy = f"split to <={max_ops} ops/segment"
+            else:
+                stage.force_interpret = True
+                remedy = "degraded to the interpreter"
+            report.findings.append(GL.Finding(
+                "static-peak-memory", "warn",
+                f"static intermediate peak ~{peak >> 20} MiB at "
+                f"~{est_rows} rows/batch exceeds executor memory "
+                f"{mem_budget >> 20} MiB — {remedy}"))
+            get_logger("plan").warning(
+                "graphlint: %s", report.findings[-1].message)
     if not max_ops or n <= max_ops or stage.force_interpret:
         return [stage]
     import math
@@ -1416,13 +1612,22 @@ def _split_oversize(stage: TransformStage, options) -> list:
     k = math.ceil(n / max_ops)
     per = math.ceil(n / k)
     # chunk boundaries must not separate an op from its trailing
-    # Resolve/Ignore guards
+    # Resolve/Ignore guards. A hazard-mode split decision carries COST-
+    # balanced cut points (splittuner boundaries) — honored as long as
+    # nothing tightened the op cap after the decision was made.
+    cuts = list(dec.boundaries) if (dec is not None and dec.boundaries
+                                    and max_ops == dec.per) else None
     chunks: list[list] = [[]]
-    for op in stage.ops:
-        if (len(chunks[-1]) >= per
-                and not isinstance(op, (L.ResolveOperator,
-                                        L.IgnoreOperator))):
+    for i, op in enumerate(stage.ops):
+        if cuts is not None:
+            split_here = bool(cuts) and i >= cuts[0]
+        else:
+            split_here = len(chunks[-1]) >= per
+        if split_here and not isinstance(op, (L.ResolveOperator,
+                                              L.IgnoreOperator)):
             chunks.append([])
+            if cuts:
+                cuts.pop(0)
         chunks[-1].append(op)
     schema = stage.input_schema
     segments: list[TransformStage] = []

@@ -76,6 +76,11 @@ class CompileModel:
         self.path = path if path is not None else (
             os.path.join(d, f"compile_model_{platform}.json") if d else "")
         self.obs: list[list] = []        # [n_ops, seconds]
+        # census-tagged observations [families dict, seconds] recorded by
+        # the compile queue when graphlint is on: the raw material for the
+        # per-family compile-cost terms (family_weights) that ride
+        # ALONGSIDE the op-count power law in predict()
+        self.fam_obs: list[list] = []
         self.boundary: list[float] = []
         # measured warm per-dispatch DEVICE seconds (runtime/devprof:
         # launch→ready, compile excluded) — the first real device-cost
@@ -92,6 +97,7 @@ class CompileModel:
         # from small finished compiles keeps predicting they are fine
         self.censored: dict[int, float] = {}
         self._fit: Optional[tuple] = None
+        self._fam_fit: Optional[tuple] = None
         self._lock = threading.Lock()
         self._load()
 
@@ -104,6 +110,9 @@ class CompileModel:
                 d = json.load(fp)
             self.obs = [o for o in d.get("obs", [])
                         if isinstance(o, list) and len(o) == 2][-_MAX_OBS:]
+            self.fam_obs = [o for o in d.get("fam_obs", [])
+                            if isinstance(o, list) and len(o) == 2
+                            and isinstance(o[0], dict)][-_MAX_OBS:]
             self.boundary = [float(b) for b in
                              d.get("boundary", [])][-_MAX_OBS:]
             self.device = [float(b) for b in
@@ -112,8 +121,9 @@ class CompileModel:
                              d.get("censored", {}).items()}
         except Exception:   # pragma: no cover - corrupt model: start fresh
             self.obs, self.boundary, self.censored = [], [], {}
-            self.device = []
+            self.device, self.fam_obs = [], []
         self._fit = None
+        self._fam_fit = None
 
     def _save(self) -> None:
         if not self.path:
@@ -123,6 +133,7 @@ class CompileModel:
             with open(tmp, "w") as fp:
                 json.dump({"platform": self.platform, "updated": time.time(),
                            "obs": self.obs[-_MAX_OBS:],
+                           "fam_obs": self.fam_obs[-_MAX_OBS:],
                            "boundary": self.boundary[-_MAX_OBS:],
                            "device": self.device[-_MAX_OBS:],
                            "censored": {str(k): v for k, v in
@@ -132,12 +143,19 @@ class CompileModel:
             pass
 
     # -- recording ------------------------------------------------------
-    def record_compile(self, n_ops: int, seconds: float) -> None:
+    def record_compile(self, n_ops: int, seconds: float,
+                       families: Optional[dict] = None) -> None:
         if n_ops <= 0 or seconds <= 0:
             return
         with self._lock:
             self.obs.append([int(n_ops), float(seconds)])
             self.obs = self.obs[-_MAX_OBS:]
+            if families:
+                self.fam_obs.append([
+                    {str(k): int(v) for k, v in families.items() if v},
+                    float(seconds)])
+                self.fam_obs = self.fam_obs[-_MAX_OBS:]
+                self._fam_fit = None
             self._fit = None
             self._save()
 
@@ -248,6 +266,53 @@ class CompileModel:
                     pred = max(pred, cs)
         return pred
 
+    # -- per-family construct terms (graphlint census) ------------------
+    def family_weights(self) -> tuple[dict, bool]:
+        """(per-family compile-seconds weights, fitted?). Fitted by ridge
+        least squares over census-tagged compile observations (each one a
+        primitive-family count vector from compiler/graphlint paired with
+        the measured compile seconds) once >=6 are on record; before
+        that, the graphlint seed weights calibrated offline against the
+        bundled-pipeline corpus. Weights clamp non-negative — a family
+        can't make a compile FASTER, and a noisy fit must not let e.g.
+        scatters subsidize elementwise ops."""
+        from ..compiler import graphlint as GL
+
+        with self._lock:
+            if self._fam_fit is not None:
+                return self._fam_fit
+            obs = list(self.fam_obs)
+        fams = sorted({f for fam, _ in obs for f in fam})
+        if len(obs) >= 6 and fams:
+            try:
+                import numpy as np
+
+                A = np.array([[float(fam.get(f, 0)) for f in fams]
+                              for fam, _ in obs])
+                y = np.array([float(s) for _, s in obs])
+                lam = 1e-3 * max(float((A * A).sum()), 1.0) / A.shape[1]
+                w = np.linalg.solve(A.T @ A + lam * np.eye(len(fams)),
+                                    A.T @ y)
+                weights = dict(GL.FAMILY_WEIGHTS)
+                for f, wf in zip(fams, w):
+                    weights[f] = max(float(wf), 0.0)
+                with self._lock:
+                    self._fam_fit = (weights, True)
+                return self._fam_fit
+            except Exception:   # pragma: no cover - singular/odd census
+                pass
+        with self._lock:
+            self._fam_fit = (dict(GL.FAMILY_WEIGHTS), False)
+            return self._fam_fit
+
+    def census_cost(self, families: dict) -> float:
+        """Predicted compile seconds from the construct census alone:
+        sum of per-family weights times counts. Rides ALONGSIDE the
+        op-count power law in plan_split — two scatter-heavy ops can cost
+        what twenty elementwise ops do, which op count can't see."""
+        w, _ = self.family_weights()
+        return sum(w.get(f, 0.0) * float(c) for f, c in families.items())
+
     def boundary_cost(self) -> float:
         """Measured per-boundary dispatch+transfer tax (median), or the
         platform default before any boundary has been observed."""
@@ -312,6 +377,9 @@ class SplitDecision:
                             # compile on host CPU with device transfer
     fitted: bool            # curve came from measured points, not defaults
     reason: str = ""
+    # op-index cut points (exclusive prefix lengths) when hazard costs
+    # placed the boundaries; None = equal-size chunking by `per`
+    boundaries: Optional[list] = None
 
     def describe(self) -> str:
         shape = (f"{self.n_ops} ops -> {self.k} segment(s) of <="
@@ -322,7 +390,8 @@ class SplitDecision:
         bud = f"budget {self.budget_s:.0f}s" if self.budget_s > 0 \
             else "no budget"
         tail = " — DEGRADED to host-CPU compile" if self.degrade else ""
-        return f"stage-split tuner: {shape}; {pred}; {bud}{tail}"
+        why = f" [{self.reason}]" if self.reason and not self.degrade else ""
+        return f"stage-split tuner: {shape}; {pred}; {bud}{tail}{why}"
 
 
 def _chunk_sizes(n: int, k: int) -> list[int]:
@@ -334,10 +403,44 @@ def _chunk_sizes(n: int, k: int) -> list[int]:
     return sizes
 
 
+def _weighted_chunks(costs: list, k: int) -> list:
+    """Cut `costs` (per-op hazard costs) into <=k contiguous chunks with
+    balanced COST (not count): the cut after op j lands where the cost
+    prefix crosses the next 1/k-th of the total. Returns a list of
+    exclusive cut indices (len k-1); every chunk keeps >=1 op."""
+    n = len(costs)
+    k = min(k, n)
+    if k <= 1:
+        return []
+    total = sum(costs) or float(n)
+    cuts, acc = [], 0.0
+    for j, c in enumerate(costs):
+        acc += c
+        done = len(cuts)
+        if done >= k - 1:
+            break
+        ops_left = n - (j + 1)
+        chunks_left = k - done - 1
+        if acc >= total * (done + 1) / k or ops_left <= chunks_left:
+            cuts.append(j + 1)
+    return cuts
+
+
+def _cost_chunks(costs: list, k: int) -> list:
+    """[(size, cost_sum)] for the k cost-balanced chunks of `costs`."""
+    cuts = _weighted_chunks(costs, k)
+    out, lo = [], 0
+    for hi in cuts + [len(costs)]:
+        out.append((hi - lo, sum(costs[lo:hi])))
+        lo = hi
+    return out
+
+
 def plan_split(n_ops: int, budget_s: float,
                model: Optional[CompileModel] = None,
                max_segments: int = 32,
-               prefer_fusion: bool = False) -> SplitDecision:
+               prefer_fusion: bool = False,
+               op_costs: Optional[list] = None) -> SplitDecision:
     """Pick the segment count for an `n_ops` fused stage.
 
     Minimizes predicted_compile + boundary tax over k; a positive
@@ -351,33 +454,77 @@ def plan_split(n_ops: int, budget_s: float,
     fits, the decision carries ``degrade=True`` with the cheapest split's
     numbers (what the accelerator WOULD cost): the physical planner then
     keeps the stage fused and pins its compile to the host CPU instead of
-    the accelerator (_split_oversize)."""
+    the accelerator (_split_oversize).
+
+    `op_costs` (compiler/graphlint: per-op construct-weighted compile
+    seconds) rides ALONGSIDE the op-count curve: each candidate segment
+    is predicted at max(power_law(size), hazard cost of its ops), and the
+    chunk boundaries balance hazard COST rather than op count — two
+    scatter-compaction ops can out-cost twenty elementwise ops, which op
+    count alone can't see. When the hazard term (not the op-count curve)
+    changes the chosen split, the decision says so (reason="hazard...")
+    and carries the cost-balanced cut points in `boundaries`."""
     model = model or model_for()
     n_ops = max(int(n_ops), 1)
+    if op_costs is not None and len(op_costs) != n_ops:
+        # spread a mismatched cost vector evenly (e.g. census from a
+        # traced fn whose op list was re-segmented since)
+        tot = sum(op_costs)
+        op_costs = [tot / n_ops] * n_ops
     # per-boundary unit tax: the host-side dispatch+transfer sample plus
     # the MEASURED device occupancy of one extra dispatch (devprof's warm
     # launch→ready median; 0.0 until a profiled run exists)
     bcost = model.boundary_cost() + model.device_dispatch_cost()
     (_, _, _), fitted = model.curve()
-    cands = []
-    for k in range(1, min(n_ops, max_segments) + 1):
-        sizes = _chunk_sizes(n_ops, k)
-        comp = sum(model.predict(s) for s in sizes)
-        bnd = (len(sizes) - 1) * bcost
-        cands.append((k, max(sizes), comp, bnd))
-    in_budget = [c for c in cands if budget_s <= 0 or c[2] <= budget_s]
-    if in_budget:
-        key = (lambda c: c[0]) if prefer_fusion \
-            else (lambda c: c[2] + c[3])
-        k, per, comp, bnd = min(in_budget, key=key)
-        return SplitDecision(n_ops, k, per, comp, bnd, budget_s,
-                             degrade=False, fitted=fitted)
-    # nothing fits: finest split, degraded to a host-CPU compile
-    k, per, comp, bnd = min(cands, key=lambda c: c[2])
-    return SplitDecision(
-        n_ops, k, per, comp, bnd, budget_s, degrade=True, fitted=fitted,
-        reason=f"finest split still predicts {comp:.0f}s compile "
-               f"> budget {budget_s:.0f}s")
+
+    def candidates(costs):
+        cs = []
+        for k in range(1, min(n_ops, max_segments) + 1):
+            if costs is None:
+                chunks = [(s, 0.0) for s in _chunk_sizes(n_ops, k)]
+            else:
+                chunks = _cost_chunks(costs, k)
+            segs = [max(model.predict(s), c) for s, c in chunks]
+            bnd = (len(chunks) - 1) * bcost
+            cs.append((k, max(s for s, _ in chunks), sum(segs), bnd,
+                       max(segs)))
+        return cs
+
+    def choose(cands, per_segment):
+        # op-count mode: the budget caps the summed serial compile (the
+        # historical contract). Hazard mode: construct cost is CONSERVED
+        # by splitting (the scatters don't go away), so a total-sum cap
+        # could never be met by any k — what splitting buys is smaller
+        # compile UNITS, so the budget caps the worst single segment.
+        def fits(c):
+            return budget_s <= 0 or \
+                (c[4] if per_segment else c[2]) <= budget_s
+        in_budget = [c for c in cands if fits(c)]
+        if in_budget:
+            key = (lambda c: c[0]) if prefer_fusion \
+                else (lambda c: c[2] + c[3])
+            return min(in_budget, key=key), False
+        return min(cands, key=lambda c: c[2]), True
+
+    hazard = op_costs is not None
+    (k, per, comp, bnd, _worst), over = choose(candidates(op_costs), hazard)
+    reason = ""
+    if over:
+        reason = (f"finest split still predicts {comp:.0f}s compile "
+                  f"> budget {budget_s:.0f}s")
+    boundaries = None
+    if hazard:
+        (k0, _, _, _, _), over0 = choose(candidates(None), False)
+        if k != k0 or over != over0:
+            reason = (
+                f"hazard: construct-weighted compile cost picked "
+                f"{'degrade' if over else f'k={k}'} (op-count curve alone "
+                f"picked {'degrade' if over0 else f'k={k0}'})")
+        if k > 1:
+            boundaries = _weighted_chunks(op_costs, k)
+    return SplitDecision(n_ops, k, per, comp, bnd, budget_s,
+                         degrade=over, fitted=fitted, reason=reason,
+                         boundaries=boundaries)
 
 
 def log_decision(dec: SplitDecision) -> None:

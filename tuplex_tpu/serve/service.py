@@ -111,6 +111,9 @@ class JobService:
 
         devprof.apply_options(o)   # serve CLI builds options Context-less
         excprof.apply_options(o)   # exception-plane drift knobs + health
+        from ..compiler import graphlint
+
+        graphlint.apply_options(o)   # pre-submission jaxpr vetting
         self._register_telemetry(o)
         # closed-loop self-healing (serve/respec): watch each tenant's
         # drift signal, re-speculate in the background, canary, hot-swap

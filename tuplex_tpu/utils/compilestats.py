@@ -86,6 +86,13 @@ def _stage_rows(stages, model) -> tuple[list, dict]:
         dec = getattr(st, "split_decision", None)
         if dec is not None:
             row["split"] = dec.describe()
+        rep = getattr(st, "graph_report", None)
+        if rep is not None:
+            row["hazard_score"] = float(min(rep.hazard_score, 1e9))
+            row["findings"] = [f.line() for f in rep.findings]
+            row["worst"] = rep.worst_severity()
+        if getattr(st, "hazard_rule", None):
+            row["hazard_rule"] = st.hazard_rule
         rows.append(row)
     return rows, {fp: ix for fp, ix in by_fp.items() if len(ix) > 1}
 
@@ -119,6 +126,74 @@ def _cost_line(entry: Optional[dict]) -> Optional[str]:
     if cost.partial:
         bits.append("(partial analysis)")
     return "measured cost: " + ", ".join(bits)
+
+
+def lint_jaxprs(script: str, stream=None) -> tuple[int, int]:
+    """`lint`'s jaxpr findings section: import the script with actions
+    stubbed (same harness as compilestats — no stage executes, nothing
+    compiles), plan each action, and print every graphlint finding the
+    planner attached while vetting the stages. Returns
+    ``(n_findings, n_wedge)`` so `lint --strict` can fail on
+    wedge-severity jaxpr findings."""
+    import sys as _sys
+
+    from ..plan.physical import TransformStage, plan_stages
+
+    stream = stream if stream is not None else _sys.stdout
+
+    def emit(line=""):
+        print(line, file=stream)
+
+    from ..plan.physical import JoinStage
+
+    captured = _capture_plans(script)
+    n_findings = n_wedge = 0
+    emitted_header = False
+    for pi, (action, sink, options) in enumerate(captured):
+        try:
+            stages = plan_stages(sink, options)
+        except Exception as e:
+            emit(f"jaxpr findings: planning {action} failed: "
+                 f"{type(e).__name__}: {e}")
+            continue
+        # join build sides plan lazily at execution time; vet them here
+        # too (the flights airport wedge lives on one)
+        labelled = [(str(i), st) for i, st in enumerate(stages)]
+        for i, st in enumerate(stages):
+            if isinstance(st, JoinStage):
+                try:
+                    labelled += [(f"{i}.build[{j}]", bs) for j, bs in
+                                 enumerate(plan_stages(st.op.right,
+                                                       options))]
+                except Exception:
+                    pass
+        for i, st in labelled:
+            if not isinstance(st, TransformStage):
+                continue
+            rep = getattr(st, "graph_report", None)
+            if rep is None or not rep.findings:
+                continue
+            if not emitted_header:
+                emit()
+                emit("jaxpr findings (compiler/graphlint, post-trace "
+                     "pre-compile):")
+                emitted_header = True
+            ops = ",".join(type(o).__name__ for o in st.ops)
+            emit(f"  plan {pi + 1} ({action}) stage {i} [{ops}] — "
+                 f"hazard score {min(rep.hazard_score, 1e9):.1f}s")
+            for f in rep.findings:
+                emit(f"    {f.line()}")
+                n_findings += 1
+                if f.severity == "wedge":
+                    n_wedge += 1
+            if getattr(st, "hazard_rule", None):
+                emit(f"    -> pre-degraded to the interpreter "
+                     f"(rule {st.hazard_rule})")
+    if emitted_header:
+        emit()
+        emit(f"jaxpr findings: {n_findings} finding(s), "
+             f"{n_wedge} wedge-severity")
+    return n_findings, n_wedge
 
 
 def main(script: str, platform: Optional[str] = None) -> int:
@@ -179,6 +254,16 @@ def main(script: str, platform: Optional[str] = None) -> int:
             print(f"{head}: {', '.join(bits)}")
             if row.get("split"):
                 print(f"    {row['split']}")
+            if row.get("hazard_rule"):
+                print(f"    HAZARD: pre-degraded to the interpreter "
+                      f"(rule {row['hazard_rule']})")
+            elif row.get("hazard_score") is not None:
+                hline = (f"    hazard score "
+                         f"{row['hazard_score']:.1f}s")
+                n_find = len(row.get("findings") or ())
+                if n_find:
+                    hline += f", {n_find} jaxpr finding(s)"
+                print(hline)
             if not row.get("interpreter"):
                 cl = _cost_line(cost_index.get(row.get("key", "")))
                 if cl:

@@ -80,6 +80,20 @@ def explain(sink, options=None, lint: bool = False) -> str:
             n = stage_eqn_count(st)
             if n is not None:
                 out.append(f"  codegen: {n} jaxpr equations (fast path)")
+        # static-vetting verdict (compiler/graphlint): the planner leaves
+        # its GraphReport on every vetted stage — surface the hazard
+        # score and any named findings, plus the pre-degrade decision
+        rep = getattr(st, "graph_report", None)
+        if rep is not None:
+            out.append(f"  hazard score: "
+                       f"{min(rep.hazard_score, 1e9):.1f}s predicted "
+                       f"compile")
+            for f in rep.findings:
+                out.append(f"  jaxpr: {f.line()}")
+        rule = getattr(st, "hazard_rule", None)
+        if rule:
+            out.append(f"  pre-degraded to the interpreter "
+                       f"(graphlint rule {rule})")
         if lint and hasattr(st, "udf_reports"):
             reports = st.udf_reports()
             if reports:
