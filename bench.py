@@ -258,6 +258,26 @@ def child() -> None:
         print(f"OUTPUT MISMATCH: got {len(got)} rows, want {len(want)}",
               file=sys.stderr)
 
+    # --- latency budget (runtime/critpath) ---------------------------------
+    # one extra NON-timed run with tracing on (the timed loop above runs
+    # untraced so the ring append never rides the measurement), then sweep
+    # the span timeline into the exclusive bucket vector: bench_diff gates
+    # the dotted latency_budget.* keys (the interpreter-resolve share and
+    # the unattributed remainder must not grow)
+    latency_budget = {}
+    try:
+        from tuplex_tpu.runtime import tracing
+        was_on = tracing.enabled()
+        tracing.enable(True)
+        tracing.clear()
+        zillow.build_pipeline(ctx.csv(data)).collect()
+        latency_budget = ctx.metrics.latencyBudget()
+        tracing.enable(was_on)
+        tracing.clear()
+    except Exception as e:   # readout is best-effort, never fails the bench
+        print(f"latency_budget skipped: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
     fast_s = ctx.metrics.fastPathWallTime()
     vs_llvm, llvm_kind = _vs_llvm(rate)
     # device-plane cost attribution (runtime/devprof) for the BEST timed
@@ -314,6 +334,10 @@ def child() -> None:
         # and the CPython sample traces that verdict let planning skip
         "analyzer_inferred_ops": ctx.metrics.analyzerInferredOps(),
         "sample_traces_skipped": ctx.metrics.sampleTracesSkipped(),
+        # critical-path wall attribution of one traced steady-state run
+        # (runtime/critpath): bucket seconds + unattributed_frac under
+        # dotted keys bench_diff gates directionally
+        "latency_budget": latency_budget,
     }
     if spec_env is not None:
         result["speculate_branches"] = spec_on

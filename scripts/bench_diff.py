@@ -80,6 +80,18 @@ HIGHER_BETTER = {
     "tier_mix.interpreter": False,
     "resolve_tier_mix.exact_exit": None,
     "resolve_tier_mix.general": None,
+    # latency-budget plane (runtime/critpath): the wall fraction the
+    # sweep could NOT attribute must not grow (observability decaying is
+    # a regression even when perf holds), nor the seconds burned on the
+    # interpreter resolve tier — matched via the two-segment rule like
+    # the tier-mix keys ('resolve_interpreter' could gate as a bare leaf,
+    # but registering the dotted form keeps it scoped to bench budgets).
+    # The other bucket seconds are informational: a plan change
+    # legitimately moves time between compile/h2d/device/merge, and the
+    # aggregate already gates through wall_s / p99 / rows-per-sec.
+    "unattributed_frac": False,
+    "latency_budget.resolve_interpreter": False,
+    "coverage_frac": None,           # informational (tracks unattributed)
     "rows_seen": None,               # informational (dataset-dependent)
     # chaos drift scenario (scripts/chaos_bench.py): windows until the
     # respecialize signal trips after the shift / until health recovers

@@ -1,0 +1,140 @@
+#!/usr/bin/env python3
+"""Latency-budget plane smoke test: drive zillow serve jobs through the
+JobService with tracing ON and assert the ISSUE-19 acceptance chain —
+every job's exclusive bucket vector sums to >= 90% of its end-to-end
+wall (``unattributed_frac < 0.10``), the dominant bucket is stable
+across two warm runs, and the SAME attribution reaches every surface:
+the ``tuplex_critpath_*`` Prometheus families, the history ``critpath``
+event the dashboard budget panel renders, and the `python -m tuplex_tpu
+whyslow` readout.
+
+Run directly (CI wires it as a tier-1 test via tests/test_critpath.py):
+
+    JAX_PLATFORMS=cpu python scripts/critpath_smoke.py
+
+Exits 0 and prints one `critpath-smoke OK ...` line on success; any
+assertion failure is a non-zero exit. CRITPATH_SMOKE_ROWS overrides the
+input size (default 400 — matching tests/test_zillow_model.py so a warm
+AOT artifact cache skips the XLA compiles)."""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))          # run from anywhere
+
+N_ROWS = int(os.environ.get("CRITPATH_SMOKE_ROWS", "400"))
+
+
+def main() -> int:
+    import tuplex_tpu
+    from tuplex_tpu.models import zillow
+    from tuplex_tpu.runtime import critpath, telemetry
+    from tuplex_tpu.serve import JobService, request_from_dataset
+
+    with tempfile.TemporaryDirectory() as d:
+        data = os.path.join(d, "zillow.csv")
+        zillow.generate_csv(data, N_ROWS, seed=7)
+        ctx = tuplex_tpu.Context({
+            "tuplex.scratchDir": os.path.join(d, "scratch"),
+            "tuplex.logDir": d,
+            "tuplex.webui.enable": True,
+            "tuplex.tpu.trace": True,
+        })
+        assert critpath.enabled(), \
+            "critpath disabled (TUPLEX_CRITPATH=0 set?) — nothing to smoke"
+        svc = JobService(ctx.options_store, recorder=ctx.recorder)
+        want = zillow.run_reference_python(data)
+
+        budgets = {}
+        # two warm-up jobs pay the compile plane (the general-path
+        # resolve stage only compiles on first USE, so one warm-up still
+        # leaves r1 paying its XLA leg); r1/r2 are the steady-state pair
+        # the dominant-bucket stability check compares
+        for name in ("warm", "warm2", "r1", "r2"):
+            h = svc.submit(request_from_dataset(
+                zillow.build_pipeline(ctx.csv(data)), name=name,
+                tenant="smoke"))
+            assert h.wait(1200) == "done", (name, h.state, h.error)
+            assert h.result() == want, f"{name}: output changed"
+            lb = h.latency_budget()
+            assert lb and lb.get("buckets"), (name, lb)
+            budgets[name] = lb
+
+        # --- coverage: buckets sum to >= 90% of each job's wall --------
+        for name, lb in budgets.items():
+            s = sum(lb["buckets"].values())
+            assert abs(s - lb["wall_s"]) < 1e-4, (name, s, lb["wall_s"])
+            assert lb["unattributed_frac"] < 0.10, \
+                (name, lb["unattributed_frac"], lb["buckets"])
+
+        # --- stability: warm runs agree on the dominant bucket ---------
+        d1, d2 = budgets["r1"]["dominant"], budgets["r2"]["dominant"]
+        assert d1 == d2, f"dominant bucket unstable across warm runs: " \
+            f"{d1} vs {d2} ({budgets['r1']['buckets']} vs " \
+            f"{budgets['r2']['buckets']})"
+
+        # --- surface parity 1: Prometheus families ---------------------
+        text = telemetry.render_prometheus()
+        for fam in ("tuplex_critpath_jobs", "tuplex_critpath_budget_seconds",
+                    "tuplex_critpath_wall_ewma_seconds",
+                    "tuplex_critpath_unattributed_frac"):
+            assert fam in text, f"{fam} missing from /metrics exposition"
+        assert 'tenant="smoke"' in text, "tenant label missing"
+        # the exposed per-bucket gauge must carry the dominant bucket the
+        # job budgets reported
+        assert f'bucket="{d1}"' in text, (d1, "missing from /metrics")
+
+        svc.close()
+
+        # --- surface parity 2: history event + dashboard panel ---------
+        hist = os.path.join(d, "tuplex_history.jsonl")
+        cp_evs = []
+        with open(hist) as fp:
+            for line in fp:
+                r = json.loads(line)
+                if r.get("event") == "critpath":
+                    cp_evs.append(r)
+        assert len(cp_evs) == 4, (len(cp_evs), "critpath events")
+        for ev in cp_evs:
+            assert ev["buckets"] and ev["wall_s"] > 0, ev
+        from tuplex_tpu.history.recorder import render_report
+
+        html = open(render_report(d)).read()
+        assert "latency budget" in html, "dashboard budget panel missing"
+        assert "cptrack" in html, "budget strip missing"
+        assert "onpath" in html, "waterfall critical-path outline missing"
+
+        # --- surface parity 3: the whyslow CLI reads the same record ---
+        from tuplex_tpu.utils.whyslow import main as whyslow_main
+
+        buf = io.StringIO()
+        stdout, sys.stdout = sys.stdout, buf
+        try:
+            whyslow_main(d)
+        finally:
+            sys.stdout = stdout
+        out = buf.getvalue()
+        assert "dominant " + d1 in out, (d1, out[:800])
+        assert "critical path" in out, out[:800]
+        # parity on the numbers, not just presence: whyslow prints the
+        # dominant bucket's milliseconds from the same history record
+        dom_ms = budgets["r2"]["buckets"][d2] * 1e3
+        assert f"{dom_ms:.1f}" in out, (dom_ms, out[:1500])
+
+        ctx.close()
+        print(f"critpath-smoke OK — 4 job(s), dominant {d1}, "
+              f"unattributed "
+              f"{max(b['unattributed_frac'] for b in budgets.values()):.4f}"
+              f" worst-case, surfaces agree (/metrics + dashboard + "
+              f"whyslow)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -142,6 +142,11 @@ def main(argv=None) -> int:
             "tuplex.serve.slots": args.slots,
             "tuplex.serve.queueDepth": max(64, 2 * args.jobs),
             "tuplex.serve.respec": args.respec == "on",
+            # span tracing feeds the latency-budget plane (runtime/
+            # critpath): per-tenant bucket vectors ride the tenants block
+            # below and bench_diff gates the interpreter share +
+            # unattributed_frac
+            "tuplex.tpu.trace": True,
         })
         svc = JobService(ctx.options_store)
 
@@ -194,6 +199,25 @@ def main(argv=None) -> int:
                              for k, v in rep["tier_mix"].items()},
                 "drift_score": round(rep["drift_score"], 4),
             }
+
+        # per-tenant latency budgets (runtime/critpath): the EWMA bucket
+        # baseline each tenant converged to over its jobs, plus the
+        # unattributed remainder — the dotted latency_budget.* keys gate
+        # in bench_diff (interpreter-resolve share and unattributed_frac
+        # must not grow)
+        from tuplex_tpu.runtime import critpath
+
+        if critpath.enabled():
+            for t in critpath.tenants():
+                rep = critpath.tenant_report(t)
+                if not rep or not rep.get("jobs"):
+                    continue
+                row = tenants.setdefault(t, {})
+                row["latency_budget"] = {
+                    k: round(float(v), 6)
+                    for k, v in (rep["baseline"] or {}).items()}
+                row["unattributed_frac"] = round(
+                    float(rep.get("unattributed_ewma") or 0.0), 4)
 
         result = {
             "metric": "serve_zillow_p99_latency_s",

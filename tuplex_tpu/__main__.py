@@ -10,6 +10,7 @@ TuplexShell, launched by the `tuplex` console entry point). Subcommands:
     python -m tuplex_tpu compilestats script.py   # compile forecast
     python -m tuplex_tpu trace out.json   # history -> Chrome trace JSON
     python -m tuplex_tpu excstats         # exception-plane readout
+    python -m tuplex_tpu whyslow [job]    # latency-budget readout
     python -m tuplex_tpu serve <root>     # multi-tenant job service
     python -m tuplex_tpu version          # print the package version
 
@@ -67,6 +68,18 @@ def main(argv=None) -> int:
                          "(tuplex.logDir; default .)")
     ex.add_argument("--job", default=None,
                     help="only jobs whose id starts with this prefix")
+    ws = sub.add_parser(
+        "whyslow",
+        help="latency-budget readout from the job history: per-job "
+             "critical-path bucket vector vs the tenant's EWMA baseline, "
+             "slow-job blame, SLO verdicts (runtime/critpath)")
+    ws.add_argument("job", nargs="?", default=None,
+                    help="only jobs whose id starts with this prefix")
+    ws.add_argument("--log-dir", default=".",
+                    help="directory holding tuplex_history.jsonl "
+                         "(tuplex.logDir; default .)")
+    ws.add_argument("--glossary", action="store_true",
+                    help="print the bucket glossary and exit")
     tr = sub.add_parser(
         "trace",
         help="replay the job history as Chrome trace-event JSON "
@@ -171,6 +184,17 @@ def main(argv=None) -> int:
             return ex_main(args.log_dir, job=args.job)
         except OSError as e:
             print(f"excstats: {e}", file=sys.stderr)
+            return 2
+    if args.cmd == "whyslow":
+        from .utils.whyslow import glossary, main as ws_main
+
+        if args.glossary:
+            glossary()
+            return 0
+        try:
+            return ws_main(args.log_dir, job=args.job)
+        except OSError as e:
+            print(f"whyslow: {e}", file=sys.stderr)
             return 2
     if args.cmd == "trace":
         from .history.recorder import history_to_chrome

@@ -200,6 +200,11 @@ class Metrics:
             "exception_rate": self.exceptionRate(),
             "resolve_tier_mix": self.resolveTierMix(),
             "drift_score": self._drift_score(),
+            # latency-budget plane (runtime/critpath): the last job's
+            # critical-path bucket vector swept from the tracing ring
+            # (bench JSON flattens to latency_budget.* dotted keys);
+            # empty when critpath or tracing is off
+            "latency_budget": self.latencyBudget(),
             "analyzer_ms": self.analyzerTimeMs(),
             "plan_fallback_ops": self.planFallbackOps(),
             "analyzer_inferred_ops": self.analyzerInferredOps(),
@@ -225,6 +230,29 @@ class Metrics:
             return float(excprof.drift_score(None))
         except Exception:   # pragma: no cover - readout is best-effort
             return 0.0
+
+    @staticmethod
+    def latencyBudget() -> dict:
+        """Critical-path bucket vector of the latest traced job
+        (runtime/critpath sweeping the tracing ring): bucket -> seconds
+        plus ``unattributed_frac``/``coverage_frac``/``dominant``. Empty
+        dict when critpath is disabled (TUPLEX_CRITPATH=0), tracing
+        never recorded a job span, or the sweep fails — the readout is
+        best-effort and must never raise."""
+        try:
+            from ..runtime import critpath
+
+            r = critpath.analyze_ring()
+            if not r:
+                return {}
+            return {**{k: round(float(v), 6)
+                       for k, v in r["buckets"].items()},
+                    "unattributed_frac": round(
+                        float(r["unattributed_frac"]), 4),
+                    "coverage_frac": round(float(r["coverage_frac"]), 4),
+                    "dominant": r["dominant"]}
+        except Exception:   # pragma: no cover - readout is best-effort
+            return {}
 
     def as_json(self) -> str:
         import json

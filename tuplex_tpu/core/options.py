@@ -179,6 +179,36 @@ DEFAULTS: dict[str, str] = {
                                             # respecialize_recommended one
                                             # window after a distribution
                                             # shift
+    "tuplex.serve.sloMs": "0",              # per-job latency objective
+                                            # (milliseconds, end-to-end:
+                                            # admission to terminal) every
+                                            # tenant is held to by the
+                                            # latency-budget plane
+                                            # (runtime/critpath): each
+                                            # finished job counts toward
+                                            # its tenant's attainment and
+                                            # burn-rate windows, and the
+                                            # `slo` health check degrades
+                                            # on a burning fast window.
+                                            # 0 = no SLO declared
+    "tuplex.serve.tenantSlos": "",          # "tenantA:250,tenantB:1000" —
+                                            # per-tenant SLO overrides in
+                                            # milliseconds (unlisted
+                                            # tenants use sloMs)
+    "tuplex.serve.sloBurnWindowS": "60",    # the FAST burn-rate window in
+                                            # seconds (the slow window is
+                                            # 5x): burn = window miss
+                                            # fraction / error budget;
+                                            # fast >= 1 -> degraded, fast
+                                            # AND slow >= 1 (sustained)
+                                            # -> unhealthy, recovery is
+                                            # automatic as misses age out
+    "tuplex.serve.sloTarget": "0.9",        # attainment objective the
+                                            # burn rate is normalized
+                                            # against (error budget =
+                                            # 1 - target; 0.9 = 10% of
+                                            # jobs may miss before burn
+                                            # reads 1.0)
     "tuplex.serve.respec": "true",          # closed-loop self-healing
                                             # (serve/respec.py): when a
                                             # tenant's exception-plane
@@ -402,6 +432,44 @@ DEFAULTS: dict[str, str] = {
                                             # instead (any exception there
                                             # is evidence the speculation
                                             # went stale)
+    "tuplex.tpu.critpath": "true",          # latency-budget plane
+                                            # (runtime/critpath): per-job
+                                            # critical-path attribution
+                                            # over the span timeline into
+                                            # the canonical exclusive
+                                            # buckets (admission/queue
+                                            # waits, compile trace/lower/
+                                            # xla, h2d, device, resolve
+                                            # tiers, d2h, merge,
+                                            # scheduler/other,
+                                            # unattributed), per-tenant
+                                            # EWMA budget baselines with
+                                            # slow-job blame, and the SLO
+                                            # attainment/burn plane.
+                                            # Surfaced via `python -m
+                                            # tuplex_tpu whyslow`, the
+                                            # dashboard budget panel,
+                                            # tuplex_critpath_* /metrics
+                                            # gauges and bench
+                                            # latency_budget.* keys. Needs
+                                            # tuplex.tpu.trace for full
+                                            # coverage (without spans only
+                                            # the wait buckets resolve).
+                                            # TUPLEX_CRITPATH=0 kills it
+                                            # with a zero-allocation
+                                            # disabled path
+    "tuplex.tpu.critpathHalfLifeS": "120",  # EWMA half-life of the per-
+                                            # tenant baseline budget
+                                            # vectors (the regression-
+                                            # blame anchor; same fold as
+                                            # excprof's drift EWMA)
+    "tuplex.tpu.critpathSlowFactor": "1.5",  # a job whose end-to-end wall
+                                            # exceeds its tenant's EWMA
+                                            # baseline by this factor is
+                                            # SLOW: the grown bucket is
+                                            # blamed (serve:slow-job
+                                            # instant + dashboard +
+                                            # whyslow)
     "tuplex.tpu.trace": "false",            # structured span tracing
                                             # (runtime/tracing.py): nested
                                             # spans across plan/compile/

@@ -1169,17 +1169,20 @@ class LocalBackend:
         t0 = time.perf_counter()
         with TR.span("partition:dispatch", "exec") as _sp:
             _sp.set("rows", part.num_rows).set("start", part.start_index)
-            batch = C.stage_partition(part, self.bucket_mode)
-            leaf_h2d = 0
-            if not isinstance(device_fn, PackedStageFn):
-                # per-leaf staging: the jit call uploads the numpy arrays
-                # (packed dispatch notes its own single-buffer H2D; arrays
-                # already device-resident — the handoff view — cost 0).
-                # Counted AFTER the call succeeds — a first-call trace
-                # failure re-enters here via _redispatch_plain and would
-                # otherwise double-count an upload that never happened
-                leaf_h2d = sum(v.nbytes for v in batch.arrays.values()
-                               if isinstance(v, np.ndarray))
+            with TR.span("h2d:leaf-stage", "xfer") as _hsp:
+                batch = C.stage_partition(part, self.bucket_mode)
+                leaf_h2d = 0
+                if not isinstance(device_fn, PackedStageFn):
+                    # per-leaf staging: the jit call uploads the numpy
+                    # arrays (packed dispatch notes its own single-buffer
+                    # H2D; arrays already device-resident — the handoff
+                    # view — cost 0). Counted AFTER the call succeeds — a
+                    # first-call trace failure re-enters here via
+                    # _redispatch_plain and would otherwise double-count
+                    # an upload that never happened
+                    leaf_h2d = sum(v.nbytes for v in batch.arrays.values()
+                                   if isinstance(v, np.ndarray))
+                _hsp.set("bytes", leaf_h2d)
             return self._dispatch_launch(part, device_fn, skey, use_comp,
                                          stage, packed, batch, t0,
                                          leaf_h2d=leaf_h2d)
@@ -1478,6 +1481,12 @@ class LocalBackend:
             n_before = len(fallback_idx)
             with TR.span("resolve:general", "exec") as _sp:
                 _sp.set("rows", n_before)
+                faults.maybe("resolve", point="general")   # chaos
+                # checkpoint: a hang (delay=) INSIDE the span injects pure
+                # resolve-path latency — the lever the latency-budget
+                # acceptance uses to prove whyslow, the dashboard panel
+                # and serve:slow-job all blame the same bucket
+                # (runtime/critpath)
                 self._general_case_pass(stage, part, fallback_idx, resolved,
                                         device_codes, buffers=bufs)
                 _sp.set("resolved", len(resolved))

@@ -269,19 +269,24 @@ class JobRecorder:
 
 def _span_slice(evts: list, cap: int) -> tuple:
     """The embedded per-job span slice: (spans, n_total, n_dropped).
-    Past `cap` events, keep the top spans BY DURATION, not the first N to
-    complete — a many-partition job's structural spans (job, stage
-    executes, compiles) finish last and must survive the cap; only the
-    shortest leaf spans drop. Re-sorted by start so the slice stays a
-    timeline. Truncation is never silent: the dropped count rides the
-    record (the waterfall panel renders it) and bumps the
-    ``trace_spans_dropped`` counter (runtime/xferstats — visible in
-    Metrics counters and the Prometheus scrape)."""
+    Past `cap` events, truncate DEEPEST-SUBTREE-FIRST: only spans that are
+    currently leaves of the containment forest are eligible to drop
+    (deepest first, shortest first within a depth), and dropping a span
+    can make its parent a leaf for the next round — so the embedded slice
+    is always a connected tree. A keep-by-duration policy would sever
+    trees: a long leaf could survive while its shorter parent dropped,
+    and every consumer that reconstructs the hierarchy by containment
+    (the dashboard waterfall, the `trace` replay, runtime/critpath's
+    orphan detection) would misfile the orphan as degraded input.
+    Structural spans (job, stage executes, compiles) are interior nodes,
+    so they survive by construction. Truncation is never silent: the
+    dropped count rides the record (the waterfall panel renders it) and
+    bumps the ``trace_spans_dropped`` counter (runtime/xferstats —
+    visible in Metrics counters and the Prometheus scrape)."""
     n_total = len(evts)
     n_dropped = max(0, n_total - cap)
     if n_dropped:
-        evts = sorted(sorted(evts, key=lambda e: -(e.get("dur") or 0.0))
-                      [:cap], key=lambda e: e["ts"])
+        evts = _prune_deepest(evts, n_dropped)
         from ..runtime import xferstats
 
         xferstats.bump("trace_spans_dropped", n_dropped, tag="embed_cap")
@@ -293,6 +298,66 @@ def _span_slice(evts: list, cap: int) -> tuple:
               **({"args": e["args"]} if e.get("args") else {})}
              for e in evts]
     return spans, n_total, n_dropped
+
+
+def _prune_deepest(evts: list, n_drop: int) -> list:
+    """Drop exactly ``n_drop`` spans, leaves-of-the-containment-forest
+    first (deepest, then shortest), so what remains is always a connected
+    tree per thread lane. Parent links come from interval containment on
+    each tid's timeline — the same reconstruction the waterfall uses —
+    not from the recorded ``depth`` field, so a slice stays connected
+    even when cross-thread spans carry surprising depths."""
+    import heapq
+
+    order = sorted(range(len(evts)),
+                   key=lambda i: (evts[i].get("tid", 0),
+                                  float(evts[i]["ts"]),
+                                  -(evts[i].get("dur") or 0.0)))
+    parent = [-1] * len(evts)
+    nkids = [0] * len(evts)
+    sdepth = [0] * len(evts)
+    stack: list = []          # open-span indices for the current tid
+    cur_tid = object()
+    eps = 0.05                # µs slack for rounded/coincident edges
+    for i in order:
+        e = evts[i]
+        tid = e.get("tid", 0)
+        if tid != cur_tid:
+            cur_tid, stack = tid, []
+        ts = float(e["ts"])
+        end = ts + float(e.get("dur") or 0.0)
+        # pop every frame this span is NOT contained in — handles both
+        # disjoint predecessors and partial overlap (a straddling span
+        # becomes a sibling of the frame it overlaps, not its child)
+        while stack and end > stack[-1][1] + eps:
+            stack.pop()
+        if stack:
+            parent[i] = stack[-1][0]
+            nkids[parent[i]] += 1
+            sdepth[i] = sdepth[parent[i]] + 1
+        else:
+            # no containment parent: drop at the recorded depth so a
+            # cross-thread orphan still yields before shallower spans
+            sdepth[i] = int(e.get("depth") or 0)
+        stack.append((i, end))
+    dropped = [False] * len(evts)
+    # heapq is a min-heap: (-depth, dur) pops deepest-then-shortest first
+    heap = [(-sdepth[i], evts[i].get("dur") or 0.0, i)
+            for i in range(len(evts)) if nkids[i] == 0]
+    heapq.heapify(heap)
+    left = n_drop
+    while left > 0 and heap:
+        _, _, i = heapq.heappop(heap)
+        dropped[i] = True
+        left -= 1
+        p = parent[i]
+        if p >= 0:
+            nkids[p] -= 1
+            if nkids[p] == 0 and not dropped[p]:
+                heapq.heappush(
+                    heap, (-sdepth[p], evts[p].get("dur") or 0.0, p))
+    return sorted((evts[i] for i in range(len(evts)) if not dropped[i]),
+                  key=lambda e: e["ts"])
 
 
 _LINT_CAP = 80
@@ -442,12 +507,90 @@ def _excprof_html(ev: dict) -> str:
             f"{''.join(body)}</details>")
 
 
+def _critpath_html(ev: dict) -> str:
+    """Latency-budget panel for one job (runtime/critpath `critpath`
+    event): the exclusive bucket vector as a proportional budget strip +
+    table against the tenant's EWMA baseline, the slow-job blame verdict,
+    and the SLO line when one is declared. The same numbers `python -m
+    tuplex_tpu whyslow` prints — the panels must agree because they read
+    the same record."""
+    buckets = ev.get("buckets") or {}
+    wall = float(ev.get("wall_s") or 0.0)
+    if not buckets or wall <= 0:
+        return ""
+    tenant = ev.get("tenant")
+    who = f"tenant {html.escape(str(tenant))}" if tenant else "job"
+    dom = str(ev.get("dominant") or "?")
+    cov = float(ev.get("coverage_frac") or 0.0) * 100
+    badge = ""
+    if ev.get("slow"):
+        blame = str(ev.get("blame") or "?")
+        badge = (f' <span class=slowbadge>SLOW — blame '
+                 f'{html.escape(blame)}</span>')
+    if ev.get("degraded"):
+        badge += ' <span class=degbadge>degraded trace</span>'
+    slo = ""
+    if float(ev.get("slo_ms") or 0.0) > 0:
+        ok = ev.get("slo_ok")
+        slo = (f" · SLO {float(ev['slo_ms']):.0f}ms "
+               f"{'met' if ok else 'MISSED' if ok is not None else '?'}")
+    head = (f"latency budget — {who}: wall {wall * 1e3:.1f}ms, dominant "
+            f"<b>{html.escape(dom)}</b>, coverage {cov:.1f}%{slo}{badge}")
+    # proportional budget strip: one segment per nonzero bucket, in
+    # canonical order, colored like the waterfall categories
+    strip, left = [], 0.0
+    order = [b for b in _CP_ORDER if b in buckets] + \
+            [b for b in buckets if b not in _CP_ORDER]
+    for b in order:
+        frac = float(buckets.get(b) or 0.0) / wall
+        if frac <= 0:
+            continue
+        w = min(frac, 1.0 - left / 100.0) * 100.0
+        strip.append(f'<span class="cpseg cp-{html.escape(b)}" '
+                     f'style="left:{left:.2f}%;width:{max(w, 0.1):.2f}%" '
+                     f'title="{html.escape(b)} '
+                     f'{float(buckets[b]) * 1e3:.1f}ms"></span>')
+        left += w
+    base = ev.get("baseline") or {}
+    rows = ["<table class=cptab><tr><th>bucket</th><th>ms</th>"
+            "<th>share</th><th>baseline ms</th><th>Δ ms</th></tr>"]
+    for b in order:
+        v = float(buckets.get(b) or 0.0)
+        bl = base.get(b)
+        if v <= 0 and not bl:
+            continue
+        cls = " class=cpdom" if b == dom else ""
+        if ev.get("slow") and b == ev.get("blame"):
+            cls = " class=cpblame"
+        d = "" if bl is None else f"{(v - float(bl)) * 1e3:+.1f}"
+        rows.append(
+            f"<tr{cls}><td><code>{html.escape(b)}</code></td>"
+            f"<td>{v * 1e3:.1f}</td><td>{v / wall * 100:.1f}%</td>"
+            f"<td>{'—' if bl is None else f'{float(bl) * 1e3:.1f}'}</td>"
+            f"<td>{d or '—'}</td></tr>")
+    rows.append("</table>")
+    return (f"<details class=critpath><summary>{head}</summary>"
+            f"<div class=cptrack>{''.join(strip)}</div>"
+            f"{''.join(rows)}</details>")
+
+
+# canonical bucket order for the budget panel (mirrors critpath.BUCKETS
+# without importing the runtime module into the static dashboard path)
+_CP_ORDER = ("admission_wait", "queue_wait", "compile_trace",
+             "compile_lower", "compile_xla", "h2d", "device",
+             "resolve_general", "resolve_interpreter", "d2h", "merge",
+             "scheduler_other", "unattributed")
+
+
 _WF_CAP = 120      # bars per job (longest-first keeps the picture honest)
 
 
-def _waterfall_html(sp_ev: dict) -> str:
+def _waterfall_html(sp_ev: dict, cp_ev: Optional[dict] = None) -> str:
     """Span waterfall for one job: proportional bars over the job's trace
-    window, indented by nesting depth, colored by category."""
+    window, indented by nesting depth, colored by category. When the
+    job's `critpath` record is available, bars owning a critical-path
+    segment get the `onpath` outline so the budget panel's attribution
+    is visible in the timeline itself."""
     spans = sp_ev.get("spans", [])
     if not spans:
         return ""
@@ -456,24 +599,35 @@ def _waterfall_html(sp_ev: dict) -> str:
     total = max(t1 - t0, 1e-6)
     shown = sorted(spans, key=lambda s: -(s.get("dur") or 0.0))[:_WF_CAP]
     shown.sort(key=lambda s: (s["ts"], s.get("depth", 0)))
+    # critical-path segments from the budget record: [ts, dur, bucket,
+    # name] on the same trace clock as the embedded spans
+    path = (cp_ev or {}).get("path") or []
     bars = []
+    n_onpath = 0
     for s in shown:
         left = (s["ts"] - t0) / total * 100.0
         width = max((s.get("dur") or 0.0) / total * 100.0, 0.15)
         dur_ms = (s.get("dur") or 0.0) / 1e3
         cat = str(s.get("cat") or "exec")
+        s_end = s["ts"] + (s.get("dur") or 0.0)
+        onpath = any(p[3] == s["name"] and p[0] >= s["ts"] - 0.2
+                     and p[0] + p[1] <= s_end + 0.2 for p in path)
+        n_onpath += onpath
         label = f"{s['name']} {dur_ms:.1f}ms"
         indent = int(s.get("depth", 0)) * 10
         bars.append(
             f'<div class=wfrow style="padding-left:{indent}px">'
             f'<span class=wflabel>{html.escape(label)}</span>'
             f'<span class=wftrack><span class="wfbar cat-'
-            f'{html.escape(cat)}" style="left:{left:.2f}%;'
+            f'{html.escape(cat)}{" onpath" if onpath else ""}" '
+            f'style="left:{left:.2f}%;'
             f'width:{width:.2f}%"></span></span></div>')
     n_total = sp_ev.get("n_total", len(spans))
     n_dropped = sp_ev.get("n_dropped", 0)
     head = (f"span waterfall — {len(shown)} of {n_total} span(s) shown, "
             f"{total / 1e3:.1f}ms window")
+    if n_onpath:
+        head += f", {n_onpath} on the critical path (outlined)"
     if n_dropped:
         # the recorder capped the embedded slice: say so instead of
         # letting a truncated panel read as the whole timeline
@@ -679,14 +833,25 @@ def _render_doc(log_dir: str, live: bool) -> str:
             rows_html.append(
                 f"<tr class=excp><td colspan=7>{_excprof_html(exev)}"
                 f"</td></tr>")
+        # latency-budget panel (runtime/critpath `critpath` event): the
+        # exclusive bucket vector, blame verdict and SLO line — rendered
+        # before the waterfall so the budget reads first, and handed to
+        # the waterfall so critical-path bars get the outline
+        cp_ev = next((e for e in reversed(events)
+                      if e.get("event") == "critpath"), None)
+        if cp_ev:
+            cp_html = _critpath_html(cp_ev)
+            if cp_html:
+                rows_html.append(
+                    f"<tr class=cp><td colspan=7>{cp_html}</td></tr>")
         # span waterfall (the 'spans' event job_done embeds when tracing
         # was on): one bar per span, offset/width proportional to the
         # job's trace window, lane color by category
         sp_ev = next((e for e in events if e.get("event") == "spans"), None)
         if sp_ev and sp_ev.get("spans"):
             rows_html.append(
-                f"<tr class=wf><td colspan=7>{_waterfall_html(sp_ev)}"
-                f"</td></tr>")
+                f"<tr class=wf><td colspan=7>"
+                f"{_waterfall_html(sp_ev, cp_ev)}</td></tr>")
 
     refresh = '<meta http-equiv="refresh" content="2">' if live else ""
     doc = f"""<!doctype html><meta charset="utf-8">
@@ -740,6 +905,32 @@ def _render_doc(log_dir: str, live: bool) -> str:
  .wfbar.cat-xfer {{ background: #4a90c2; }}
  .wfbar.cat-mem {{ background: #c25a8a; }}
  .wfbar.cat-job {{ background: #778; }}
+ .wfbar.onpath {{ outline: 2px solid #c23a3a; outline-offset: 1px; }}
+ tr.cp td {{ border-bottom: none; }}
+ .critpath summary {{ font-size: 12px; color: #456; cursor: pointer; }}
+ .slowbadge {{ background: #c23a3a; color: #fff; font-size: 11px;
+               padding: 0 .4em; border-radius: 3px; }}
+ .degbadge {{ background: #b90; color: #fff; font-size: 11px;
+              padding: 0 .4em; border-radius: 3px; }}
+ .cptrack {{ position: relative; height: 14px; background: #f4f4f4;
+             margin: .3rem 0 .3rem 1rem; }}
+ .cpseg {{ position: absolute; top: 1px; height: 12px; min-width: 1px;
+           background: #8ab; }}
+ .cp-admission_wait, .cp-queue_wait {{ background: #aab; }}
+ .cp-compile_trace, .cp-compile_lower, .cp-compile_xla
+   {{ background: #d6906b; }}
+ .cp-h2d, .cp-d2h {{ background: #4a90c2; }}
+ .cp-device {{ background: #5a9e6f; }}
+ .cp-resolve_general {{ background: #c2a23a; }}
+ .cp-resolve_interpreter {{ background: #c2703a; }}
+ .cp-merge {{ background: #7b6bd6; }}
+ .cp-scheduler_other {{ background: #99a; }}
+ .cp-unattributed {{ background: repeating-linear-gradient(45deg, #ddd,
+                     #ddd 3px, #bbb 3px, #bbb 6px); }}
+ table.cptab {{ width: auto; font-size: 12px; margin: .3rem 0 .3rem 1rem; }}
+ table.cptab th, table.cptab td {{ padding: .15rem .6rem; }}
+ table.cptab tr.cpdom td {{ font-weight: bold; }}
+ table.cptab tr.cpblame td {{ color: #c23a3a; font-weight: bold; }}
 </style>
 <h1>tuplex_tpu job history</h1>
 <p>{len(jobs)} job(s) · {html.escape(src)}</p>

@@ -317,6 +317,18 @@ def _window(scope: str) -> dict:
     return w
 
 
+def ewma_alpha(dt_s: float, half_life_s: float) -> float:
+    """Time-aware EWMA fold factor: the weight a window spanning
+    ``dt_s`` seconds gets against the running estimate, parameterized
+    so the old estimate retains exactly half its weight after one
+    half-life. Shared with runtime/critpath's per-tenant latency-budget
+    baselines so both drift detectors forget at the same, documented
+    rate."""
+    if half_life_s <= 0:
+        return 1.0
+    return 1.0 - 2.0 ** (-dt_s / half_life_s)
+
+
 def _roll_locked(w: dict, now: float, force: bool = False) -> None:
     """Fold the current window into the EWMA when its span elapsed. An
     elapsed EMPTY window decays the EWMA toward the anchor — a tenant
@@ -343,7 +355,7 @@ def _roll_locked(w: dict, now: float, force: bool = False) -> None:
     else:
         w["t0"] = now
         return
-    alpha = 1.0 - 2.0 ** (-dt / _half_life_s)
+    alpha = ewma_alpha(dt, _half_life_s)
     if w["ewma_rate"] is None:
         w["ewma_rate"] = rate
         w["ewma_unexpected"] = unexpected

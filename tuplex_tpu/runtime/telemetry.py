@@ -581,6 +581,56 @@ def _excprof_lines(lines: list) -> None:
                     f"{_fmt_val(rep.get(key, 0.0))}")
 
 
+def _critpath_lines(lines: list) -> None:
+    """Latency-budget plane (runtime/critpath): per-tenant EWMA budget
+    baselines (seconds per canonical bucket), SLO attainment, the
+    multi-window burn-rate gauges the ``slo`` health check reads, and
+    slow-job counts — the /metrics face of the same record whyslow and
+    the dashboard budget panel render."""
+    try:
+        from . import critpath
+    except Exception:       # pragma: no cover - import cycle safety
+        return
+    if not critpath.enabled():
+        return
+    tens = sorted(critpath.tenants())
+    if not tens:
+        return
+    fams: dict[str, list] = {
+        "critpath_jobs": [], "critpath_budget_seconds": [],
+        "critpath_wall_ewma_seconds": [], "critpath_unattributed_frac": [],
+        "critpath_slow_jobs": [], "critpath_slo_ms": [],
+        "critpath_slo_attainment": [], "critpath_burn_rate": []}
+    for tenant in tens:
+        rep = critpath.tenant_report(tenant)
+        lt = (("tenant", tenant or "global"),)
+        fams["critpath_jobs"].append((lt, rep["jobs"]))
+        fams["critpath_wall_ewma_seconds"].append((lt, rep["wall_ewma_s"]))
+        fams["critpath_unattributed_frac"].append(
+            (lt, rep["unattributed_ewma"]))
+        fams["critpath_slow_jobs"].append((lt, rep["slow_jobs"]))
+        for bucket, v in sorted(rep["baseline"].items()):
+            fams["critpath_budget_seconds"].append(
+                (lt + (("bucket", bucket),), v))
+        if rep["slo_ms"] > 0:
+            fams["critpath_slo_ms"].append((lt, rep["slo_ms"]))
+            if rep["attainment"] is not None:
+                fams["critpath_slo_attainment"].append(
+                    (lt, rep["attainment"]))
+            br = rep["burn"]
+            fams["critpath_burn_rate"].append(
+                (lt + (("window", "fast"),), br["fast"]))
+            fams["critpath_burn_rate"].append(
+                (lt + (("window", "slow"),), br["slow"]))
+    for fam, rows in fams.items():
+        if not rows:
+            continue
+        n = _PREFIX + fam
+        lines.append(f"# TYPE {n} gauge")
+        for lbl, v in rows:
+            lines.append(f"{n}{_fmt_labels(lbl)} {_fmt_val(v)}")
+
+
 def render_prometheus(reg: Optional[Registry] = None) -> str:
     """The full scrape: registry histograms + gauges, bridged xferstats
     counter families, compile-plane stats, and the health state as
@@ -625,6 +675,7 @@ def render_prometheus(reg: Optional[Registry] = None) -> str:
     _compile_plane_lines(lines)
     _devprof_lines(lines)
     _excprof_lines(lines)
+    _critpath_lines(lines)
 
     # health
     h = reg.health()

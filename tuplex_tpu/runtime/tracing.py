@@ -111,6 +111,15 @@ def now_us() -> float:
     return (time.perf_counter() - _t0) * 1e6
 
 
+def to_trace_us(perf_s: float) -> float:
+    """Convert a raw ``time.perf_counter()`` reading to microseconds on
+    the trace clock. Both clocks share the perf_counter timebase, so
+    scheduler stamps (JobRecord.t_submit/t_start) and span timestamps
+    become directly comparable — runtime/critpath uses this to bound a
+    job's running window on the span timeline."""
+    return (perf_s - _t0) * 1e6
+
+
 class _NoopSpan:
     """Shared do-nothing span for the disabled path: entering, exiting and
     setting attributes all fall through. One module-level instance — a

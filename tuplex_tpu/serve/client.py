@@ -284,6 +284,10 @@ def _finish(jdir: str, handle, jcache: Optional[dict] = None) -> None:
                 "counters": handle.counters(),
                 "stats": handle.stats,
                 "attempts": handle.attempts(),
+                # latency-budget vector (runtime/critpath): wire clients
+                # get the same per-bucket attribution + critical path an
+                # in-process JobHandle.latency_budget() reads
+                "latency_budget": handle.latency_budget(),
                 "exception_counts": {}}
         for e in handle.exceptions():
             resp["exception_counts"][e.exc_name] = \

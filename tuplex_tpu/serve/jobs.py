@@ -211,6 +211,15 @@ class JobHandle:
         job that succeeded first try."""
         return [dict(a) for a in self._rec.attempts]
 
+    def latency_budget(self) -> dict:
+        """The job's latency-budget vector (runtime/critpath): its
+        end-to-end wall attributed into the canonical exclusive buckets
+        (admission/queue waits, compile split, h2d/device/d2h, resolve
+        tiers, merge, scheduler/other) with an honest ``unattributed``
+        remainder, plus the swept critical path. Empty until the job is
+        terminal or when critpath is disabled."""
+        return dict(self._rec.latency_budget or {})
+
     # -- completion --------------------------------------------------------
     def wait(self, timeout: Optional[float] = None) -> str:
         """Block until the job reaches a terminal state (or `timeout`
@@ -257,6 +266,9 @@ class JobRecord:
         self.result_rows: Optional[list] = None
         self.runner: Optional[_JobRunner] = None
         self.final_counters: Optional[dict] = None
+        self.latency_budget: Optional[dict] = None   # runtime/critpath
+                                            # bucket vector, stamped at
+                                            # the terminal turn
         self.weight = max(1, int(weight))
         self.burst = 0                      # consecutive steps this round
         self.attempt = 0                    # completed FAILED attempts

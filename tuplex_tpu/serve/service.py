@@ -111,6 +111,11 @@ class JobService:
 
         devprof.apply_options(o)   # serve CLI builds options Context-less
         excprof.apply_options(o)   # exception-plane drift knobs + health
+        from ..runtime import critpath
+
+        critpath.apply_options(o)  # latency-budget plane: SLOs, burn-rate
+        # health, per-tenant baseline budget vectors (tuplex.serve.sloMs /
+        # tenantSlos / sloBurnWindowS / sloTarget + tuplex.tpu.critpath*)
         from ..compiler import graphlint
 
         graphlint.apply_options(o)   # pre-submission jaxpr vetting
@@ -429,9 +434,17 @@ class JobService:
                     rec.t_start = time.perf_counter()
                     rec.stats["queued_s"] = rec.t_start - rec.t_submit
             if rec.t_enqueue is not None:
-                telemetry.observe("serve_stage_queue_wait_seconds",
-                                  time.perf_counter() - rec.t_enqueue,
+                qw = time.perf_counter() - rec.t_enqueue
+                telemetry.observe("serve_stage_queue_wait_seconds", qw,
                                   tenant=rec.request.tenant)
+                # cumulative stage-queue wait ALSO rides the record: the
+                # latency-budget plane (runtime/critpath) attributes it
+                # as the queue_wait bucket — span gaps alone cannot tell
+                # a DRR requeue from an unattributed stall
+                if rec.t_start is not None \
+                        and rec.t_enqueue > rec.t_start:
+                    rec.stats["stage_queue_s"] = \
+                        rec.stats.get("stage_queue_s", 0.0) + qw
             self._run_turn(rec)
 
     def _note_attempt(self, rec: JobRecord, err: BaseException) -> bool:
@@ -477,7 +490,7 @@ class JobService:
         transient failures requeue the job from stage 0 after its
         exponential backoff (the slot frees immediately — backoff never
         blocks a worker)."""
-        from ..runtime import excprof, tracing, xferstats
+        from ..runtime import critpath, excprof, tracing, xferstats
 
         done = False
         err: Optional[BaseException] = None
@@ -567,15 +580,68 @@ class JobService:
             # file so `python -m tuplex_tpu trace` replays serve jobs too
             # (before the state flip: a waiter that sees DONE must find
             # the rows already written)
-            if tracing.enabled():
-                evts = tracing.events_for_stream(rec.id)
+            evts = tracing.events_for_stream(rec.id) \
+                if tracing.enabled() else []
+            if evts:
                 r = self.recorder
-                if evts and r is not None and getattr(r, "enabled", False):
+                if r is not None and getattr(r, "enabled", False):
                     try:
                         r.serve_job_spans(rec.id, evts,
                                           tenant=rec.request.tenant)
                     except Exception:   # dashboard rows are advisory
                         pass
+            # latency-budget plane (runtime/critpath): sweep the job's
+            # span stream into the canonical exclusive bucket vector,
+            # fold the tenant's EWMA baseline + SLO burn windows, and
+            # surface the blame verdict — whyslow, the dashboard budget
+            # panel and the serve:slow-job instant all read THIS record
+            if critpath.enabled():
+                try:
+                    budget = critpath.analyze_events(
+                        evts,
+                        wall_s=now - rec.t_submit,
+                        queued_s=float(rec.stats.get("queued_s") or 0.0),
+                        stage_queue_s=float(
+                            rec.stats.get("stage_queue_s") or 0.0),
+                        t0_us=tracing.to_trace_us(rec.t_start)
+                        if rec.t_start is not None and evts else None,
+                        t1_us=tracing.to_trace_us(now) if evts else None)
+                    verdict = critpath.record_job(
+                        rec.request.tenant, rec.id, budget,
+                        failed=err is not None)
+                    rec.latency_budget = budget
+                    if budget is not None:
+                        if verdict.get("slow"):
+                            tracing.instant("serve:slow-job", "serve", {
+                                "job": rec.id,
+                                "tenant": rec.request.tenant,
+                                "wall_ms": round(
+                                    budget["wall_s"] * 1e3, 1),
+                                "baseline_ms": round(
+                                    (verdict.get("baseline_wall_s")
+                                     or 0.0) * 1e3, 1),
+                                "blame": verdict.get("blame"),
+                                "delta_ms": round(
+                                    verdict.get("delta_s", 0.0) * 1e3,
+                                    1)})
+                        self._record_event(
+                            rec, "critpath", tenant=rec.request.tenant,
+                            wall_s=budget["wall_s"],
+                            dominant=budget["dominant"],
+                            unattributed_frac=budget[
+                                "unattributed_frac"],
+                            coverage_frac=budget["coverage_frac"],
+                            degraded=budget["degraded"],
+                            buckets=budget["buckets"],
+                            path=budget["path"][:32],
+                            slow=bool(verdict.get("slow")),
+                            blame=verdict.get("blame"),
+                            slo_ms=verdict.get("slo_ms"),
+                            slo_ok=verdict.get("slo_ok"),
+                            baseline=critpath.tenant_report(
+                                rec.request.tenant)["baseline"])
+                except Exception:   # budget rows are advisory
+                    pass
             # snapshot the job's scoped counter family onto the record and
             # release the registry entry (a service that lives for
             # thousands of jobs must not keep one family per job)
@@ -684,5 +750,6 @@ class JobService:
             # controller state (quarantine markers persist on disk)
             for t in retired_tenants:
                 excprof.drop_scope(t)
+                critpath.drop_tenant(t)
                 if self.respec is not None:
                     self.respec.note_tenant_retired(t)

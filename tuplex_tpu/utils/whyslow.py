@@ -1,0 +1,115 @@
+"""`python -m tuplex_tpu whyslow` — latency-budget readout from the job
+history.
+
+Renders the terminal ``critpath`` events (serve/service stamps one per
+job from runtime/critpath's span-timeline sweep) as text: the exclusive
+bucket vector with shares of wall, the tenant's EWMA baseline with
+per-bucket deltas, the slow-job blame verdict, the SLO met/missed line,
+and the critical-path segment strip — the same record the dashboard
+budget panel and the ``serve:slow-job`` instant read, so the three
+surfaces must agree. Reads ``<logDir>/tuplex_history.jsonl``; nothing
+executes.
+"""
+
+from __future__ import annotations
+
+# canonical bucket order (critpath.BUCKETS) — kept inline so the readout
+# works from a bare history file without importing the runtime plane
+_ORDER = ("admission_wait", "queue_wait", "compile_trace", "compile_lower",
+          "compile_xla", "h2d", "device", "resolve_general",
+          "resolve_interpreter", "d2h", "merge", "scheduler_other",
+          "unattributed")
+
+_GLOSS = {
+    "admission_wait": "queued before the scheduler admitted the job",
+    "queue_wait": "requeued between stage turns (DRR slot contention)",
+    "compile_trace": "jax trace of the stage fn",
+    "compile_lower": "StableHLO lowering",
+    "compile_xla": "XLA backend compile (inline, not pool-overlapped)",
+    "h2d": "host->device transfer",
+    "device": "device execution of compiled stages",
+    "resolve_general": "compiled general-case resolve pass",
+    "resolve_interpreter": "interpreter-tier row-at-a-time resolve",
+    "d2h": "device->host fetch",
+    "merge": "partition merge on host",
+    "scheduler_other": "scheduler bookkeeping / unclassified spans",
+    "unattributed": "wall time no span or wait accounts for",
+}
+
+
+def main(log_dir: str = ".", job: str | None = None) -> int:
+    from ..history.recorder import _load_jobs
+
+    jobs = _load_jobs(log_dir)      # FileNotFoundError -> caller prints
+    n_shown = 0
+    for job_id, events in jobs.items():
+        if job is not None and not str(job_id).startswith(job):
+            continue
+        cpev = next((e for e in reversed(events)
+                     if e.get("event") == "critpath"), None)
+        if cpev is None or not cpev.get("buckets"):
+            continue
+        n_shown += 1
+        _print_job(job_id, cpev)
+    if n_shown == 0:
+        which = f" matching {job!r}" if job else ""
+        print(f"whyslow: no latency-budget events{which} in "
+              f"{log_dir or '.'}/tuplex_history.jsonl — run a serve job "
+              f"with tuplex.tpu.critpath on (the default; "
+              f"TUPLEX_CRITPATH=0 disables) and tuplex.tpu.trace for "
+              f"full coverage")
+    return 0
+
+
+def _print_job(job_id: str, ev: dict) -> None:
+    wall = float(ev.get("wall_s") or 0.0)
+    tenant = ev.get("tenant")
+    head = f"job {job_id}"
+    if tenant:
+        head += f" (tenant {tenant})"
+    head += (f" — wall {wall * 1e3:.1f}ms, dominant "
+             f"{ev.get('dominant', '?')}, coverage "
+             f"{float(ev.get('coverage_frac') or 0.0) * 100:.1f}%")
+    if ev.get("degraded"):
+        head += "  [degraded trace]"
+    print(head)
+    if ev.get("slow"):
+        print(f"  SLOW: blame {ev.get('blame', '?')} "
+              f"(+{float(ev.get('delta_s') or 0.0) * 1e3:.1f}ms over the "
+              f"tenant baseline)")
+    if float(ev.get("slo_ms") or 0.0) > 0:
+        ok = ev.get("slo_ok")
+        state = "met" if ok else ("MISSED" if ok is not None else "?")
+        print(f"  SLO {float(ev['slo_ms']):.0f}ms: {state}")
+    buckets = ev.get("buckets") or {}
+    base = ev.get("baseline") or {}
+    order = [b for b in _ORDER if b in buckets] + \
+            [b for b in buckets if b not in _ORDER]
+    print(f"  {'bucket':<20} {'ms':>9} {'share':>7} {'base ms':>9} "
+          f"{'Δ ms':>8}")
+    for b in order:
+        v = float(buckets.get(b) or 0.0)
+        bl = base.get(b)
+        if v <= 0 and not bl:
+            continue
+        mark = " *" if b == ev.get("dominant") else \
+            (" !" if ev.get("slow") and b == ev.get("blame") else "")
+        share = f"{v / wall * 100:.1f}%" if wall > 0 else "—"
+        bs = f"{float(bl) * 1e3:.1f}" if bl is not None else "—"
+        d = f"{(v - float(bl)) * 1e3:+.1f}" if bl is not None else "—"
+        print(f"  {b:<20} {v * 1e3:>9.1f} {share:>7} {bs:>9} {d:>8}"
+              f"{mark}")
+    path = ev.get("path") or []
+    if path:
+        print(f"  critical path ({len(path)} segment(s)):")
+        for p in path[:24]:
+            print(f"    {float(p[0]) / 1e3:>9.1f}ms  "
+                  f"{float(p[1]) / 1e3:>8.1f}ms  {p[2]:<20} {p[3]}")
+        if len(path) > 24:
+            print(f"    … {len(path) - 24} more")
+
+
+def glossary() -> None:
+    """Print the bucket glossary (the README's table, for the terminal)."""
+    for b in _ORDER:
+        print(f"  {b:<20} {_GLOSS[b]}")
